@@ -1,0 +1,34 @@
+"""byteps_tpu: a TPU-native distributed-training communication framework.
+
+A ground-up rebuild of the capabilities of BytePS (reference mounted at
+/root/reference; see SURVEY.md) for JAX/XLA on TPU: a Horovod-style
+``push_pull`` gradient-synchronization core with tensor partitioning,
+priority-based communication scheduling, credit-based pipelining,
+cross-barrier overlap, async/elastic modes, and a gradient-compression
+engine — driving chunked XLA collectives over the ICI/DCN mesh instead of
+NCCL + a ZMQ/RDMA parameter server.
+
+Top-level API mirrors the reference's BytePSBasics surface
+(byteps/common/__init__.py in the reference): init/shutdown, rank/size,
+push_pull, declare, plus the framework adapters under byteps_tpu.jax and
+byteps_tpu.torch.
+"""
+
+__version__ = "0.1.0"
+
+from byteps_tpu.core.api import (  # noqa: F401
+    init,
+    shutdown,
+    suspend,
+    resume,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    push_pull,
+    push_pull_async,
+    poll,
+    synchronize,
+    declare,
+    get_pushpull_speed,
+)

@@ -1,0 +1,163 @@
+"""Chunked XLA collectives: the data plane of push_pull.
+
+This layer replaces the reference's entire communication pipeline — NCCL
+ReduceScatter/AllGather inside a machine, shm staging, ps-lite ZPush/ZPull to
+parameter servers (reference core_loops.cc:190-360,538-618, nccl_manager.cc)
+— with XLA collectives emitted from ``shard_map`` over the (dcn, ici) mesh.
+
+Two reduction strategies, matching the reference's two-level design
+(docs/architecture.md:14-41):
+
+- :func:`all_reduce` — single fused psum over all mesh axes.  Best inside
+  one ICI domain, where XLA's allreduce is already bandwidth-optimal.
+- :func:`hierarchical_all_reduce` — explicit reduce-scatter over ICI,
+  cross-slice psum over DCN on the 1/n_ici shard, then all-gather over ICI.
+  This reproduces the reference's "NCCL RS -> push/server-sum/pull -> NCCL
+  AG" flow (operations.cc:429-485) and is the hook point where DCN-crossing
+  bytes can be compressed (each device only exchanges its shard).
+
+Data model: rank-stacked arrays.  The Horovod-style contract is "every rank
+contributes one tensor; everyone receives the sum".  Under a single JAX
+controller the R ranks' tensors are one array of shape [R, ...] sharded along
+axis 0 over the whole mesh; the reduced result is replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import CommContext, DCN_AXIS, ICI_AXIS
+
+
+def _rank_index(n_ici: int):
+    return lax.axis_index(DCN_AXIS) * n_ici + lax.axis_index(ICI_AXIS)
+
+
+def _cached(comm: CommContext, key, builder):
+    # Compiled collectives live on the CommContext so they are released
+    # together with the mesh on shutdown/resume (elastic mode would otherwise
+    # accumulate dead meshes in a module-level cache).
+    fn = comm.jit_cache.get(key)
+    if fn is None:
+        fn = comm.jit_cache[key] = builder()
+    return fn
+
+
+def _all_reduce_fn(comm: CommContext, average: bool):
+    def build():
+        axes = comm.dp_axes
+
+        def body(x):
+            r = lax.psum(x[0], axes)
+            if average:
+                r = (r / comm.num_ranks).astype(x.dtype)
+            return r
+
+        # No donation: the input frequently aliases a user-held gradient
+        # array (engine passes a reshape view), which donation would delete
+        # on TPU.
+        return jax.jit(jax.shard_map(body, mesh=comm.mesh,
+                                     in_specs=P(axes), out_specs=P()))
+    return _cached(comm, ("all_reduce", average), build)
+
+
+def _hierarchical_fn(comm: CommContext, average: bool):
+    n_ici = comm.n_ici
+
+    def build():
+        def body(x):
+            x = x[0]  # [n], n % n_ici == 0
+            # intra-slice reduce-scatter: each device owns a summed shard
+            s = lax.psum_scatter(x, ICI_AXIS, scatter_dimension=0, tiled=True)
+            # inter-slice exchange of the shard only (ps push+pull
+            # equivalent); a size-1 dcn axis makes this a no-op but keeps
+            # the value replication statically provable.
+            s = lax.psum(s, DCN_AXIS)
+            if average:
+                s = (s / comm.num_ranks).astype(x.dtype)
+            return s
+
+        # The reference finishes with an intra-node AllGather ("BROADCAST"
+        # stage, core_loops.cc:254-268).  Here the gather is implicit: the
+        # body returns each device's reduced shard and out_specs=P(ici)
+        # stitches the global tensor, so XLA only materializes an all-gather
+        # if and where a consumer actually needs unsharded values.
+        inner = jax.shard_map(body, mesh=comm.mesh,
+                              in_specs=P(comm.dp_axes),
+                              out_specs=P(ICI_AXIS))
+
+        def fn(stacked):
+            r = stacked.shape[0]
+            flat = stacked.reshape(r, -1)
+            n = flat.shape[1]
+            pad = (-n) % n_ici
+            if pad:
+                flat = jnp.pad(flat, ((0, 0), (0, pad)))
+            out = inner(flat)
+            if pad:
+                out = out[:n]
+            return out.reshape(stacked.shape[1:])
+
+        return jax.jit(fn)
+
+    return _cached(comm, ("hierarchical", average), build)
+
+
+def _broadcast_fn(comm: CommContext, root: int):
+    def build():
+        n_ici = comm.n_ici
+
+        def body(x):
+            x = x[0]
+            # The reference implements broadcast as zero-non-root + sum
+            # push_pull (torch/__init__.py:259-291); same trick here.
+            mask = (_rank_index(n_ici) == root).astype(x.dtype)
+            return lax.psum(x * mask, (DCN_AXIS, ICI_AXIS))
+
+        return jax.jit(jax.shard_map(body, mesh=comm.mesh,
+                                     in_specs=P(comm.dp_axes), out_specs=P()))
+
+    return _cached(comm, ("broadcast", root), build)
+
+
+def _as_stacked(comm: CommContext, stacked) -> jax.Array:
+    """Ensure the [R, ...] array is sharded rank-major over the mesh."""
+    if stacked.shape[0] != comm.num_ranks:
+        raise ValueError(
+            f"stacked axis 0 ({stacked.shape[0]}) != num_ranks "
+            f"({comm.num_ranks})")
+    sharding = comm.stacked_sharding(extra_dims=stacked.ndim - 1)
+    return jax.device_put(stacked, sharding)
+
+
+def all_reduce(comm: CommContext, stacked, op: str = "sum") -> jax.Array:
+    """Sum (or average) rank-stacked tensors; returns the replicated result."""
+    return _all_reduce_fn(comm, op == "average")(_as_stacked(comm, stacked))
+
+
+def hierarchical_all_reduce(comm: CommContext, stacked,
+                            op: str = "sum") -> jax.Array:
+    """Two-level RS -> DCN-psum -> AG reduction of rank-stacked tensors."""
+    return _hierarchical_fn(comm, op == "average")(_as_stacked(comm, stacked))
+
+
+def broadcast(comm: CommContext, stacked, root: int = 0) -> jax.Array:
+    """Every rank receives rank ``root``'s slice of the stacked array."""
+    if not 0 <= root < comm.num_ranks:
+        raise ValueError(f"root {root} out of range")
+    return _broadcast_fn(comm, root)(_as_stacked(comm, stacked))
+
+
+def push_pull_array(comm: CommContext, stacked, op: str = "average",
+                    hierarchical: Optional[bool] = None) -> jax.Array:
+    """The collective behind bps.push_pull: picks the strategy by topology."""
+    if hierarchical is None:
+        hierarchical = comm.n_dcn > 1
+    if hierarchical:
+        return hierarchical_all_reduce(comm, stacked, op)
+    return all_reduce(comm, stacked, op)

@@ -1,0 +1,134 @@
+"""Mesh bootstrap: the TPU replacement for the reference's entire L1 substrate.
+
+The reference stitches together four transports — NCCL rings inside a machine,
+POSIX shm staging, Unix-socket control signaling, and a ps-lite ZMQ/RDMA
+parameter server between machines (SURVEY.md §2.7).  On TPU all of that
+collapses into one object: a ``jax.sharding.Mesh`` with a two-level axis
+layout ``(dcn, ici)`` — ICI is the intra-slice interconnect (replacing
+NCCL + shm + sockets) and DCN is the inter-slice network (replacing ps-lite).
+XLA emits the collectives; there is no manager process, no rendezvous server,
+no staging buffer.
+
+Bootstrap parity: the reference rendezvouses through the DMLC env protocol
+(DMLC_PS_ROOT_URI/PORT, communicator.cc:60-96); multi-host JAX rendezvouses
+through ``jax.distributed.initialize`` with a coordinator address, which
+:func:`bootstrap` wires from the same env vars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.config import Config, get_config
+from ..common.logging import get_logger
+
+# Canonical axis names.  DP reduction runs over both; ICI-only and DCN-only
+# stages address one each (hierarchical path).
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: used as a jit-cache key
+class CommContext:
+    """Process-wide communication context (replaces BytePSGlobal's comm
+    singletons, reference global.h:77-125)."""
+
+    mesh: Mesh
+    n_dcn: int
+    n_ici: int
+    # Compiled collective cache; lives and dies with this context so elastic
+    # shutdown/resume cycles don't accumulate executables for dead meshes.
+    jit_cache: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_ranks(self) -> int:
+        return self.n_dcn * self.n_ici
+
+    @property
+    def dp_axes(self) -> tuple:
+        return (DCN_AXIS, ICI_AXIS)
+
+    def stacked_sharding(self, extra_dims: int = 0) -> NamedSharding:
+        """Sharding for rank-stacked arrays: axis 0 is the rank axis."""
+        return NamedSharding(
+            self.mesh, P((DCN_AXIS, ICI_AXIS), *([None] * extra_dims))
+        )
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+_comm: Optional[CommContext] = None
+_lock = threading.Lock()
+
+
+def _build_mesh(devices: Sequence, n_dcn: int) -> Mesh:
+    devs = np.asarray(devices)
+    if devs.size % n_dcn:
+        raise ValueError(
+            f"device count {devs.size} not divisible by dcn size {n_dcn}")
+    return Mesh(devs.reshape(n_dcn, devs.size // n_dcn),
+                axis_names=(DCN_AXIS, ICI_AXIS))
+
+
+def bootstrap(cfg: Optional[Config] = None,
+              devices: Optional[List] = None) -> CommContext:
+    """Initialize (or return) the process-wide CommContext.
+
+    - multi-host: calls ``jax.distributed.initialize`` with the coordinator
+      address derived from DMLC_PS_ROOT_URI/PORT (reference bootstrap protocol,
+      docs/env.md:7-45), then lays hosts out along the DCN axis.
+    - single-host: all local devices on the ICI axis; BYTEPS_DCN_SIZE can
+      force a two-level layout for testing the hierarchical path on a flat
+      device set.
+    """
+    global _comm
+    with _lock:
+        if _comm is not None:
+            return _comm
+        cfg = cfg or get_config()
+        # Multi-host decision comes from config alone: touching
+        # jax.process_count() here would initialize the local backend and
+        # make the subsequent distributed initialize fail.
+        if cfg.num_hosts > 1 and not jax.distributed.is_initialized():
+            if cfg.coordinator_address is None:
+                raise RuntimeError(
+                    "multi-host run needs DMLC_PS_ROOT_URI/PORT (coordinator)")
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator_address,
+                num_processes=cfg.num_hosts,
+                process_id=cfg.host_id,
+            )
+        if devices is None:
+            devices = jax.devices()
+        n_dcn = int(os.environ.get("BYTEPS_DCN_SIZE", "0")) or (
+            jax.process_count() if jax.process_count() > 1 else 1)
+        _comm = CommContext(mesh=_build_mesh(devices, n_dcn), n_dcn=n_dcn,
+                            n_ici=len(devices) // n_dcn)
+        get_logger().info(
+            "mesh up: %d device(s) as (dcn=%d, ici=%d)",
+            len(devices), _comm.n_dcn, _comm.n_ici)
+        return _comm
+
+
+def get_comm() -> CommContext:
+    if _comm is None:
+        raise RuntimeError("byteps_tpu not initialized — call bps.init()")
+    return _comm
+
+
+def comm_initialized() -> bool:
+    return _comm is not None
+
+
+def shutdown_comm() -> None:
+    global _comm
+    with _lock:
+        _comm = None

@@ -1,0 +1,146 @@
+"""Typed configuration for the TPU-native BytePS rebuild.
+
+The reference configures itself through ~30 ad-hoc environment variables read
+with ``getenv`` at init time (reference ``docs/env.md``, ``common/global.cc``).
+Here they are centralized into one typed, testable config object.  Environment
+variable names are kept BYTEPS_*-compatible so launcher scripts written for the
+reference keep working where the knob still makes sense on TPU.
+
+Reference parity map (reference file:line):
+  - BYTEPS_PARTITION_BYTES        global.cc:42,134-144  -> partition_bytes
+  - BYTEPS_SCHEDULING_CREDIT      scheduled_queue.cc:35 -> scheduling_credit
+  - BYTEPS_MIN_COMPRESS_BYTES     global.cc:43,137-139  -> min_compress_bytes
+  - BYTEPS_LOG_LEVEL              logging.cc            -> log_level
+  - BYTEPS_TRACE_ON/START/END/DIR global.cc:113-124     -> trace_*
+  - BYTEPS_TELEMETRY_ON           global.cc:697-752     -> telemetry_on
+  - BYTEPS_ENABLE_ASYNC           server.cc:417-419     -> enable_async
+  - BYTEPS_FORCE_DISTRIBUTED     global.cc              -> force_distributed
+  - DMLC_NUM_WORKER / DMLC_WORKER_ID (docs/env.md:11-17) -> num_hosts / host_id
+  - BYTEPS_LOCAL_RANK/LOCAL_SIZE  launch.py:180-206     -> local_rank/local_size
+
+Knobs that only exist because of the reference's CPU/GPU/NIC split (PCIe switch
+size, NCCL rings, NUMA pinning, server engine threads, shm paths) have no TPU
+meaning and are intentionally absent; unknown BYTEPS_* vars are ignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}")
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+# Page size used for alignment of partition bounds; the reference aligns
+# partition bounds to its Align() rule (common.h:281-285).  On TPU we align to
+# 512 lanes * 4 bytes so chunk boundaries respect (8,128) tiling of f32.
+ALIGN_BYTES = 4096
+
+
+@dataclasses.dataclass
+class Config:
+    """Process-wide configuration, normally built once via :func:`get_config`."""
+
+    # --- topology / bootstrap (DMLC-compatible names) ---
+    num_hosts: int = 1              # DMLC_NUM_WORKER
+    host_id: int = 0                # DMLC_WORKER_ID
+    local_rank: int = 0             # BYTEPS_LOCAL_RANK (one proc per host on TPU)
+    local_size: int = 1             # BYTEPS_LOCAL_SIZE
+    coordinator_address: Optional[str] = None  # DMLC_PS_ROOT_URI:PORT equivalent
+    force_distributed: bool = False  # BYTEPS_FORCE_DISTRIBUTED
+
+    # --- partitioning / scheduling ---
+    partition_bytes: int = 4096000   # BYTEPS_PARTITION_BYTES (default as reference)
+    scheduling_credit: int = 0       # BYTEPS_SCHEDULING_CREDIT; 0 = unlimited window
+    enable_priority: bool = True     # priority ordering of chunk dispatch
+
+    # --- compression ---
+    min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES
+
+    # --- modes ---
+    enable_async: bool = False       # BYTEPS_ENABLE_ASYNC (async-PS weight deltas)
+
+    # --- observability ---
+    log_level: str = "WARNING"       # BYTEPS_LOG_LEVEL
+    trace_on: bool = False           # BYTEPS_TRACE_ON
+    trace_start_step: int = 10       # BYTEPS_TRACE_START_STEP
+    trace_end_step: int = 20         # BYTEPS_TRACE_END_STEP
+    trace_dir: str = "."             # BYTEPS_TRACE_DIR
+    telemetry_on: bool = True        # BYTEPS_TELEMETRY_ON
+
+    def __post_init__(self):
+        if self.partition_bytes <= 0:
+            raise ValueError("partition_bytes must be positive")
+        # Round partition bound up to alignment so chunk boundaries stay tiled.
+        r = self.partition_bytes % ALIGN_BYTES
+        if r and self.partition_bytes < 2**31 - ALIGN_BYTES:
+            self.partition_bytes += ALIGN_BYTES - r
+        if self.num_hosts < 1:
+            raise ValueError("num_hosts must be >= 1")
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT")
+        coord = f"{uri}:{port}" if uri and port else None
+        return cls(
+            num_hosts=_env_int("DMLC_NUM_WORKER", 1),
+            host_id=_env_int("DMLC_WORKER_ID", 0),
+            local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
+            local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+            coordinator_address=coord,
+            force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED", False),
+            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
+            scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+            enable_priority=_env_bool("BYTEPS_ENABLE_PRIORITY", True),
+            min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            enable_async=_env_bool("BYTEPS_ENABLE_ASYNC", False),
+            log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
+            trace_on=_env_bool("BYTEPS_TRACE_ON", False),
+            trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
+            trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
+            trace_dir=_env_str("BYTEPS_TRACE_DIR", "."),
+            telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
+        )
+
+
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    """Return the process-wide config, building it from env on first use."""
+    global _config
+    if _config is None:
+        _config = Config.from_env()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    """Install an explicit config (tests, embedding applications)."""
+    global _config
+    _config = cfg
+
+
+def reset_config() -> None:
+    global _config
+    _config = None

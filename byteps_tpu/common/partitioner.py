@@ -1,0 +1,57 @@
+"""Tensor partitioning: split a flat tensor into independently scheduled chunks.
+
+Reference behavior (operations.cc:140-180 PartitionTensor; global.cc:134-144
+partition bound): every tensor larger than BYTEPS_PARTITION_BYTES is split
+into byte-bounded chunks, each with its own 64-bit key, scheduled and routed
+independently.  That is what enables pipelining (later chunks overlap earlier
+ones) and load balance.
+
+TPU adaptation: chunk boundaries are aligned to a multiple of 512 elements so
+every chunk maps cleanly onto the (8, 128) f32 / (16, 128) bf16 vreg tiling
+and reduce-scatter shard sizes stay tile-friendly after the engine pads to
+the mesh size.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+# Chunk boundaries land on multiples of this many elements (8 sublanes * 128
+# lanes * 0.5, i.e. one bf16 tile is 16*128; 512 divides into both tilings).
+ALIGN_ELEMS = 512
+
+
+def chunk_bounds(num_elems: int, itemsize: int, partition_bytes: int
+                 ) -> List[Tuple[int, int]]:
+    """Return [(offset_elems, length_elems)] covering [0, num_elems).
+
+    Chunks are at most ``partition_bytes`` big; all but the last are aligned
+    to ALIGN_ELEMS elements.  A tensor at or under the bound is one chunk
+    (the common case — the default bound is 4 MB and most layers are smaller).
+    """
+    if num_elems <= 0:
+        return [(0, 0)] if num_elems == 0 else []
+    max_elems = max(1, partition_bytes // itemsize)
+    if num_elems <= max_elems:
+        return [(0, num_elems)]
+    # Align the per-chunk element count down so boundaries stay tiled.
+    if max_elems > ALIGN_ELEMS:
+        max_elems -= max_elems % ALIGN_ELEMS
+    bounds = []
+    off = 0
+    while off < num_elems:
+        ln = min(max_elems, num_elems - off)
+        bounds.append((off, ln))
+        off += ln
+    return bounds
+
+
+def num_chunks(num_elems: int, itemsize: int, partition_bytes: int) -> int:
+    return len(chunk_bounds(num_elems, itemsize, partition_bytes))
+
+
+def flatten_array(arr) -> np.ndarray:
+    """View an array as flat 1-D without copying when possible."""
+    return arr.reshape(-1)

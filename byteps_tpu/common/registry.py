@@ -1,0 +1,110 @@
+"""Tensor registry: name -> context, declaration-order key assignment.
+
+Reference behavior: frameworks call ``declare_tensor(name)`` once per tensor
+in a fixed order on every rank; the core assigns a monotonically increasing
+``declared_key`` and later carves the 64-bit key space as declared_key<<16 |
+partition (reference operations.cc:302-318, global.cc tensor name->context
+registry).  Declaration order doubles as the priority source: the first
+declared tensor (closest to the model output, needed last in the next
+forward) gets priority 0, the next -1, etc. — frameworks pass
+``priority = -declared_key`` (reference tensorflow/ops.cc:158).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import get_config
+from .logging import get_logger
+from .partitioner import chunk_bounds
+from .types import TensorContext, make_key
+
+
+class TensorRegistry:
+    """Process-wide tensor table (reference BytePSGlobal registry, global.cc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_name: Dict[str, TensorContext] = {}
+        self._next_key = 0
+
+    def declare(self, name: str) -> TensorContext:
+        """Idempotently declare a tensor; returns its context.
+
+        Mirrors common::IsTensorDeclared + key assignment
+        (reference operations.cc:283-318).
+        """
+        with self._lock:
+            ctx = self._by_name.get(name)
+            if ctx is None:
+                ctx = TensorContext(name=name, declared_key=self._next_key)
+                self._next_key += 1
+                self._by_name[name] = ctx
+                get_logger().debug(
+                    "declared tensor %s -> key %d", name, ctx.declared_key
+                )
+            return ctx
+
+    def init_tensor(self, name: str, shape, dtype,
+                    compression_kwargs: Optional[Dict[str, str]] = None
+                    ) -> TensorContext:
+        """First-call initialization: record shape/dtype, carve chunk keys.
+
+        Reference InitTensor (operations.cc:283-414) additionally allocates
+        shm staging buffers and does a blocking init-push to servers as a
+        barrier; on TPU there is no staging area and the mesh is the barrier,
+        so initialization is pure bookkeeping (+ compressor instantiation,
+        done lazily by the engine to avoid an import cycle).
+        """
+        ctx = self.declare(name)
+        with ctx.lock:
+            np_dtype = np.dtype(dtype)
+            if ctx.initialized:
+                # The reference CHECKs tensor size on re-entry
+                # (operations.cc InitTensor); a name reused with different
+                # geometry would otherwise reduce with stale chunk bounds.
+                if ctx.shape != tuple(shape) or ctx.dtype_name != np_dtype.name:
+                    raise ValueError(
+                        f"tensor {name!r} re-initialized with "
+                        f"{tuple(shape)}/{np_dtype.name}, previously "
+                        f"{ctx.shape}/{ctx.dtype_name}")
+                return ctx
+            cfg = get_config()
+            num_elems = int(np.prod(shape)) if len(tuple(shape)) else 1
+            bounds = chunk_bounds(num_elems, np_dtype.itemsize,
+                                  cfg.partition_bytes)
+            ctx.shape = tuple(shape)
+            ctx.dtype_name = np_dtype.name
+            ctx.num_elems = num_elems
+            ctx.nbytes = num_elems * np_dtype.itemsize
+            ctx.chunk_bounds = bounds
+            ctx.key_list = [make_key(ctx.declared_key, i)
+                            for i in range(len(bounds))]
+            ctx.compression_kwargs = dict(compression_kwargs or {})
+            ctx.initialized = True
+            get_logger().debug(
+                "init tensor %s: %d elems, %d chunk(s)", name, num_elems,
+                len(bounds)
+            )
+        return ctx
+
+    def get(self, name: str) -> Optional[TensorContext]:
+        with self._lock:
+            return self._by_name.get(name)
+
+    def names_in_declaration_order(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_name,
+                          key=lambda n: self._by_name[n].declared_key)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_name.clear()
+            self._next_key = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_name)

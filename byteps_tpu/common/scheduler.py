@@ -1,0 +1,94 @@
+"""Priority + credit-based chunk scheduler.
+
+Reference behavior (scheduled_queue.cc): one priority queue per pipeline
+stage; ``addTask`` keeps tasks sorted by (priority desc, key asc)
+(scheduled_queue.cc:82-102), ``getTask`` enforces a credit window — a
+byte-budget of in-flight work (BYTEPS_SCHEDULING_CREDIT,
+scheduled_queue.cc:33-45,136-150) — and ``reportFinish`` returns credits
+(scheduled_queue.cc:197-203).
+
+TPU adaptation: XLA executes collectives in dispatch order on a chip, so the
+only reliable priority knob is the order in which chunk programs are
+dispatched from the host (SURVEY.md §7 "hard parts").  This scheduler is that
+knob: the engine feeds every chunk task in, and pulls them back out in
+priority order, bounded by the credit window so a giant low-priority tensor
+cannot monopolize the dispatch queue ahead of later high-priority gradients.
+A single queue suffices (stages inside one chunk run inside one fused XLA
+program); the reference needed one queue per stage because its stages were
+separate hardware domains.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import List, Optional
+
+from .types import ChunkTask
+
+
+class ChunkScheduler:
+    """Thread-safe priority queue with a bytes-in-flight credit window."""
+
+    def __init__(self, credit_bytes: int = 0):
+        # credit_bytes == 0 means unlimited (reference: credit disabled
+        # unless BYTEPS_SCHEDULING_CREDIT is set).
+        self._credit_limit = credit_bytes
+        self._in_flight = 0
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._cv = threading.Condition()
+
+    # -- producer side -----------------------------------------------------
+    def add_task(self, task: ChunkTask) -> None:
+        with self._cv:
+            heapq.heappush(self._heap, (task.sort_tuple(), self._seq, task))
+            self._seq += 1
+            self._cv.notify()
+
+    # -- consumer side -----------------------------------------------------
+    def _eligible_locked(self) -> bool:
+        if not self._heap:
+            return False
+        if self._credit_limit <= 0:
+            return True
+        task = self._heap[0][2]
+        # Always allow at least one task in flight even if it alone exceeds
+        # the window, matching the reference's clamp of oversized partitions.
+        return self._in_flight == 0 or \
+            self._in_flight + task.nbytes <= self._credit_limit
+
+    def get_task(self, block: bool = False,
+                 timeout: Optional[float] = None) -> Optional[ChunkTask]:
+        """Pop the highest-priority task if the credit window allows it."""
+        with self._cv:
+            if block:
+                self._cv.wait_for(self._eligible_locked, timeout=timeout)
+            if not self._eligible_locked():
+                return None
+            _, _, task = heapq.heappop(self._heap)
+            self._in_flight += task.nbytes
+            return task
+
+    def report_finish(self, nbytes: int) -> None:
+        with self._cv:
+            self._in_flight = max(0, self._in_flight - nbytes)
+            self._cv.notify()
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._heap)
+
+    @property
+    def bytes_in_flight(self) -> int:
+        with self._cv:
+            return self._in_flight
+
+    def drain(self) -> List[ChunkTask]:
+        """Pop everything regardless of credit (shutdown path)."""
+        with self._cv:
+            tasks = [t for _, _, t in sorted(self._heap)]
+            self._heap.clear()
+            return tasks

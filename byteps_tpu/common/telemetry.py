@@ -1,0 +1,45 @@
+"""PushPull speed telemetry.
+
+Reference: a rolling MB/s gauge updated every 10s, surfaced as
+``bps.get_pushpull_speed()`` (reference global.cc:697-752,
+common/__init__.py:130-139); off switch BYTEPS_TELEMETRY_ON.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Tuple
+
+
+class SpeedMonitor:
+    def __init__(self, window_sec: float = 10.0, history: int = 60):
+        self._window = window_sec
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self._t0 = time.monotonic()
+        self._records = collections.deque(maxlen=history)
+
+    def record(self, nbytes: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._bytes += nbytes
+            dt = now - self._t0
+            if dt >= self._window:
+                self._records.append((now, self._bytes / dt / 2**20))
+                self._bytes = 0
+                self._t0 = now
+
+    def speed(self) -> Tuple[float, float]:
+        """(unix-ish timestamp, MB/s) of the latest closed window, else the
+        live partial window."""
+        with self._lock:
+            if self._records:
+                return self._records[-1]
+            dt = time.monotonic() - self._t0
+            return (time.monotonic(), self._bytes / dt / 2**20 if dt > 0 else 0.0)
+
+    def total_windows(self) -> int:
+        with self._lock:
+            return len(self._records)

@@ -1,0 +1,168 @@
+"""Core types for the TPU-native push_pull engine.
+
+The reference defines its unit-of-work and per-tensor state in
+``byteps/common/common.h`` (TensorTableEntry common.h:221-264, BPSContext
+common.h:177-205, QueueType common.h:88-102).  This module is the TPU-native
+equivalent: the 12 GPU/NIC pipeline stages collapse to the stages that exist
+on a TPU mesh (compress -> reduce-scatter -> cross-slice exchange ->
+all-gather -> decompress), tensors are JAX arrays, and readiness is JAX async
+dispatch rather than CUDA events.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class StatusCode(enum.Enum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclasses.dataclass
+class Status:
+    """Mirrors the reference's Status (common.h); used by handle polling."""
+
+    code: StatusCode = StatusCode.OK
+    reason: str = ""
+
+    @classmethod
+    def ok(cls) -> "Status":
+        return cls(StatusCode.OK)
+
+    @classmethod
+    def in_progress(cls) -> "Status":
+        return cls(StatusCode.IN_PROGRESS)
+
+    @classmethod
+    def error(cls, reason: str) -> "Status":
+        return cls(StatusCode.UNKNOWN_ERROR, reason)
+
+    def ok_or_raise(self) -> None:
+        if self.code not in (StatusCode.OK, StatusCode.IN_PROGRESS):
+            raise RuntimeError(f"byteps_tpu: {self.code.name}: {self.reason}")
+
+
+class Stage(enum.Enum):
+    """Pipeline stages of a push_pull task on TPU.
+
+    The reference's 12 QueueTypes (COORDINATE_REDUCE, REDUCE, COPYD2H,
+    PCIE_REDUCE, COMPRESS, PUSH, PULL, DECOMPRESS, COPYH2D,
+    COORDINATE_BROADCAST, BROADCAST, COORDINATE_PUSH; common.h:88-102) exist
+    because GPUs, host memory, NICs and the PS server are distinct domains.
+    On a TPU mesh the data plane is one XLA program over ICI/DCN, so the
+    stages that survive are the logical ones; they are kept as an explicit
+    enum because the scheduler, tracer and tests all speak in stages.
+    """
+
+    PARTITION = 0       # split tensor into chunks (reference: PartitionTensor)
+    COMPRESS = 1        # worker-side compressor    (reference: COMPRESS queue)
+    REDUCE_SCATTER = 2  # intra-slice ICI RS        (reference: REDUCE/NCCL RS)
+    CROSS_REDUCE = 3    # inter-slice DCN exchange  (reference: PUSH+server+PULL)
+    ALL_GATHER = 4      # intra-slice ICI AG        (reference: BROADCAST/NCCL AG)
+    DECOMPRESS = 5      # worker-side decompressor  (reference: DECOMPRESS queue)
+    CALLBACK = 6        # fire user callback        (reference: FinishOrProceed)
+
+
+class DeviceKind(enum.Enum):
+    TPU = "tpu"
+    CPU = "cpu"
+    GPU = "gpu"
+
+
+# DataType parity with the reference's enum (common.h:41-55), expressed as a
+# name->jnp dtype mapping.  bfloat16 is first-class on TPU (the reference only
+# knows IEEE fp16, common.h + half.h).
+DATA_TYPES: Dict[str, Any] = {
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+
+def dtype_name(dtype) -> str:
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    if name not in DATA_TYPES:
+        raise TypeError(f"unsupported dtype for push_pull: {name}")
+    return name
+
+
+MAX_PARTS_PER_TENSOR = 1 << 16
+
+
+def make_key(declared_key: int, part_index: int) -> int:
+    """64-bit chunk key: declared_key<<16 | part (reference operations.cc:302-311)."""
+    if not 0 <= part_index < MAX_PARTS_PER_TENSOR:
+        raise ValueError(f"part_index out of range: {part_index}")
+    return (declared_key << 16) | part_index
+
+
+def split_key(key: int) -> tuple:
+    return key >> 16, key & (MAX_PARTS_PER_TENSOR - 1)
+
+
+@dataclasses.dataclass
+class ChunkTask:
+    """One schedulable unit of communication: a single partition of a tensor.
+
+    TPU-native analog of the reference's TensorTableEntry (common.h:221-264):
+    same identity fields (name/key/priority/version/offset/len), but the
+    payload is a JAX array chunk and completion is an async-dispatch future
+    rather than a CUDA ready-event + queue_list walk.
+    """
+
+    name: str
+    key: int                      # make_key(declared, part)
+    priority: int
+    version: int
+    offset_elems: int             # offset into the flat tensor, in elements
+    num_elems: int                # chunk length in elements
+    nbytes: int                   # chunk size in bytes (credit accounting)
+    total_parts: int
+    # Filled by the engine as the task moves through stages:
+    data: Any = None              # jax.Array chunk (input, then output)
+    stage: Stage = Stage.PARTITION
+    callback: Optional[Callable[[Status], None]] = None
+
+    # Sort order matches the reference's addTask comparator: priority desc,
+    # then key asc (scheduled_queue.cc:82-102).
+    def sort_tuple(self):
+        return (-self.priority, self.key)
+
+
+@dataclasses.dataclass
+class TensorContext:
+    """Per-declared-tensor state (reference BPSContext, common.h:177-205)."""
+
+    name: str
+    declared_key: int
+    initialized: bool = False
+    shape: Optional[tuple] = None
+    dtype_name: Optional[str] = None
+    num_elems: int = 0
+    nbytes: int = 0
+    # chunk boundaries in elements: list of (offset, length)
+    chunk_bounds: List[tuple] = dataclasses.field(default_factory=list)
+    key_list: List[int] = dataclasses.field(default_factory=list)
+    # compression (kwargs dict as the reference passes per-tensor, e.g.
+    # {"compressor": "onebit", "ef": "vanilla", ...})
+    compression_kwargs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    compressor: Any = None
+    # profiling
+    version: int = 0
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)

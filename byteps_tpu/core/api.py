@@ -1,0 +1,147 @@
+"""Top-level BytePS-style API: init/shutdown/rank/size/push_pull/....
+
+Mirrors the reference's BytePSBasics ctypes surface
+(reference byteps/common/__init__.py:52-139) plus suspend/resume
+(operations.cc:96-119).  Rank semantics on TPU: JAX is a single-controller
+model, so within one process every local device is a "rank"; ``rank()``
+returns the first global rank owned by this process and ``size()`` the total
+device count across hosts — matching how the reference numbers GPUs across
+machines.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ..comm import mesh as mesh_mod
+from ..common.config import Config, get_config, set_config
+from ..common.handles import Handle
+from ..common.logging import get_logger
+from .engine import PushPullEngine
+
+_engine: Optional[PushPullEngine] = None
+_lock = threading.Lock()
+# Tensors declared before/with init, re-declared in order on resume
+# (reference global.cc:431-436 re-declares in original order on re-init).
+_declared_order: List[str] = []
+
+
+def init(config: Optional[Config] = None,
+         devices: Optional[list] = None) -> None:
+    """Initialize byteps_tpu: mesh bootstrap + engine start.
+
+    Reference: byteps_init() (operations.cc:36-88) — spawns the background
+    stage loops; here it builds the (dcn, ici) mesh and starts the
+    dispatcher/syncer pair.
+    """
+    global _engine
+    with _lock:
+        if _engine is not None:
+            return
+        if config is not None:
+            set_config(config)
+        cfg = get_config()
+        comm = mesh_mod.bootstrap(cfg, devices=devices)
+        _engine = PushPullEngine(comm, cfg)
+        for name in _declared_order:
+            _engine.registry.declare(name)
+        get_logger().info("byteps_tpu initialized: %d ranks", comm.num_ranks)
+
+
+def initialized() -> bool:
+    return _engine is not None
+
+
+def shutdown(wait: bool = True) -> None:
+    """Tear down engine + mesh (reference byteps_shutdown)."""
+    global _engine
+    with _lock:
+        if _engine is None:
+            return
+        _engine.shutdown(wait=wait)
+        _engine = None
+        mesh_mod.shutdown_comm()
+
+
+def suspend() -> None:
+    """Elastic-training pause: drain and stop (reference byteps_suspend,
+    operations.cc:96-105).  Declared tensor order is retained so resume()
+    reproduces identical key assignment."""
+    global _declared_order
+    eng = _require()
+    _declared_order = eng.registry.names_in_declaration_order()
+    shutdown(wait=True)
+
+
+def resume(config: Optional[Config] = None,
+           devices: Optional[list] = None) -> None:
+    """Elastic-training resume: re-init with possibly different topology
+    (reference byteps_resume, operations.cc:107-119); tensors are re-declared
+    in their original order."""
+    init(config=config, devices=devices)
+
+
+def _require() -> PushPullEngine:
+    if _engine is None:
+        raise RuntimeError("byteps_tpu not initialized — call bps.init()")
+    return _engine
+
+
+def size() -> int:
+    return _require().comm.num_ranks
+
+
+def rank() -> int:
+    return jax.process_index() * local_size()
+
+
+def local_size() -> int:
+    c = _require().comm
+    return c.num_ranks // jax.process_count()
+
+
+def local_rank() -> int:
+    return 0  # one controller process per host owns all local chips
+
+
+def declare(name: str) -> int:
+    """Pre-declare a tensor; returns its declared key.  Usable before init
+    (reference declare_tensor can run before byteps_lazy_init completes)."""
+    if _engine is not None:
+        return _engine.registry.declare(name).declared_key
+    if name not in _declared_order:
+        _declared_order.append(name)
+    return _declared_order.index(name)
+
+
+def push_pull(stacked, name: str, op: str = "average",
+              priority: Optional[int] = None,
+              compression: Optional[Dict[str, str]] = None) -> Any:
+    """Synchronous sum/average of rank-stacked tensors (Horovod allreduce)."""
+    return _require().push_pull(stacked, name, op=op, priority=priority,
+                                compression=compression)
+
+
+def push_pull_async(stacked, name: str, op: str = "average",
+                    priority: Optional[int] = None,
+                    compression: Optional[Dict[str, str]] = None) -> Handle:
+    return _require().push_pull_async(stacked, name, op=op, priority=priority,
+                                      compression=compression)
+
+
+def poll(handle: Handle) -> bool:
+    return handle.poll()
+
+
+def synchronize(handle: Handle, timeout: Optional[float] = None) -> Any:
+    out = handle.wait(timeout=timeout)
+    _require().handles.release(handle.id)
+    return out
+
+
+def get_pushpull_speed() -> tuple:
+    """(timestamp, MB/s) telemetry (reference byteps_get_pushpull_speed)."""
+    return _require().speed.speed()

@@ -1,0 +1,220 @@
+"""The push_pull engine: partition -> schedule -> chunked collective -> callback.
+
+This is the TPU-native collapse of the reference's core runtime
+(operations.cc EnqueueTensor + scheduled_queue.cc + core_loops.cc).  The
+reference runs ~15 dedicated stage threads because its pipeline crosses five
+hardware domains (GPU, PCIe, host memory, NIC, remote server).  On TPU one
+chunk's whole reduction is a single fused XLA program over the mesh, so two
+threads suffice:
+
+- the **dispatcher** pops chunk tasks from the priority scheduler (credit
+  window permitting) and launches the chunk collective — JAX async dispatch
+  returns immediately, so dispatch order from this thread IS the priority
+  mechanism (SURVEY.md §7 "priority scheduling under XLA");
+- the **syncer** blocks on issued chunks in order, returns scheduling
+  credits, and fires the tensor callback when its last partition lands —
+  the role the reference's SyncNcclLoop + FinishOrProceed play
+  (core_loops.cc:31-137,362-376).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm.collectives import push_pull_array
+from ..comm.mesh import CommContext
+from ..common.config import Config
+from ..common.handles import Handle, HandleManager
+from ..common.logging import get_logger
+from ..common.registry import TensorRegistry
+from ..common.scheduler import ChunkScheduler
+from ..common.telemetry import SpeedMonitor
+from ..common.types import ChunkTask, Status, TensorContext
+
+
+class _PendingTensor:
+    """Accumulates finished chunks of one push_pull until all arrive."""
+
+    def __init__(self, handle: Handle, ctx: TensorContext, out_shape, op: str,
+                 total_ranks: int):
+        self.handle = handle
+        self.ctx = ctx
+        self.out_shape = out_shape
+        self.op = op
+        self.total_ranks = total_ranks
+        self.parts: Dict[int, Any] = {}
+        self.total = len(ctx.chunk_bounds)
+        self.lock = threading.Lock()
+
+    def complete_part(self, part_idx: int, data) -> bool:
+        with self.lock:
+            self.parts[part_idx] = data
+            return len(self.parts) == self.total
+
+    def assemble(self):
+        if self.total == 1:
+            flat = self.parts[0]
+        else:
+            flat = jnp.concatenate([self.parts[i] for i in range(self.total)])
+        out = flat.reshape(self.out_shape)
+        if self.op == "average":
+            # The reference divides by size in the done-callback
+            # (torch/ops.cc StartTask callback; torch/__init__.py).
+            if jnp.issubdtype(out.dtype, jnp.inexact):
+                out = out / self.total_ranks
+            else:
+                out = out // self.total_ranks
+        return out
+
+
+class PushPullEngine:
+    """Process-wide engine; one per bps.init() (reference BytePSGlobal)."""
+
+    def __init__(self, comm: CommContext, cfg: Config):
+        self.comm = comm
+        self.cfg = cfg
+        self.registry = TensorRegistry()
+        self.handles = HandleManager()
+        self.scheduler = ChunkScheduler(credit_bytes=cfg.scheduling_credit)
+        self.speed = SpeedMonitor()
+        self._sync_q: "queue.Queue" = queue.Queue()
+        self._running = True
+        self._compressor_cache: Dict[str, Any] = {}
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="bps-dispatch", daemon=True)
+        self._syncer = threading.Thread(
+            target=self._sync_loop, name="bps-sync", daemon=True)
+        self._dispatcher.start()
+        self._syncer.start()
+
+    # ------------------------------------------------------------------ API
+    def push_pull_async(self, stacked, name: str,
+                        priority: Optional[int] = None,
+                        op: str = "average",
+                        compression: Optional[Dict[str, str]] = None,
+                        ) -> Handle:
+        """Enqueue a rank-stacked tensor [R, ...] for reduction.
+
+        Equivalent of common::EnqueueTensor (reference operations.cc:182-281):
+        splits into partitions, each an independently scheduled ChunkTask;
+        the returned handle completes when every partition's collective has
+        executed and the result is reassembled.
+        """
+        if not self._running:
+            raise RuntimeError("engine is shut down")
+        if compression:
+            # The compression engine (byteps_tpu.compression) wires in via
+            # compressed hierarchical collectives; until that lands,
+            # refusing is better than silently sending uncompressed.
+            raise NotImplementedError(
+                "per-tensor compression is not wired into the engine yet")
+        r = stacked.shape[0]
+        if r != self.comm.num_ranks:
+            raise ValueError(
+                f"stacked rank axis {r} != mesh ranks {self.comm.num_ranks}")
+        out_shape = stacked.shape[1:]
+        ctx = self.registry.init_tensor(name, out_shape, stacked.dtype,
+                                        compression_kwargs=compression)
+        if priority is None:
+            prio = -ctx.declared_key if self.cfg.enable_priority else 0
+        else:
+            prio = priority
+        handle = self.handles.allocate(name)
+        pending = _PendingTensor(handle, ctx, out_shape, op,
+                                 self.comm.num_ranks)
+        with ctx.lock:
+            ctx.version += 1
+            version = ctx.version
+
+        flat = stacked.reshape(r, -1)
+        itemsize = np.dtype(stacked.dtype).itemsize
+        nchunks = len(ctx.chunk_bounds)
+        for part_idx, (off, ln) in enumerate(ctx.chunk_bounds):
+            chunk = flat if nchunks == 1 else flat[:, off:off + ln]
+            task = ChunkTask(
+                name=name, key=ctx.key_list[part_idx], priority=prio,
+                version=version, offset_elems=off, num_elems=ln,
+                nbytes=ln * itemsize, total_parts=nchunks,
+                data=chunk,
+            )
+            task.callback = self._make_chunk_callback(pending, part_idx)
+            self.scheduler.add_task(task)
+        # Auto-release on completion: the manager tracks only outstanding
+        # work, so direct handle.wait() users don't leak table entries.
+        handle.add_done_callback(lambda h: self.handles.release(h.id))
+        return handle
+
+    def _make_chunk_callback(self, pending: _PendingTensor, part_idx: int):
+        def cb(data, status: Status):
+            if status.code.name != "OK":
+                pending.handle.set_result(None, status)
+                return
+            if pending.complete_part(part_idx, data):
+                try:
+                    pending.handle.set_result(pending.assemble(), Status.ok())
+                except Exception as e:  # noqa: BLE001
+                    pending.handle.set_result(None, Status.error(str(e)))
+        return cb
+
+    # ---------------------------------------------------------- loops
+    def _dispatch_loop(self):
+        while self._running:
+            task = self.scheduler.get_task(block=True, timeout=0.05)
+            if task is None:
+                continue
+            try:
+                out = push_pull_array(self.comm, task.data, op="sum")
+                self._sync_q.put((task, out, None))
+            except Exception as e:  # noqa: BLE001
+                get_logger().error("dispatch failed for %s: %s", task.name, e)
+                self._sync_q.put((task, None, e))
+
+    def _sync_loop(self):
+        while self._running or not self._sync_q.empty():
+            try:
+                task, out, err = self._sync_q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if err is None:
+                try:
+                    jax.block_until_ready(out)
+                except Exception as e:  # noqa: BLE001
+                    err = e
+            self.scheduler.report_finish(task.nbytes)
+            if self.cfg.telemetry_on:
+                self.speed.record(task.nbytes * 2)  # push + pull bytes
+            if task.callback is not None:
+                if err is not None:
+                    task.callback(None, Status.error(str(err)))
+                else:
+                    # Average is applied at assembly granularity: the
+                    # reference divides in the done-callback too
+                    # (torch/__init__.py task callback output.div_(size)).
+                    task.callback(out, Status.ok())
+
+    # ---------------------------------------------------------- lifecycle
+    def shutdown(self, wait: bool = True):
+        if wait:
+            # drain: wait for all outstanding handles
+            for h in self.handles.outstanding():
+                try:
+                    h.wait(timeout=60)
+                except Exception:  # noqa: BLE001
+                    pass
+        self._running = False
+        self._dispatcher.join(timeout=5)
+        self._syncer.join(timeout=5)
+        self.handles.clear()
+
+    def push_pull(self, stacked, name: str, **kw):
+        """Synchronous push_pull; returns the reduced array."""
+        h = self.push_pull_async(stacked, name, **kw)
+        out = h.wait()
+        self.handles.release(h.id)
+        return out

@@ -1,0 +1,37 @@
+"""Test fixtures: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test harness shape (tests/meta_test.py in the
+reference spawns a scheduler+server and forces distributed mode on one
+machine); here the analog is XLA host-platform device virtualization —
+8 CPU "chips" stand in for a TPU slice so every collective path is exercised
+without hardware (SURVEY.md §4).
+"""
+
+import os
+
+# Must run before the first JAX backend initialization.  Note: the image's
+# sitecustomize imports jax at interpreter start, so JAX_PLATFORMS in the
+# environment is already consumed — jax.config.update is the reliable switch.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Tests force compression regardless of size, as the reference does
+# (meta_test.py:31-33 sets BYTEPS_MIN_COMPRESS_BYTES=0).
+os.environ.setdefault("BYTEPS_MIN_COMPRESS_BYTES", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config():
+    """Each test gets a config rebuilt from the current environment."""
+    from byteps_tpu.common.config import reset_config
+    reset_config()
+    yield
+    reset_config()

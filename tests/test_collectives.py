@@ -1,0 +1,111 @@
+"""Collective-layer tests on the virtual 8-device CPU mesh.
+
+Covers the op-correctness ground the reference's tests/test_mxnet.py covers
+(push_pull sums 1-3D tensors over dtypes against numpy, SURVEY.md §4), plus
+the hierarchical two-level path the reference exercises via its NCCL+PS
+pipeline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.comm import mesh as mesh_mod
+from byteps_tpu.comm.collectives import (
+    all_reduce,
+    broadcast,
+    hierarchical_all_reduce,
+    push_pull_array,
+)
+from byteps_tpu.comm.mesh import CommContext, _build_mesh
+
+
+@pytest.fixture
+def comm():
+    return CommContext(mesh=_build_mesh(jax.devices(), 1), n_dcn=1, n_ici=8)
+
+
+@pytest.fixture
+def comm2d():
+    return CommContext(mesh=_build_mesh(jax.devices(), 2), n_dcn=2, n_ici=4)
+
+
+def _stacked(shape, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return jnp.asarray(rng.randint(-100, 100, (8,) + shape).astype(dtype))
+    return jnp.asarray(rng.randn(8, *shape).astype(dtype))
+
+
+@pytest.mark.parametrize("shape", [(7,), (32, 5), (4, 3, 2)])
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32])
+def test_all_reduce_sum_matches_numpy(comm, shape, dtype):
+    x = _stacked(shape, dtype)
+    out = all_reduce(comm, x, op="sum")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_all_reduce_average(comm):
+    x = _stacked((16,))
+    out = all_reduce(comm, x, op="average")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).mean(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_all_reduce_bfloat16(comm):
+    x = jnp.ones((8, 128), dtype=jnp.bfloat16) * 0.5
+    out = all_reduce(comm, x, op="sum")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32), 4.0)
+
+
+@pytest.mark.parametrize("n", [8, 16, 17, 1000])  # incl. non-divisible sizes
+def test_hierarchical_matches_flat(comm, n):
+    x = _stacked((n,))
+    flat = all_reduce(comm, x, op="sum")
+    hier = hierarchical_all_reduce(comm, x, op="sum")
+    np.testing.assert_allclose(np.asarray(hier), np.asarray(flat), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [16, 17, 333])
+def test_hierarchical_two_level(comm2d, n):
+    # dcn=2 x ici=4: reduce-scatter inside each "slice", psum across, stitch
+    x = _stacked((n,), seed=3)
+    out = hierarchical_all_reduce(comm2d, x, op="average")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).mean(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_hierarchical_2d_tensor(comm2d):
+    x = _stacked((10, 3), seed=4)
+    out = hierarchical_all_reduce(comm2d, x, op="sum")
+    assert out.shape == (10, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(comm, root):
+    x = _stacked((9,), seed=root)
+    out = broadcast(comm, x, root=root)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x)[root])
+
+
+def test_broadcast_2d_mesh(comm2d):
+    x = _stacked((9,), seed=9)
+    out = broadcast(comm2d, x, root=5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x)[5])
+
+
+def test_push_pull_array_picks_topology(comm, comm2d):
+    x = _stacked((33,), seed=5)
+    for c in (comm, comm2d):
+        out = push_pull_array(c, x, op="sum")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_wrong_rank_axis_raises(comm):
+    with pytest.raises(ValueError):
+        all_reduce(comm, jnp.ones((4, 3)), op="sum")

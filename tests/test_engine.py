@@ -1,0 +1,120 @@
+"""End-to-end engine tests: the full partition -> schedule -> collective ->
+callback path (reference call stack §3.2, collapsed to TPU stages)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.common import Config
+from byteps_tpu.common.config import set_config
+
+
+@pytest.fixture
+def bps_session():
+    bps.init()
+    yield bps
+    bps.shutdown()
+
+
+@pytest.fixture
+def bps_chunked():
+    # Tiny partition bound -> every tensor over 4096 B gets multiple chunks,
+    # exercising partitioning + reassembly (reference BYTEPS_PARTITION_BYTES).
+    set_config(Config(partition_bytes=4096))
+    bps.init()
+    yield bps
+    bps.shutdown()
+
+
+def test_basics(bps_session):
+    assert bps.size() == 8
+    assert bps.rank() == 0
+    assert bps.local_size() == 8
+    assert bps.local_rank() == 0
+
+
+def test_push_pull_sum_and_average(bps_session):
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 13, 3).astype(np.float32))
+    s = bps.push_pull(x, "grad/w", op="sum")
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x).sum(0), rtol=1e-5)
+    a = bps.push_pull(x, "grad/w", op="average")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(x).mean(0), rtol=1e-5)
+
+
+def test_push_pull_async_many(bps_session):
+    rng = np.random.RandomState(1)
+    tensors = {f"g{i}": rng.randn(8, 50 + i).astype(np.float32)
+               for i in range(20)}
+    handles = {n: bps.push_pull_async(jnp.asarray(v), n, op="sum")
+               for n, v in tensors.items()}
+    for n, h in handles.items():
+        out = bps.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), tensors[n].sum(0),
+                                   rtol=1e-5)
+
+
+def test_partitioned_tensor_roundtrip(bps_chunked):
+    # 40_000 f32 = 160 KB -> ~40 chunks at 4 KB bound
+    x = np.random.RandomState(2).randn(8, 40_000).astype(np.float32)
+    out = bps.push_pull(jnp.asarray(x), "big", op="sum")
+    eng = bps.core.api._require()
+    ctx = eng.registry.get("big")
+    assert len(ctx.chunk_bounds) > 1  # partitioning actually happened
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+
+def test_partitioned_2d_average(bps_chunked):
+    x = np.random.RandomState(3).randn(8, 200, 30).astype(np.float32)
+    out = bps.push_pull(jnp.asarray(x), "big2", op="average")
+    np.testing.assert_allclose(np.asarray(out), x.mean(0), rtol=1e-5)
+
+
+def test_declaration_order_sets_priority(bps_session):
+    eng = bps.core.api._require()
+    bps.declare("p/first")
+    bps.declare("p/second")
+    c1 = eng.registry.get("p/first")
+    c2 = eng.registry.get("p/second")
+    assert c1.declared_key < c2.declared_key
+
+
+def test_declare_before_init():
+    bps.declare("early/a")
+    bps.declare("early/b")
+    bps.init()
+    try:
+        eng = bps.core.api._require()
+        assert eng.registry.get("early/a").declared_key == 0
+        assert eng.registry.get("early/b").declared_key == 1
+    finally:
+        bps.shutdown()
+
+
+def test_suspend_resume_preserves_keys(bps_session):
+    x = jnp.ones((8, 4), jnp.float32)
+    bps.push_pull(x, "el/a", op="sum")
+    bps.push_pull(x, "el/b", op="sum")
+    eng = bps.core.api._require()
+    key_a = eng.registry.get("el/a").declared_key
+    bps.suspend()
+    bps.resume()
+    eng2 = bps.core.api._require()
+    assert eng2.registry.get("el/a").declared_key == key_a
+    out = bps.push_pull(x, "el/a", op="sum")
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+    bps.init()  # idempotent re-init is a no-op
+
+
+def test_int_average_uses_floor_div(bps_session):
+    x = jnp.ones((8, 4), jnp.int32) * 3
+    out = bps.push_pull(x, "ints", op="average")
+    np.testing.assert_array_equal(np.asarray(out), 3)
+
+
+def test_pushpull_speed_moves(bps_session):
+    x = jnp.ones((8, 1024), jnp.float32)
+    for i in range(5):
+        bps.push_pull(x, "spd", op="sum")
+    ts, mbps = bps.get_pushpull_speed()
+    assert mbps > 0
