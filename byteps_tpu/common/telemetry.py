@@ -27,18 +27,20 @@ class SpeedMonitor:
             self._bytes += nbytes
             dt = now - self._t0
             if dt >= self._window:
-                self._records.append((now, self._bytes / dt / 2**20))
+                # wall-clock timestamp for cross-host correlation (the
+                # reference reports real timestamps for the same reason)
+                self._records.append((time.time(), self._bytes / dt / 2**20))
                 self._bytes = 0
                 self._t0 = now
 
     def speed(self) -> Tuple[float, float]:
-        """(unix-ish timestamp, MB/s) of the latest closed window, else the
-        live partial window."""
+        """(wall-clock timestamp, MB/s) of the latest closed window, else
+        the live partial window."""
         with self._lock:
             if self._records:
                 return self._records[-1]
             dt = time.monotonic() - self._t0
-            return (time.monotonic(), self._bytes / dt / 2**20 if dt > 0 else 0.0)
+            return (time.time(), self._bytes / dt / 2**20 if dt > 0 else 0.0)
 
     def total_windows(self) -> int:
         with self._lock:

@@ -137,7 +137,8 @@ class ChunkTask:
     # Filled by the engine as the task moves through stages:
     data: Any = None              # jax.Array chunk (input, then output)
     stage: Stage = Stage.PARTITION
-    callback: Optional[Callable[[Status], None]] = None
+    # invoked as callback(result_chunk_or_None, status) by the sync loop
+    callback: Optional[Callable[[Any, Status], None]] = None
 
     # Sort order matches the reference's addTask comparator: priority desc,
     # then key asc (scheduled_queue.cc:82-102).

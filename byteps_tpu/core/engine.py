@@ -38,6 +38,9 @@ from ..common.telemetry import SpeedMonitor
 from ..common.types import ChunkTask, Status, TensorContext
 
 
+_SHUTDOWN = object()  # sync-queue sentinel
+
+
 class _PendingTensor:
     """Accumulates finished chunks of one push_pull until all arrive."""
 
@@ -176,11 +179,14 @@ class PushPullEngine:
                 self._sync_q.put((task, None, e))
 
     def _sync_loop(self):
-        while self._running or not self._sync_q.empty():
-            try:
-                task, out, err = self._sync_q.get(timeout=0.05)
-            except queue.Empty:
-                continue
+        # Exits only on the sentinel, which shutdown enqueues *after* the
+        # dispatcher has joined — so a completion the dispatcher put just
+        # before stopping can never be lost to a flag/empty-queue race.
+        while True:
+            item = self._sync_q.get()
+            if item is _SHUTDOWN:
+                break
+            task, out, err = item
             if err is None:
                 try:
                     jax.block_until_ready(out)
@@ -209,6 +215,7 @@ class PushPullEngine:
                     pass
         self._running = False
         self._dispatcher.join(timeout=5)
+        self._sync_q.put(_SHUTDOWN)
         self._syncer.join(timeout=5)
         self.handles.clear()
 

@@ -1,0 +1,201 @@
+"""JAX/optax framework adapter — the flagship plugin.
+
+The TPU-native counterpart of the reference's framework plugins
+(byteps/torch, byteps/tensorflow, byteps/mxnet — SURVEY.md §2.4): a
+Horovod-style surface over the push_pull core.
+
+Two modes, mirroring the reference's two integration styles:
+
+- **engine mode** (imperative; like torch ``DistributedOptimizer`` whose
+  backward hooks enqueue per-tensor push_pulls, reference
+  torch/__init__.py:115-156): pytree leaves become named tensors, each
+  partitioned/scheduled/reduced by the background engine with priority =
+  declaration order.  Host-driven; works outside jit.
+- **fused mode** (in-graph; like the TF custom op path, reference
+  tensorflow/ops.cc): :func:`distributed_optimizer` returns a pure optax
+  ``GradientTransformation`` whose update psums gradients — call it inside
+  your shard_map/jit step and XLA fuses the collectives with the update.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core import api as _api
+from ..ops import push_pull_tree as _traced_push_pull_tree
+
+__all__ = [
+    "push_pull",
+    "push_pull_async",
+    "DistributedOptimizer",
+    "distributed_optimizer",
+    "broadcast_parameters",
+    "broadcast_optimizer_state",
+    "DistributedGradientTape",
+]
+
+
+def _leaf_names(tree, prefix: str) -> list:
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [prefix + jax.tree_util.keystr(path) for path, _ in paths]
+
+
+def push_pull_async(tree, name_prefix: str = "byteps", op: str = "average"
+                    ) -> list:
+    """Enqueue every leaf of a rank-stacked pytree; returns handles.
+
+    Each leaf must have leading axis == number of ranks (see
+    byteps_tpu.comm.collectives data model).  Leaf names derive from tree
+    paths, so declaration order — and therefore communication priority
+    (reference tensorflow/ops.cc:158 ``priority=-declared_key``) — is the
+    order leaves first appear.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    names = _leaf_names(tree, name_prefix)
+    return [_api.push_pull_async(leaf, n, op=op)
+            for n, leaf in zip(names, leaves)]
+
+
+def push_pull(tree, name_prefix: str = "byteps", op: str = "average"):
+    """Synchronously reduce a rank-stacked pytree; returns the reduced tree
+    (leaves lose their leading rank axis)."""
+    treedef = jax.tree_util.tree_structure(tree)
+    handles = push_pull_async(tree, name_prefix, op=op)
+    outs = [h.wait() for h in handles]
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+def broadcast_parameters(params, root: int = 0):
+    """Make every rank's parameters identical to ``root``'s.
+
+    Reference: broadcast_parameters zeroes non-root tensors then sum-reduces
+    (torch/__init__.py:259-291).  Input leaves may be rank-stacked
+    ([R, ...], per-rank values) or plain (replicated candidates).  Returns
+    the root's tree (no rank axis).
+    """
+    from ..comm.collectives import broadcast as _bcast
+    from ..comm.mesh import get_comm
+    comm = get_comm()
+    r = comm.num_ranks
+
+    def one(leaf):
+        leaf = jnp.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] == r:
+            stacked = leaf
+        else:
+            stacked = jnp.broadcast_to(leaf[None], (r,) + leaf.shape)
+        return _bcast(comm, stacked, root=root)
+
+    return jax.tree.map(one, params)
+
+
+def broadcast_optimizer_state(opt_state, root: int = 0):
+    """Broadcast optax optimizer state (reference broadcast_optimizer_state,
+    torch/__init__.py:292-411 — there it must walk torch state dicts; optax
+    state is already a pytree).  Non-array leaves (step counters etc.) pass
+    through untouched."""
+    def one(leaf):
+        if isinstance(leaf, (int, float, bool)):
+            return leaf
+        return broadcast_parameters(leaf, root=root)
+    return jax.tree.map(one, opt_state)
+
+
+def distributed_optimizer(tx: optax.GradientTransformation,
+                          axis_names=("dcn", "ici"),
+                          op: str = "average") -> optax.GradientTransformation:
+    """Fused-mode wrapper: an optax transformation that reduces gradients
+    across mesh axes before the inner update.  Use inside shard_map.
+
+    The in-graph analog of the reference's _DistributedOptimizer
+    ``compute_gradients`` override (tensorflow/__init__.py:186-280).
+    """
+
+    def init_fn(params):
+        return tx.init(params)
+
+    def update_fn(grads, state, params=None, **extra):
+        grads = _traced_push_pull_tree(grads, axis_names, op=op)
+        return tx.update(grads, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class DistributedOptimizer:
+    """Engine-mode optimizer wrapper (imperative, host-driven).
+
+    Mirrors the reference torch ``DistributedOptimizer``
+    (torch/__init__.py:110-214): gradients are enqueued per-leaf into the
+    background engine (partitioned, priority-scheduled, credit-limited) and
+    the optax update runs on the averaged result.  Supports
+    ``backward_passes_per_step`` gradient accumulation: micro-steps
+    accumulate locally and only the boundary step communicates
+    (reference torch/__init__.py:110-156).
+    """
+
+    def __init__(self, tx: optax.GradientTransformation,
+                 name_prefix: str = "grad",
+                 op: str = "average",
+                 backward_passes_per_step: int = 1):
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._tx = tx
+        self._prefix = name_prefix
+        self._op = op
+        self._bpps = backward_passes_per_step
+        self._accum = None
+        self._micro = 0
+        self._lock = threading.Lock()
+
+    def init(self, params):
+        return self._tx.init(params)
+
+    def update(self, grads, state, params=None):
+        """grads: rank-stacked pytree ([R, ...] leaves).
+
+        Returns (updates, new_state).  On accumulation micro-steps the
+        updates are zeros (parameters unchanged), matching the reference's
+        deferral of push_pull until the boundary pass.
+        """
+        with self._lock:
+            if self._bpps > 1:
+                self._accum = grads if self._accum is None else jax.tree.map(
+                    jnp.add, self._accum, grads)
+                self._micro += 1
+                if self._micro < self._bpps:
+                    zeros = jax.tree.map(
+                        lambda g: jnp.zeros(g.shape[1:], g.dtype), grads)
+                    return zeros, state
+                grads = self._accum
+                if self._op == "average":
+                    grads = jax.tree.map(lambda g: g / self._bpps, grads)
+                self._accum = None
+                self._micro = 0
+        reduced = push_pull(grads, self._prefix, op=self._op)
+        return self._tx.update(reduced, state, params)
+
+
+class DistributedGradientTape:
+    """API parity with the reference's TF DistributedGradientTape
+    (tensorflow/__init__.py:343-417): wraps a loss function; ``gradient``
+    computes per-rank grads (vmap over the rank axis) and push_pull-averages
+    them through the engine."""
+
+    def __init__(self, loss_fn, name_prefix: str = "tape",
+                 op: str = "average"):
+        self._grad_fn = jax.grad(loss_fn)
+        self._prefix = name_prefix
+        self._op = op
+
+    def gradient(self, params, *stacked_args):
+        """``params``: one parameter tree (shared across ranks);
+        ``stacked_args``: rank-stacked per-rank inputs ([R, ...])."""
+        grads = jax.vmap(self._grad_fn, in_axes=(None,) + (0,) * len(
+            stacked_args))(params, *stacked_args)
+        return push_pull(grads, self._prefix, op=self._op)

@@ -1,0 +1,58 @@
+"""bpslaunch: process launcher for TPU hosts.
+
+The reference launcher (launcher/launch.py:180-216) spawns one copy of the
+training command per visible GPU with BYTEPS_LOCAL_RANK injected, plus
+server/scheduler roles running the PS process.  On TPU the process model is
+one controller process per host owning all local chips, and there is no
+server or scheduler process (the mesh replaces them) — so the worker role
+execs the command once with topology env prepared, and server/scheduler
+roles are accepted-and-ignored for drop-in compatibility with reference
+launch scripts (they exit 0 with a notice).
+
+Usage:
+    bpslaunch python train.py ...
+Env (DMLC-compatible, reference docs/env.md:7-45):
+    DMLC_ROLE                worker|server|scheduler (default worker)
+    DMLC_NUM_WORKER          number of hosts (default 1)
+    DMLC_WORKER_ID           this host's index (default 0)
+    DMLC_PS_ROOT_URI/PORT    coordinator address for multi-host rendezvous
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def launch_worker(cmd: list) -> int:
+    env = dict(os.environ)
+    # One controller per host: local rank is always 0, local size is the
+    # host's chip count (resolved lazily by bps.init()).
+    env.setdefault("BYTEPS_LOCAL_RANK", "0")
+    env.setdefault("DMLC_ROLE", "worker")
+    proc = subprocess.Popen(cmd, env=env)
+    proc.wait()
+    return proc.returncode
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    role = os.environ.get("DMLC_ROLE", "worker").lower()
+    if role in ("server", "scheduler"):
+        # The reference runs `python3 -c 'import byteps.server'` here
+        # (launch.py:208-216).  On TPU the parameter-server and rendezvous
+        # scheduler do not exist as processes; accept the role so existing
+        # multi-role launch scripts keep working.
+        print(f"bpslaunch: role '{role}' is not needed on TPU "
+              "(XLA collectives replace the parameter server); exiting 0.",
+              file=sys.stderr)
+        return 0
+    if not argv:
+        print("usage: bpslaunch COMMAND [ARGS...]", file=sys.stderr)
+        return 2
+    return launch_worker(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Model zoo for examples/benchmarks, mirroring the reference's example/
+directory (PyTorch MNIST, synthetic ResNet-50, GluonNLP BERT-large —
+SURVEY.md §6 configs)."""
+
+from .mlp import MLP, mnist_mlp  # noqa: F401

@@ -1,0 +1,33 @@
+"""MNIST-class MLP — the minimum end-to-end model (SURVEY.md §7 step 4,
+standing in for the reference's example/pytorch MNIST config)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+def mnist_mlp() -> MLP:
+    return MLP(features=(128, 64, 10))
+
+
+def softmax_cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits)
+    onehot = jnp.eye(logits.shape[-1], dtype=logp.dtype)[labels]
+    return -(onehot * logp).sum(-1).mean()

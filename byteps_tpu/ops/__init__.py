@@ -1,0 +1,16 @@
+"""Traceable collective ops, usable inside jit/shard_map.
+
+These are the "fused path" counterparts of the host-driven engine: where the
+engine dispatches chunked programs from Python (priority scheduling,
+credit pipelining — reference scheduled_queue.cc semantics), these ops are
+traced into the user's own step function so XLA fuses reduction with the
+surrounding compute.  This is the mode that wins on raw throughput inside an
+ICI domain; the engine path wins when BytePS-style scheduling/overlap
+semantics across many tensors matter.
+"""
+
+from .collective_ops import (  # noqa: F401
+    push_pull_tree,
+    broadcast_tree,
+    hierarchical_push_pull,
+)

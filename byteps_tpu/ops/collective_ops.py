@@ -1,0 +1,107 @@
+"""Jit-traceable push_pull / broadcast over pytrees.
+
+Call these from *inside* a shard_map body (or any context where the mesh
+axes are bound).  They are the building blocks of the fused training step —
+the TPU-native equivalent of the reference's in-graph BytepsPushPull custom
+op (reference tensorflow/ops.cc:208-231) — and of the compressed
+cross-slice reduction (compression arrives via byteps_tpu.compression).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def _norm_axes(axis_names: AxisNames) -> Tuple[str, ...]:
+    if isinstance(axis_names, str):
+        return (axis_names,)
+    return tuple(axis_names)
+
+
+def push_pull_tree(tree, axis_names: AxisNames, op: str = "average"):
+    """Sum or average every leaf across the named mesh axes.
+
+    Horovod-style allreduce of a gradient pytree; the in-graph analog of
+    bps.push_pull (reference tensorflow/__init__.py:40-81 applies
+    compression then averages — here averaging is fused into the psum).
+    """
+    axes = _norm_axes(axis_names)
+
+    def red(g):
+        if op == "average":
+            return lax.pmean(g, axes)
+        return lax.psum(g, axes)
+
+    return jax.tree.map(red, tree)
+
+
+def broadcast_tree(tree, axis_names: AxisNames, root: int = 0):
+    """Every shard receives the root shard's leaves.
+
+    The reference implements broadcast as zero-non-root + sum push_pull
+    (torch/__init__.py:259-291); identical trick, traced.
+    """
+    axes = _norm_axes(axis_names)
+
+    def bcast(g):
+        idx = _linear_axis_index(axes)
+        mask = (idx == root).astype(g.dtype)
+        return lax.psum(g * mask, axes)
+
+    return jax.tree.map(bcast, tree)
+
+
+def _linear_axis_index(axes: Tuple[str, ...]):
+    """Global linear index across a tuple of mesh axes (row-major)."""
+    idx = lax.axis_index(axes[0])
+    for name in axes[1:]:
+        idx = idx * lax.axis_size(name) + lax.axis_index(name)
+    return idx
+
+
+def hierarchical_push_pull(x, ici_axis: str = "ici", dcn_axis: str = "dcn",
+                           op: str = "average",
+                           compress=None, decompress=None):
+    """Two-level reduction of one array with an optional compressed DCN hop.
+
+    Reproduces the reference's architecture (docs/architecture.md:14-41):
+    reduce-scatter inside the slice (NCCL RS), exchange only the 1/n_ici
+    shard across slices (push/pull to servers), all-gather inside the slice
+    (NCCL AG).  ``compress``/``decompress`` wrap the DCN hop exactly where
+    the reference's COMPRESS/DECOMPRESS pipeline stages sit
+    (operations.cc:199-204): compressed bytes cross the slow network, full
+    precision stays on ICI.
+    """
+    orig_shape = x.shape
+    orig_dtype = x.dtype
+    n_ici = lax.axis_size(ici_axis)
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_ici
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0, tiled=True)
+    if compress is not None:
+        # all_gather the compressed shards over DCN and decompress-sum:
+        # the server-side "decompress each push, sum" semantics
+        # (reference server.cc:87-113) without a server process.
+        payload = compress(shard)
+        gathered = lax.all_gather(payload, dcn_axis, axis=0)
+        n_dcn = lax.axis_size(dcn_axis)
+        shard = sum(decompress(jax.tree.map(lambda p: p[i], gathered))
+                    for i in range(n_dcn))
+        shard = shard.astype(orig_dtype)
+    else:
+        shard = lax.psum(shard, dcn_axis)
+    if op == "average":
+        total = n_ici * lax.axis_size(dcn_axis)
+        shard = (shard / total).astype(orig_dtype)
+    out = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    if pad:
+        out = out[:out.shape[0] - pad]
+    return out.reshape(orig_shape)

@@ -17,8 +17,9 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Tests force compression regardless of size, as the reference does
-# (meta_test.py:31-33 sets BYTEPS_MIN_COMPRESS_BYTES=0).
+# Once the compression engine is wired, tests force compression regardless
+# of tensor size, as the reference harness does (meta_test.py:31-33).  Until
+# then this only exercises the Config parsing path.
 os.environ.setdefault("BYTEPS_MIN_COMPRESS_BYTES", "0")
 
 import jax  # noqa: E402
