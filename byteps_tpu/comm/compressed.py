@@ -1,0 +1,89 @@
+"""Compressed all-reduce: the PS push/pull cycle with compression, on a mesh.
+
+Reference flow (SURVEY.md §2.2 integration points): worker compresses its
+gradient (COMPRESS stage), the server decompresses every worker's push and
+sums (server.cc:87-113), re-compresses the merged result, and workers
+decompress what they pull (DECOMPRESS stage).  Mathematically:
+
+    out = D_s(C_s( sum_i D_w(C_w(g_i)) ))
+
+This module reproduces both the math *and* the bandwidth economics without
+a server: each rank all-gathers only its compressed payload (the "push"),
+locally decompress-sums all payloads (the "server"), and bidirectional
+compressors re-quantize the merged sum (the "re-compressed pull").  On a
+ring, all-gathering payloads moves (R-1) x payload_bytes per rank versus
+~2 x full_bytes for a psum allreduce — with 32x onebit compression that is
+a real multi-x wire saving, which is the whole point on bandwidth-scarce
+(DCN) links.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..compression.base import Compressor
+from .mesh import CommContext
+
+
+def _stack_spec(tree):
+    return jax.tree.map(lambda _: P(("dcn", "ici")), tree)
+
+
+def _repl_spec(tree):
+    return jax.tree.map(lambda _: P(), tree)
+
+
+def compressed_all_reduce(comm: CommContext, stacked,
+                          worker_comp: Compressor,
+                          server_comp: Compressor,
+                          worker_states, server_state) -> Tuple:
+    """Reduce rank-stacked [R, n] chunks through the compression pipeline.
+
+    worker_states: rank-stacked state pytree ([R, ...] leaves);
+    server_state: replicated state pytree.
+    Returns (summed [n] array, new worker_states, new server_state).
+    """
+    axes = comm.dp_axes
+
+    def build():
+        def body(x, wst, sst):
+            x = x[0]
+            wst = jax.tree.map(lambda s: s[0], wst)
+            payload, wst2 = worker_comp.compress(x, wst)
+            # "push": only compressed bytes cross the interconnect
+            gathered = jax.tree.map(
+                lambda p: lax.all_gather(p, axes, axis=0), payload)
+            # "server": decompress every rank's payload and sum
+            y = jax.vmap(worker_comp.decompress)(gathered) \
+                .astype(jnp.float32).sum(axis=0)
+            if worker_comp.bidirectional:
+                # "re-compressed pull" (server.cc re-compresses merged data)
+                p2, sst2 = server_comp.compress(y, sst)
+                y = server_comp.decompress(p2).astype(jnp.float32)
+            else:
+                sst2 = sst
+            return (y.astype(x.dtype),
+                    jax.tree.map(lambda s: s[None], wst2),
+                    sst2)
+
+        return jax.jit(jax.shard_map(
+            body, mesh=comm.mesh,
+            in_specs=(P(axes), _stack_spec(worker_states),
+                      _repl_spec(server_state)),
+            out_specs=(P(), _stack_spec(worker_states),
+                       _repl_spec(server_state)),
+            check_vma=False,
+        ))
+
+    # Keyed by config, not object identity: same-config chunks (e.g. N
+    # equal-shaped layers) share one compiled program.
+    key = ("compressed", worker_comp.cache_key(), server_comp.cache_key())
+    fn = comm.jit_cache.get(key)
+    if fn is None:
+        fn = comm.jit_cache[key] = build()
+    return fn(stacked, worker_states, server_state)

@@ -139,6 +139,9 @@ class ChunkTask:
     stage: Stage = Stage.PARTITION
     # invoked as callback(result_chunk_or_None, status) by the sync loop
     callback: Optional[Callable[[Any, Status], None]] = None
+    # set by the engine for compressed tensors: the per-chunk compression
+    # slot (reference BPSContext.compressor_list, common.h:177-205)
+    compression: Any = None
 
     # Sort order matches the reference's addTask comparator: priority desc,
     # then key asc (scheduled_queue.cc:82-102).

@@ -1,0 +1,18 @@
+"""Gradient compression engine (reference byteps/common/compressor/ —
+SURVEY.md §2.2): onebit / topk / randomk / dithering compressors with
+error-feedback and Nesterov-momentum decorators, re-designed as functional
+jittable JAX transforms with explicit state.
+
+Where the reference compresses to shrink NIC bytes between workers and
+parameter servers, this engine shrinks interconnect bytes — most valuable
+on DCN hops between slices (comm/compressed.py, ops.hierarchical_push_pull).
+"""
+
+from .base import Compressor, IdentityCompressor  # noqa: F401
+from .dithering import DitheringCompressor  # noqa: F401
+from .error_feedback import ErrorFeedback  # noqa: F401
+from .momentum import NesterovMomentum  # noqa: F401
+from .onebit import OnebitCompressor  # noqa: F401
+from .randomk import RandomkCompressor  # noqa: F401
+from .registry import create  # noqa: F401
+from .topk import TopkCompressor  # noqa: F401

@@ -1,0 +1,72 @@
+"""Compressor protocol: functional, jittable, explicit-state.
+
+Reference interface (compressor/compressor.h:53-127): ``Compress(tensor)
+-> tensor``, ``Decompress``, optional ``FastUpdateError``, with the
+compressor owning hidden buffers.  JAX requires purity, so the rebuild makes
+the hidden state explicit: every compressor is a set of pure functions over
+(array, state) and the engine threads state through steps.
+
+Conventions:
+- compress/decompress operate on flat 1-D arrays (the engine hands chunks);
+- payload is a dict of arrays (a pytree) — the "wire format" whose total
+  bytes are what a DCN hop would carry;
+- state is a dict of arrays, possibly empty;
+- ``bidirectional`` compressors are re-applied to the merged sum, matching
+  the server's re-compression of merged results (reference server.cc:87-113).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+Payload = Dict[str, Any]
+State = Dict[str, Any]
+
+
+class Compressor:
+    """Base compressor; subclasses implement the pure transforms."""
+
+    name: str = "identity"
+    bidirectional: bool = True
+
+    def __init__(self, numel: int, dtype=jnp.float32):
+        self.numel = int(numel)
+        self.dtype = dtype
+
+    # -- state ------------------------------------------------------------
+    def init_state(self) -> State:
+        return {}
+
+    # -- transforms (pure, jittable) --------------------------------------
+    def compress(self, x, state: State) -> Tuple[Payload, State]:
+        return {"values": x}, state
+
+    def decompress(self, payload: Payload) -> Any:
+        return payload["values"]
+
+    # -- accounting --------------------------------------------------------
+    def payload_nbytes(self) -> int:
+        """Wire size of one compressed chunk (telemetry / ratio checks).
+        Subclasses override analytically; the fallback traces a compress."""
+        payload, _ = self.compress(jnp.zeros(self.numel, self.dtype),
+                                   self.init_state())
+        return int(sum(np.prod(v.shape) * v.dtype.itemsize
+                       for v in payload.values()))
+
+    def cache_key(self) -> tuple:
+        """Hashable config identity: compressors with equal keys are
+        behaviorally identical pure functions, so compiled collectives can
+        be shared across same-config chunks."""
+        return (self.name, self.numel, str(self.dtype))
+
+
+class IdentityCompressor(Compressor):
+    """No-op compressor (used when a tensor is below the compression size
+    cutoff, reference BYTEPS_MIN_COMPRESS_BYTES / operations.cc:362-364)."""
+
+    name = "identity"
+    bidirectional = False

@@ -1,0 +1,15 @@
+"""Shared helpers for the compression package (reference
+compressor/common.h + utils.h kwargs plumbing)."""
+
+from __future__ import annotations
+
+
+def resolve_k(k, numel: int) -> int:
+    """'k' may be an absolute count (int >= 1) or a fraction (0 < k < 1),
+    as the reference's HyperParamFinder accepts (compressor/utils.h)."""
+    if isinstance(k, float) and 0 < k < 1:
+        k = max(1, int(round(k * numel)))
+    k = int(k)
+    if not 1 <= k <= numel:
+        raise ValueError(f"k={k} out of range for numel={numel}")
+    return k

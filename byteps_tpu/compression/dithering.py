@@ -1,0 +1,89 @@
+"""Stochastic (dithered) quantization.
+
+Reference behavior (compressor/impl/dithering.cc:51-110): normalize by max
+or L2 norm, quantize magnitudes onto ``s`` partitions — linear (uniform
+levels i/s) or natural (power-of-two levels 2^-j) — with stochastic
+rounding, and entropy-code the sparse result with Elias-delta + sign bits
+via a sequential BitWriter.
+
+TPU redesign: the *math* (levels, normalization, stochastic rounding
+probabilities) is preserved exactly; the *layout* is not — variable-length
+Elias-delta coding is inherently sequential, so the payload is a dense
+signed int8 code per element (level index, sign folded in) + the norm
+scalar.  4x wire reduction for f32 at full vectorization; SURVEY.md §7
+"hard parts" calls out exactly this trade.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .base import Compressor, Payload, State
+from . import prng
+
+
+def _levels(scheme: str, s: int) -> np.ndarray:
+    if scheme == "linear":
+        return (np.arange(s + 1) / s).astype(np.float32)
+    if scheme == "natural":
+        lv = [0.0] + [2.0 ** -(s - 1 - i) for i in range(s)]
+        return np.asarray(lv, dtype=np.float32)
+    raise ValueError(f"unknown partition scheme: {scheme}")
+
+
+class DitheringCompressor(Compressor):
+    name = "dithering"
+    bidirectional = True
+
+    def __init__(self, numel: int, dtype=jnp.float32, s: int = 16,
+                 partition: str = "linear", normalize: str = "max",
+                 seed: int = 0):
+        super().__init__(numel, dtype)
+        if not 1 <= s <= 127:
+            raise ValueError("s must be in [1, 127] for int8 codes")
+        if normalize not in ("max", "l2"):
+            raise ValueError(f"unknown normalization: {normalize}")
+        self.s = s
+        self.partition = partition
+        self.normalize = normalize
+        self.seed = int(seed)
+        self.level_table = _levels(partition, s)
+
+    def init_state(self) -> State:
+        return {"counter": jnp.uint32(0)}
+
+    def compress(self, x, state: State):
+        xf = x.astype(jnp.float32)
+        mag = jnp.abs(xf)
+        if self.normalize == "max":
+            norm = jnp.max(mag)
+        else:
+            norm = jnp.sqrt(jnp.sum(mag * mag))
+        safe = jnp.where(norm > 0, norm, 1.0)
+        u = jnp.clip(mag / safe, 0.0, 1.0)
+        lv = jnp.asarray(self.level_table)
+        # L[i] <= u < L[i+1]
+        i = jnp.clip(jnp.searchsorted(lv, u, side="right") - 1,
+                     0, self.s - 1)
+        lo = jnp.take(lv, i)
+        hi = jnp.take(lv, i + 1)
+        p = (u - lo) / (hi - lo)
+        r = prng.uniform(self.seed, state["counter"], self.numel)
+        code = i + (r < p)
+        signed = jnp.where(xf < 0, -code, code).astype(jnp.int8)
+        new_state = {"counter": state["counter"] + jnp.uint32(self.numel)}
+        return {"codes": signed, "norm": norm}, new_state
+
+    def decompress(self, payload: Payload):
+        codes = payload["codes"].astype(jnp.int32)
+        lv = jnp.asarray(self.level_table)
+        mags = jnp.take(lv, jnp.abs(codes)) * payload["norm"]
+        return (jnp.sign(codes).astype(jnp.float32) * mags).astype(self.dtype)
+
+    def payload_nbytes(self) -> int:
+        return self.numel + 4  # int8 code per element + norm
+
+    def cache_key(self) -> tuple:
+        return super().cache_key() + (self.s, self.partition,
+                                      self.normalize, self.seed)

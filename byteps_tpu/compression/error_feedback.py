@@ -1,0 +1,53 @@
+"""Error-feedback decorator.
+
+Reference behavior (compressor/error_feedback.h:26-95, vanilla impl):
+``Compress``: grad += error; c = inner.Compress(grad); error = grad -
+Decompress(c).  The vanilla variant additionally rescales the residual by
+the learning-rate ratio read from an mmap file the MXNet trainer writes
+(vanilla_error_feedback.cc + mxnet/__init__.py:211-214) — an
+MXNet-plumbing detail with no TPU analog, so the residual is kept in
+gradient space here (callers that scale gradients by lr before push_pull
+get identical behavior).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Compressor, State
+
+
+class ErrorFeedback(Compressor):
+    """Decorator: accumulate compression residual into the next step."""
+
+    name = "error_feedback"
+
+    def __init__(self, inner: Compressor):
+        super().__init__(inner.numel, inner.dtype)
+        self.inner = inner
+        self.bidirectional = inner.bidirectional
+
+    def init_state(self) -> State:
+        return {
+            "error": jnp.zeros(self.numel, jnp.float32),
+            "inner": self.inner.init_state(),
+        }
+
+    def compress(self, x, state: State):
+        corrected = x.astype(jnp.float32) + state["error"]
+        payload, inner_state = self.inner.compress(corrected, state["inner"])
+        decompressed = self.inner.decompress(payload).astype(jnp.float32)
+        new_state = {
+            "error": corrected - decompressed,
+            "inner": inner_state,
+        }
+        return payload, new_state
+
+    def decompress(self, payload):
+        return self.inner.decompress(payload)
+
+    def payload_nbytes(self) -> int:
+        return self.inner.payload_nbytes()
+
+    def cache_key(self) -> tuple:
+        return ("ef",) + self.inner.cache_key()

@@ -1,0 +1,46 @@
+"""Nesterov-momentum decorator.
+
+Reference behavior (compressor/momentum.h:25-44, nesterov_momentum.cc):
+m = mu*m + g; g += mu*m, applied *before* compression on the worker only
+(the server never runs momentum — compressor_registry.cc:39-56 skips it
+server-side).  Explicitly replaces framework momentum; pair with a
+momentum-free optimizer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Compressor, State
+
+
+class NesterovMomentum(Compressor):
+    name = "nesterov_momentum"
+
+    def __init__(self, inner: Compressor, mu: float = 0.9):
+        super().__init__(inner.numel, inner.dtype)
+        self.inner = inner
+        self.mu = float(mu)
+        self.bidirectional = inner.bidirectional
+
+    def init_state(self) -> State:
+        return {
+            "momentum": jnp.zeros(self.numel, jnp.float32),
+            "inner": self.inner.init_state(),
+        }
+
+    def compress(self, x, state: State):
+        xf = x.astype(jnp.float32)
+        m = self.mu * state["momentum"] + xf
+        boosted = xf + self.mu * m
+        payload, inner_state = self.inner.compress(boosted, state["inner"])
+        return payload, {"momentum": m, "inner": inner_state}
+
+    def decompress(self, payload):
+        return self.inner.decompress(payload)
+
+    def payload_nbytes(self) -> int:
+        return self.inner.payload_nbytes()
+
+    def cache_key(self) -> tuple:
+        return ("nesterov", self.mu) + self.inner.cache_key()

@@ -1,0 +1,55 @@
+"""Onebit (sign) compression with optional L1-mean scaling.
+
+Reference behavior (compressor/impl/onebit.cc:34-140): quantize to sign
+bits packed 32 per word; optional ``scaling`` appends the L1-mean as a
+trailing float so decompression returns ``sign * mean(|x|)``; bidirectional
+(the server re-compresses the merged sum); fused FastUpdateError.
+
+TPU redesign: packing is a vectorized reshape+shift-reduce onto uint32 —
+no sequential BitWriter.  32x wire-size reduction (plus 4 bytes for the
+scale), identical math.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Compressor, Payload, State
+
+
+class OnebitCompressor(Compressor):
+    name = "onebit"
+    bidirectional = True
+
+    def __init__(self, numel: int, dtype=jnp.float32, scaling: bool = True):
+        super().__init__(numel, dtype)
+        self.scaling = scaling
+        self._words = (numel + 31) // 32
+
+    def compress(self, x, state: State):
+        x = x.astype(jnp.float32)
+        if self.scaling:
+            scale = jnp.mean(jnp.abs(x))
+        else:
+            scale = jnp.float32(1.0)
+        bits = (x >= 0).astype(jnp.uint32)
+        pad = self._words * 32 - self.numel
+        if pad:
+            bits = jnp.pad(bits, (0, pad))
+        words = (bits.reshape(self._words, 32)
+                 << jnp.arange(32, dtype=jnp.uint32)).sum(
+                     axis=1, dtype=jnp.uint32)
+        return {"words": words, "scale": scale}, state
+
+    def decompress(self, payload: Payload):
+        words = payload["words"]
+        bits = (words[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        bits = bits.reshape(-1)[: self.numel]
+        signs = bits.astype(jnp.float32) * 2.0 - 1.0
+        return (signs * payload["scale"]).astype(self.dtype)
+
+    def payload_nbytes(self) -> int:
+        return self._words * 4 + 4
+
+    def cache_key(self) -> tuple:
+        return super().cache_key() + (self.scaling,)

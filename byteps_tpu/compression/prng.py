@@ -1,0 +1,56 @@
+"""Counter-based deterministic PRNG shared by the randomized compressors.
+
+The reference uses a sequential xorshift128p stream (compressor/utils.h),
+and its tests re-implement that PRNG in numpy so randomized compressors are
+deterministic across the C++/Python boundary (reference tests/utils.py:31-50).
+A sequential stream is hostile to SIMD/TPU, so this rebuild uses a
+*counter-based* generator instead: a murmur3-style integer hash of
+(seed, counter + lane index).  Same determinism contract — identical values
+from the numpy mirror in tests/compression_refs.py — but every lane is
+independent, so it vectorizes on the VPU and never serializes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_C1 = np.uint32(0x85EBCA6B)
+_C2 = np.uint32(0xC2B2AE35)
+_GOLDEN = np.uint32(0x9E3779B9)
+_KNUTH = np.uint32(2654435761)
+
+
+def _mix_jax(z):
+    z = z ^ (z >> 16)
+    z = z * jnp.uint32(_C1)
+    z = z ^ (z >> 13)
+    z = z * jnp.uint32(_C2)
+    z = z ^ (z >> 16)
+    return z
+
+
+def uniform(seed: int, counter: int, n: int):
+    """n floats in [0, 1), deterministic in (seed, counter, lane)."""
+    idx = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(counter)
+    z = idx * jnp.uint32(_KNUTH) + jnp.uint32(seed) * jnp.uint32(_GOLDEN)
+    z = _mix_jax(z)
+    return z.astype(jnp.float32) / jnp.float32(2**32)
+
+
+def _mix_np(z: np.ndarray) -> np.ndarray:
+    z = z ^ (z >> np.uint32(16))
+    z = (z * _C1).astype(np.uint32)
+    z = z ^ (z >> np.uint32(13))
+    z = (z * _C2).astype(np.uint32)
+    z = z ^ (z >> np.uint32(16))
+    return z
+
+
+def uniform_np(seed: int, counter: int, n: int) -> np.ndarray:
+    """Numpy mirror of :func:`uniform` — must match bit-for-bit."""
+    with np.errstate(over="ignore"):
+        idx = (np.arange(n, dtype=np.uint32) + np.uint32(counter))
+        z = (idx * _KNUTH + np.uint32(seed) * _GOLDEN).astype(np.uint32)
+        z = _mix_np(z)
+    return z.astype(np.float32) / np.float32(2**32)

@@ -1,0 +1,52 @@
+"""Random-k sparsification: keep k uniformly random entries.
+
+Reference behavior (compressor/impl/randomk.cc): k entries chosen by a
+seeded xorshift128p stream; worker and server share the seed so indices are
+reproducible.  Here the counter-based PRNG (prng.py) picks k distinct
+indices per step — the per-step ``counter`` in the state advances so every
+step draws fresh indices, and determinism across replicas comes from the
+shared (seed, counter), exactly the property the reference's shared seed
+provides.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Compressor, Payload, State
+from .common import resolve_k
+from . import prng
+
+
+class RandomkCompressor(Compressor):
+    name = "randomk"
+    bidirectional = True
+
+    def __init__(self, numel: int, dtype=jnp.float32, k=0.01, seed: int = 0):
+        super().__init__(numel, dtype)
+        self.k = resolve_k(k, numel)
+        self.seed = int(seed)
+
+    def init_state(self) -> State:
+        return {"counter": jnp.uint32(0)}
+
+    def compress(self, x, state: State):
+        xf = x.astype(jnp.float32)
+        # k distinct random indices: random scores, take the k largest
+        scores = prng.uniform(self.seed, state["counter"], self.numel)
+        _, idx = lax.top_k(scores, self.k)
+        vals = jnp.take(xf, idx)
+        new_state = {"counter": state["counter"] + jnp.uint32(self.numel)}
+        return {"indices": idx.astype(jnp.int32), "values": vals}, new_state
+
+    def decompress(self, payload: Payload):
+        out = jnp.zeros(self.numel, jnp.float32)
+        out = out.at[payload["indices"]].set(payload["values"])
+        return out.astype(self.dtype)
+
+    def payload_nbytes(self) -> int:
+        return self.k * 8
+
+    def cache_key(self) -> tuple:
+        return super().cache_key() + (self.k, self.seed)

@@ -1,0 +1,96 @@
+"""String-keyed compressor factory + decorator chain builder.
+
+Reference behavior (compressor/compressor_registry.cc:39-56): build the
+chain by checking ``momentum_type`` -> ``ef_type`` -> ``compressor_type`` in
+order, so the final object is momentum(ef(impl)); momentum is skipped on
+the server.  kwargs arrive as a per-tensor string dict exactly as the
+frameworks pass them (reference mxnet/__init__.py:235-316 compression
+params -> byteps_* attrs -> kwargs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+from .base import Compressor, IdentityCompressor
+from .dithering import DitheringCompressor
+from .error_feedback import ErrorFeedback
+from .momentum import NesterovMomentum
+from .onebit import OnebitCompressor
+from .randomk import RandomkCompressor
+from .topk import TopkCompressor
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+@register("onebit")
+def _make_onebit(numel, dtype, kwargs):
+    scaling = str(kwargs.get("scaling", "true")).lower() in ("1", "true")
+    return OnebitCompressor(numel, dtype, scaling=scaling)
+
+
+@register("topk")
+def _make_topk(numel, dtype, kwargs):
+    return TopkCompressor(numel, dtype, k=_num(kwargs.get("k", 0.01)))
+
+
+@register("randomk")
+def _make_randomk(numel, dtype, kwargs):
+    return RandomkCompressor(numel, dtype, k=_num(kwargs.get("k", 0.01)),
+                             seed=int(kwargs.get("seed", 0)))
+
+
+@register("dithering")
+def _make_dithering(numel, dtype, kwargs):
+    # 'k' is the reference's name for the level count here
+    # (docs/gradient-compression.md: k must be specified for dithering)
+    return DitheringCompressor(
+        numel, dtype,
+        s=int(kwargs.get("partition_num",
+                         kwargs.get("s", kwargs.get("k", 16)))),
+        partition=str(kwargs.get("partition", "linear")),
+        normalize=str(kwargs.get("normalize", "max")),
+        seed=int(kwargs.get("seed", 0)))
+
+
+def _num(v):
+    if isinstance(v, str):
+        return float(v) if "." in v or "e" in v.lower() else int(v)
+    return v
+
+
+def create(kwargs: Optional[Dict], numel: int, dtype=jnp.float32,
+           for_server: bool = False) -> Compressor:
+    """Build the compressor chain from a kwargs dict.
+
+    Keys (reference docs/gradient-compression.md naming):
+      compressor: onebit|topk|randomk|dithering
+      ef: vanilla                     (error feedback decorator)
+      momentum: nesterov              (worker-side only)
+      + per-compressor params (k, scaling, partition_num, normalize, seed,
+        momentum_mu)
+    """
+    if not kwargs or "compressor" not in kwargs:
+        return IdentityCompressor(numel, dtype)
+    ctype = str(kwargs["compressor"]).lower()
+    if ctype not in _REGISTRY:
+        raise ValueError(
+            f"unknown compressor {ctype!r}; have {sorted(_REGISTRY)}")
+    comp = _REGISTRY[ctype](numel, dtype, kwargs)
+    ef = str(kwargs.get("ef", "")).lower()
+    if ef in ("vanilla", "true", "1"):
+        comp = ErrorFeedback(comp)
+    momentum = str(kwargs.get("momentum", "")).lower()
+    if momentum == "nesterov" and not for_server:
+        comp = NesterovMomentum(comp, mu=float(kwargs.get("momentum_mu",
+                                                          0.9)))
+    return comp

@@ -1,0 +1,43 @@
+"""Top-k sparsification: keep the k largest-magnitude entries.
+
+Reference behavior (compressor/impl/topk.cc): emit (index, value) pairs of
+the k largest |x_i|; the server sums scattered pairs.  ``k`` may be given
+as an absolute count or a fraction of numel (HyperParamFinder semantics).
+
+TPU: ``lax.top_k`` on the MXU/VPU; payload is a dense (indices, values)
+pair — static shapes, no variable-length encoding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Compressor, Payload, State
+from .common import resolve_k
+
+
+class TopkCompressor(Compressor):
+    name = "topk"
+    bidirectional = True
+
+    def __init__(self, numel: int, dtype=jnp.float32, k=0.01):
+        super().__init__(numel, dtype)
+        self.k = resolve_k(k, numel)
+
+    def compress(self, x, state: State):
+        xf = x.astype(jnp.float32)
+        _, idx = lax.top_k(jnp.abs(xf), self.k)
+        vals = jnp.take(xf, idx)
+        return {"indices": idx.astype(jnp.int32), "values": vals}, state
+
+    def decompress(self, payload: Payload):
+        out = jnp.zeros(self.numel, jnp.float32)
+        out = out.at[payload["indices"]].set(payload["values"])
+        return out.astype(self.dtype)
+
+    def payload_nbytes(self) -> int:
+        return self.k * 8  # int32 index + f32 value
+
+    def cache_key(self) -> tuple:
+        return super().cache_key() + (self.k,)

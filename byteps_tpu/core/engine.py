@@ -28,7 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm.collectives import push_pull_array
+from ..comm.compressed import compressed_all_reduce
 from ..comm.mesh import CommContext
+from ..compression import registry as compression_registry
 from ..common.config import Config
 from ..common.handles import Handle, HandleManager
 from ..common.logging import get_logger
@@ -39,6 +41,24 @@ from ..common.types import ChunkTask, Status, TensorContext
 
 
 _SHUTDOWN = object()  # sync-queue sentinel
+
+
+class _CompressionSlot:
+    """Per-chunk compressor pair + functional state, engine-owned.
+
+    TPU stand-in for the reference's per-partition compressor objects with
+    hidden buffers (compressor_list, common.h:201): state is explicit JAX
+    arrays, committed by the dispatcher at issue time (so pipelined steps
+    of the same chunk chain correctly) and rolled back by the syncer if the
+    async execution fails."""
+
+    __slots__ = ("worker", "server", "wstates", "sstate")
+
+    def __init__(self, worker, server, wstates, sstate):
+        self.worker = worker
+        self.server = server
+        self.wstates = wstates      # rank-stacked pytree
+        self.sstate = sstate        # replicated pytree
 
 
 class _PendingTensor:
@@ -88,7 +108,6 @@ class PushPullEngine:
         self.speed = SpeedMonitor()
         self._sync_q: "queue.Queue" = queue.Queue()
         self._running = True
-        self._compressor_cache: Dict[str, Any] = {}
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="bps-dispatch", daemon=True)
         self._syncer = threading.Thread(
@@ -111,12 +130,6 @@ class PushPullEngine:
         """
         if not self._running:
             raise RuntimeError("engine is shut down")
-        if compression:
-            # The compression engine (byteps_tpu.compression) wires in via
-            # compressed hierarchical collectives; until that lands,
-            # refusing is better than silently sending uncompressed.
-            raise NotImplementedError(
-                "per-tensor compression is not wired into the engine yet")
         r = stacked.shape[0]
         if r != self.comm.num_ranks:
             raise ValueError(
@@ -135,6 +148,7 @@ class PushPullEngine:
             ctx.version += 1
             version = ctx.version
 
+        self._ensure_compression(ctx, stacked.dtype)
         flat = stacked.reshape(r, -1)
         itemsize = np.dtype(stacked.dtype).itemsize
         nchunks = len(ctx.chunk_bounds)
@@ -145,6 +159,8 @@ class PushPullEngine:
                 version=version, offset_elems=off, num_elems=ln,
                 nbytes=ln * itemsize, total_parts=nchunks,
                 data=chunk,
+                compression=(ctx.compressor[part_idx]
+                             if ctx.compressor else None),
             )
             task.callback = self._make_chunk_callback(pending, part_idx)
             self.scheduler.add_task(task)
@@ -152,6 +168,38 @@ class PushPullEngine:
         # work, so direct handle.wait() users don't leak table entries.
         handle.add_done_callback(lambda h: self.handles.release(h.id))
         return handle
+
+    def _ensure_compression(self, ctx: TensorContext, dtype) -> None:
+        """Instantiate the per-chunk compressor chain on first use.
+
+        Reference parity: one compressor per partition
+        (BPSContext.compressor_list), instantiated at InitTensor when the
+        tensor passes the BYTEPS_MIN_COMPRESS_BYTES cutoff
+        (operations.cc:362-364).  Worker chain carries momentum+EF; the
+        server chain (re-compression of the merged sum) never has momentum
+        (compressor_registry.cc:39-56).
+        """
+        with ctx.lock:
+            if ctx.compressor is not None or not ctx.compression_kwargs:
+                return
+            if ctx.nbytes < self.cfg.min_compress_bytes:
+                ctx.compression_kwargs = {}
+                return
+            r = self.comm.num_ranks
+            slots = []
+            for off, ln in ctx.chunk_bounds:
+                wc = compression_registry.create(
+                    ctx.compression_kwargs, ln, dtype)
+                sc = compression_registry.create(
+                    ctx.compression_kwargs, ln, dtype, for_server=True)
+                wstate = jax.tree.map(
+                    lambda s: jnp.broadcast_to(
+                        jnp.asarray(s)[None],
+                        (r,) + jnp.asarray(s).shape),
+                    wc.init_state())
+                slots.append(_CompressionSlot(wc, sc, wstate,
+                                              sc.init_state()))
+            ctx.compressor = slots
 
     def _make_chunk_callback(self, pending: _PendingTensor, part_idx: int):
         def cb(data, status: Status):
@@ -172,11 +220,27 @@ class PushPullEngine:
             if task is None:
                 continue
             try:
-                out = push_pull_array(self.comm, task.data, op="sum")
-                self._sync_q.put((task, out, None))
+                slot = task.compression
+                rollback = None
+                if slot is not None:
+                    out, new_wst, new_sst = compressed_all_reduce(
+                        self.comm, task.data, slot.worker, slot.server,
+                        slot.wstates, slot.sstate)
+                    # Commit at dispatch time so a later step of the same
+                    # chunk (which can be dispatched before this one syncs)
+                    # sees the advanced EF/momentum/PRNG state; the syncer
+                    # rolls back to the pre-step snapshot if the async
+                    # execution later fails, so a transient device fault
+                    # does not poison the slot.
+                    rollback = (slot, slot.wstates, slot.sstate)
+                    slot.wstates = new_wst
+                    slot.sstate = new_sst
+                else:
+                    out = push_pull_array(self.comm, task.data, op="sum")
+                self._sync_q.put((task, out, rollback, None))
             except Exception as e:  # noqa: BLE001
                 get_logger().error("dispatch failed for %s: %s", task.name, e)
-                self._sync_q.put((task, None, e))
+                self._sync_q.put((task, None, None, e))
 
     def _sync_loop(self):
         # Exits only on the sentinel, which shutdown enqueues *after* the
@@ -186,15 +250,23 @@ class PushPullEngine:
             item = self._sync_q.get()
             if item is _SHUTDOWN:
                 break
-            task, out, err = item
+            task, out, rollback, err = item
             if err is None:
                 try:
                     jax.block_until_ready(out)
                 except Exception as e:  # noqa: BLE001
                     err = e
+                    if rollback is not None:
+                        slot, wst, sst = rollback
+                        slot.wstates = wst
+                        slot.sstate = sst
             self.scheduler.report_finish(task.nbytes)
             if self.cfg.telemetry_on:
-                self.speed.record(task.nbytes * 2)  # push + pull bytes
+                # push + pull wire bytes; compressed chunks report payload
+                # size, which is the point of the feature
+                wire = (task.compression.worker.payload_nbytes()
+                        if task.compression is not None else task.nbytes)
+                self.speed.record(wire * 2)
             if task.callback is not None:
                 if err is not None:
                     task.callback(None, Status.error(str(err)))

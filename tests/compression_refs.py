@@ -1,0 +1,105 @@
+"""Pure-numpy reference implementations of every compressor.
+
+Mirrors the reference's test strategy (SURVEY.md §4): its tests replicate
+the entire worker+server compressor pipeline in numpy — including the exact
+PRNG — so randomized compressors are deterministic across implementations
+(reference tests/utils.py:31-50, test_onebit.py:32-113).  These refs must
+match byteps_tpu.compression bit-for-bit on the PRNG and to float tolerance
+on the math."""
+
+import numpy as np
+
+from byteps_tpu.compression.prng import uniform_np
+
+
+# --- onebit ----------------------------------------------------------------
+
+def onebit_compress(x, scaling=True):
+    x = x.astype(np.float32)
+    scale = np.abs(x).mean() if scaling else np.float32(1.0)
+    bits = (x >= 0).astype(np.uint32)
+    words = len(bits)
+    pad = (-words) % 32
+    bits = np.pad(bits, (0, pad))
+    packed = (bits.reshape(-1, 32) << np.arange(32, dtype=np.uint32)) \
+        .sum(axis=1).astype(np.uint32)
+    return packed, np.float32(scale)
+
+
+def onebit_decompress(packed, scale, numel):
+    bits = ((packed[:, None] >> np.arange(32, dtype=np.uint32)) & 1)
+    bits = bits.reshape(-1)[:numel]
+    return (bits.astype(np.float32) * 2.0 - 1.0) * scale
+
+
+# --- topk ------------------------------------------------------------------
+
+def topk_compress(x, k):
+    x = x.astype(np.float32)
+    # np.argsort is ascending & stable; jax.lax.top_k takes largest with
+    # ties broken by lowest index — replicate via (-|x|, index) lexsort
+    order = np.lexsort((np.arange(len(x)), -np.abs(x)))
+    idx = order[:k].astype(np.int32)
+    return idx, x[idx]
+
+
+def sparse_decompress(idx, vals, numel):
+    out = np.zeros(numel, np.float32)
+    out[idx] = vals
+    return out
+
+
+# --- randomk ---------------------------------------------------------------
+
+def randomk_compress(x, k, seed, counter):
+    x = x.astype(np.float32)
+    scores = uniform_np(seed, counter, len(x))
+    order = np.lexsort((np.arange(len(x)), -scores))
+    idx = order[:k].astype(np.int32)
+    return idx, x[idx], counter + len(x)
+
+
+# --- dithering -------------------------------------------------------------
+
+def dithering_levels(scheme, s):
+    if scheme == "linear":
+        return (np.arange(s + 1) / s).astype(np.float32)
+    return np.asarray([0.0] + [2.0 ** -(s - 1 - i) for i in range(s)],
+                      dtype=np.float32)
+
+
+def dithering_compress(x, s, partition, normalize, seed, counter):
+    x = x.astype(np.float32)
+    mag = np.abs(x)
+    norm = mag.max() if normalize == "max" else np.sqrt((mag * mag).sum())
+    safe = norm if norm > 0 else np.float32(1.0)
+    u = np.clip(mag / safe, 0.0, 1.0)
+    lv = dithering_levels(partition, s)
+    i = np.clip(np.searchsorted(lv, u, side="right") - 1, 0, s - 1)
+    lo, hi = lv[i], lv[i + 1]
+    p = (u - lo) / (hi - lo)
+    r = uniform_np(seed, counter, len(x))
+    code = i + (r < p)
+    signed = np.where(x < 0, -code, code).astype(np.int8)
+    return signed, np.float32(norm), counter + len(x)
+
+
+def dithering_decompress(codes, norm, s, partition):
+    lv = dithering_levels(partition, s)
+    mags = lv[np.abs(codes.astype(np.int32))] * norm
+    return np.sign(codes).astype(np.float32) * mags
+
+
+# --- decorators ------------------------------------------------------------
+
+def ef_compress(x, error, compress_fn, decompress_fn):
+    corrected = x.astype(np.float32) + error
+    payload = compress_fn(corrected)
+    decompressed = decompress_fn(payload)
+    return payload, corrected - decompressed
+
+
+def nesterov_compress(x, m, mu):
+    x = x.astype(np.float32)
+    m2 = mu * m + x
+    return x + mu * m2, m2
