@@ -65,12 +65,12 @@ class _PendingTensor:
     """Accumulates finished chunks of one push_pull until all arrive."""
 
     def __init__(self, handle: Handle, ctx: TensorContext, out_shape, op: str,
-                 total_ranks: int):
+                 denom: int):
         self.handle = handle
         self.ctx = ctx
         self.out_shape = out_shape
         self.op = op
-        self.total_ranks = total_ranks
+        self.denom = denom  # divisor applied at assembly (1 = plain sum)
         self.parts: Dict[int, Any] = {}
         self.total = len(ctx.chunk_bounds)
         self.lock = threading.Lock()
@@ -86,13 +86,13 @@ class _PendingTensor:
         else:
             flat = jnp.concatenate([self.parts[i] for i in range(self.total)])
         out = flat.reshape(self.out_shape)
-        if self.op == "average":
+        if self.denom != 1:
             # The reference divides by size in the done-callback
             # (torch/ops.cc StartTask callback; torch/__init__.py).
             if jnp.issubdtype(out.dtype, jnp.inexact):
-                out = out / self.total_ranks
+                out = out / self.denom
             else:
-                out = out // self.total_ranks
+                out = out // self.denom
         return out
 
 
@@ -120,6 +120,8 @@ class PushPullEngine:
                         priority: Optional[int] = None,
                         op: str = "average",
                         compression: Optional[Dict[str, str]] = None,
+                        denom: Optional[int] = None,
+                        out_shape: Optional[tuple] = None,
                         ) -> Handle:
         """Enqueue a rank-stacked tensor [R, ...] for reduction.
 
@@ -134,7 +136,8 @@ class PushPullEngine:
         if r != self.comm.num_ranks:
             raise ValueError(
                 f"stacked rank axis {r} != mesh ranks {self.comm.num_ranks}")
-        out_shape = stacked.shape[1:]
+        if out_shape is None:
+            out_shape = stacked.shape[1:]
         ctx = self.registry.init_tensor(name, out_shape, stacked.dtype,
                                         compression_kwargs=compression)
         if priority is None:
@@ -142,8 +145,9 @@ class PushPullEngine:
         else:
             prio = priority
         handle = self.handles.allocate(name)
-        pending = _PendingTensor(handle, ctx, out_shape, op,
-                                 self.comm.num_ranks)
+        if denom is None:
+            denom = self.comm.num_ranks if op == "average" else 1
+        pending = _PendingTensor(handle, ctx, out_shape, op, denom)
         with ctx.lock:
             ctx.version += 1
             version = ctx.version
@@ -294,6 +298,44 @@ class PushPullEngine:
     def push_pull(self, stacked, name: str, **kw):
         """Synchronous push_pull; returns the reduced array."""
         h = self.push_pull_async(stacked, name, **kw)
+        out = h.wait()
+        self.handles.release(h.id)
+        return out
+
+    # -------------------------------------------------- contribution mode
+    def push_pull_local_async(self, x, name: str, **kw) -> Handle:
+        """Per-process (non-stacked) push_pull: this process contributes one
+        tensor; the result is the sum/average over *processes*.
+
+        This is the reference's native data model — every worker process
+        owns one replica and calls push_pull on its own gradient
+        (torch/__init__.py).  Under a single controller the local
+        contribution is replicated across the process's devices and the
+        over-count is divided back out, which also reproduces the
+        reference's single-worker forced-distributed test mode
+        (BYTEPS_FORCE_DISTRIBUTED, meta_test.py).
+        """
+        import jax as _jax
+        op = kw.pop("op", "average")
+        n_proc = _jax.process_count()
+        local = self.comm.num_ranks // n_proc
+        # numpy broadcast is a zero-copy *view*: no R-times materialization
+        # on host or device — device_put later reads one [1, n] slice per
+        # device (a device-side jnp.broadcast_to would materialize R x n on
+        # the default device first).
+        xn = np.asarray(x)
+        # flatten before broadcasting so every later reshape/slice in
+        # push_pull_async stays a zero-copy view of the single source array
+        flat = np.broadcast_to(xn.reshape(-1)[None],
+                               (self.comm.num_ranks, xn.size))
+        # engine sums all ranks = local_size * (sum over processes); divide
+        # the over-count (and the process count for averages) at assembly
+        denom = local * n_proc if op == "average" else local
+        return self.push_pull_async(flat, name, op=op, denom=denom,
+                                    out_shape=xn.shape, **kw)
+
+    def push_pull_local(self, x, name: str, **kw):
+        h = self.push_pull_local_async(x, name, **kw)
         out = h.wait()
         self.handles.release(h.id)
         return out

@@ -1,0 +1,153 @@
+"""BERT encoder (flax) — the flagship benchmark model.
+
+The reference's headline number is BERT-large scaling efficiency with
+GluonNLP on 256 GPUs (reference README.md:35-41; BASELINE.md).  This is a
+TPU-first reimplementation of that workload's model: bf16 compute / f32
+params, MXU-aligned dims (1024/4096 hidden, 64-dim heads), optional
+rematerialization of encoder layers to trade FLOPs for HBM, and static
+shapes throughout so XLA tiles everything onto the systolic array.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528          # 30522 rounded up to a multiple of 64
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.0        # benchmarks run dropout-free
+    dtype: Any = jnp.bfloat16        # compute dtype; params stay f32
+    remat: bool = False              # jax.checkpoint each layer
+
+
+def bert_large() -> "BertConfig":
+    return BertConfig()
+
+
+def bert_base() -> "BertConfig":
+    return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                      intermediate_size=3072)
+
+
+def bert_tiny() -> "BertConfig":
+    """For CPU-mesh tests and multichip dry-runs."""
+    return BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                      num_heads=2, intermediate_size=256, max_position=128)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name)
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        scale = jnp.asarray(head_dim, cfg.dtype) ** -0.5
+        # [B, H, T, T] logits on the MXU; additive mask
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+        logits = logits + mask[:, None, None, :]
+        probs = jax.nn.softmax(logits.astype(jnp.float32)).astype(cfg.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1),
+                               dtype=cfg.dtype, name="out")(ctx)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        a = SelfAttention(cfg, name="attention")(x, mask)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_att")(x + a)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(h)
+        return nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x + h)
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.cfg
+        b, t = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, t), jnp.int32)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, t), jnp.int32)
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       dtype=cfg.dtype, name="word_embeddings")(input_ids)
+        pos = nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
+                       name="position_embeddings")(jnp.arange(t)[None])
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_emb")(emb + pos + typ)
+        # additive attention mask: 0 keep, -1e9 drop
+        mask = (1.0 - attention_mask.astype(jnp.float32)) * -1e9
+        mask = mask.astype(cfg.dtype)
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(EncoderLayer)
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
+        return x
+
+
+class BertForMLM(nn.Module):
+    """Masked-LM head — the pretraining objective of the headline bench."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        cfg = self.cfg
+        x = BertEncoder(cfg, name="encoder")(input_ids, attention_mask)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+        x = jax.nn.gelu(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="mlm_out")(x)
+        return logits.astype(jnp.float32)
+
+
+def mlm_loss(logits, labels, weights=None):
+    """Cross-entropy over masked positions (labels < 0 are unmasked)."""
+    valid = (labels >= 0)
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    w = valid.astype(jnp.float32)
+    if weights is not None:
+        w = w * weights
+    return -(ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def synthetic_batch(rng: "jax.Array", cfg: BertConfig, batch: int,
+                    seq_len: int, mask_frac: float = 0.15):
+    """Deterministic fake pretraining batch (reference benchmarks use
+    synthetic data too, example/pytorch/benchmark_byteps.py)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    ids = jax.random.randint(k1, (batch, seq_len), 0, cfg.vocab_size)
+    is_masked = jax.random.uniform(k2, (batch, seq_len)) < mask_frac
+    labels = jnp.where(is_masked, ids, -1)
+    input_ids = jnp.where(is_masked, jnp.zeros_like(ids), ids)
+    return {"input_ids": input_ids, "labels": labels,
+            "attention_mask": jnp.ones((batch, seq_len), jnp.int32)}

@@ -1,0 +1,14 @@
+"""Parallel training strategies.
+
+The reference implements data parallelism only (SURVEY.md §2.6); this
+package holds its TPU-native equivalent (data_parallel.py: fused DP training
+steps over the (dcn, ici) mesh) plus the DDP-style module wrapper and
+cross-barrier pipelining as they land.
+"""
+
+from .data_parallel import (  # noqa: F401
+    dp_specs,
+    make_dp_train_step,
+    replicate,
+    shard_batch,
+)

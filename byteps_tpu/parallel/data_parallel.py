@@ -1,0 +1,81 @@
+"""Fused data-parallel training step over the (dcn, ici) mesh.
+
+This is the "MirroredStrategy" of the rebuild (the reference ships a
+BytePS-backed tf.distribute MirroredStrategy whose cross-device ops route
+through push_pull, reference distribute/mirrored_strategy.py): the whole
+training step — forward, backward, gradient push_pull, optimizer — is one
+XLA program over the mesh.  Parameters are replicated, the batch is sharded
+across all mesh devices, and gradient reduction is the in-graph
+push_pull_tree (which XLA lowers to ICI/DCN collectives and fuses with the
+update).  This is the peak-throughput path the benchmarks use.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.mesh import CommContext
+from ..ops import push_pull_tree
+
+
+def dp_specs(comm: CommContext):
+    """(replicated, batch-sharded) PartitionSpecs for this mesh."""
+    return P(), P(comm.dp_axes)
+
+
+def replicate(comm: CommContext, tree):
+    """Place a pytree replicated across the mesh."""
+    sh = NamedSharding(comm.mesh, P())
+    return jax.device_put(tree, sh)
+
+
+def shard_batch(comm: CommContext, batch):
+    """Shard a batch pytree along its leading axis across all devices."""
+    sh = NamedSharding(comm.mesh, P(comm.dp_axes))
+    return jax.device_put(batch, sh)
+
+
+def make_dp_train_step(comm: CommContext,
+                       loss_fn: Callable,
+                       tx: optax.GradientTransformation,
+                       donate: bool = True,
+                       compress_dcn=None) -> Callable:
+    """Build jitted (params, opt_state, batch) -> (params, opt_state, loss).
+
+    ``loss_fn(params, batch) -> scalar`` is the per-shard loss (mean over
+    the local examples).  Gradient averaging across the mesh is the
+    framework's push_pull; ``compress_dcn`` optionally applies a compressor
+    pair to the inter-slice hop via hierarchical_push_pull (SURVEY.md §7
+    two-level scheme).
+    """
+    axes = comm.dp_axes
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if compress_dcn is not None:
+            from ..ops import hierarchical_push_pull
+            comp, decomp = compress_dcn
+            grads = jax.tree.map(
+                lambda g: hierarchical_push_pull(
+                    g, op="average", compress=comp, decompress=decomp),
+                grads)
+        else:
+            grads = push_pull_tree(grads, axes, op="average")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = lax.pmean(loss, axes)
+        return params, opt_state, loss
+
+    mapped = jax.shard_map(
+        step, mesh=comm.mesh,
+        in_specs=(P(), P(), P(axes)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,214 @@
+"""PyTorch framework adapter.
+
+TPU-native counterpart of the reference's byteps.torch plugin
+(torch/__init__.py, torch/ops.py — SURVEY.md §2.4): the same Horovod-style
+surface (init/rank/size, push_pull[_async], poll/synchronize,
+DistributedOptimizer with per-parameter backward hooks,
+broadcast_parameters / broadcast_optimizer_state), with the communication
+running through the byteps_tpu engine — torch stays the modeling frontend
+(CPU tensors), JAX/XLA is the transport.
+
+Process model parity: in the reference every worker process owns one model
+replica and reduces across processes; here push_pull uses the engine's
+contribution mode (engine.push_pull_local_*), which reduces across
+processes on the global mesh and degenerates to the reference's
+single-worker forced-distributed mode on one host.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+import torch
+
+from ..core import api as _api
+from ..common.handles import Handle
+from .compression import Compression  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "push_pull", "push_pull_async", "poll", "synchronize", "declare",
+    "DistributedOptimizer", "broadcast_parameters",
+    "broadcast_optimizer_state", "Compression",
+]
+
+init = _api.init
+shutdown = _api.shutdown
+rank = _api.rank
+size = _api.size
+local_rank = _api.local_rank
+local_size = _api.local_size
+declare = _api.declare
+
+
+def _to_jnp(t: torch.Tensor):
+    arr = t.detach().cpu().numpy()
+    # ascontiguousarray promotes 0-d to 1-d; reshape restores the rank
+    return np.ascontiguousarray(arr).reshape(arr.shape)
+
+
+def _to_torch(arr, like: torch.Tensor) -> torch.Tensor:
+    # np.array copies: jax buffers are read-only and torch wants writable
+    return torch.from_numpy(np.array(arr)).to(dtype=like.dtype)
+
+
+def push_pull_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None,
+                    priority: Optional[int] = None,
+                    compression: Optional[Dict[str, str]] = None) -> Handle:
+    """Async reduce of this process's tensor across all processes
+    (reference byteps_torch_push_pull_async_*, torch/ops.py:69-76)."""
+    eng = _api._require()
+    return eng.push_pull_local_async(
+        _to_jnp(tensor), name or f"torch.tensor_{id(tensor)}",
+        op="average" if average else "sum",
+        priority=priority, compression=compression)
+
+
+def push_pull(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None,
+              compression: Optional[Dict[str, str]] = None) -> torch.Tensor:
+    h = push_pull_async(tensor, average=average, name=name,
+                        compression=compression)
+    return _to_torch(h.wait(), tensor)
+
+
+def poll(handle: Handle) -> bool:
+    return handle.poll()
+
+
+def synchronize(handle: Handle, like: Optional[torch.Tensor] = None):
+    out = handle.wait()
+    if like is not None:
+        return _to_torch(out, like)
+    return torch.from_numpy(np.array(out))
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a state_dict or named_parameters iterable
+    (reference torch/__init__.py:259-291: zero-non-root + sum push_pull)."""
+    if isinstance(params, dict):
+        items = [(k, v) for k, v in sorted(params.items())
+                 if torch.is_tensor(v)]
+    else:
+        items = [(k, v) for k, v in params if torch.is_tensor(v)]
+    from ..comm.collectives import broadcast as _bcast
+    from ..comm.mesh import get_comm
+    import jax.numpy as jnp
+    comm = get_comm()
+    for name, t in items:
+        stacked = jnp.broadcast_to(
+            jnp.asarray(_to_jnp(t))[None],
+            (comm.num_ranks,) + tuple(t.shape))
+        out = _bcast(comm, stacked, root=root_rank)
+        with torch.no_grad():
+            t.copy_(_to_torch(out, t))
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state tensors in-place (reference
+    torch/__init__.py:292-411 walks the state dict the same way)."""
+    tensors = {}
+    for gi, group in enumerate(optimizer.state_dict()["state"].items()):
+        pid, pstate = group
+        for k, v in pstate.items():
+            if torch.is_tensor(v) and v.numel() > 0:
+                tensors[f"opt.{pid}.{k}"] = v
+    if tensors:
+        broadcast_parameters(tensors, root_rank=root_rank)
+
+
+class DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer: gradients are push_pull-averaged through the
+    engine before every step.
+
+    Reference design (torch/__init__.py:110-214): per-parameter hooks fire
+    as gradients materialize during backward, enqueueing async push_pulls
+    immediately — communication overlaps the rest of backward;
+    ``step()`` synchronizes all handles and runs the inner optimizer.
+    ``backward_passes_per_step`` defers communication across gradient
+    accumulation micro-steps.
+    """
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters: Optional[Iterable[Tuple[str, torch.nn.Parameter]]] = None,
+                 compression: Optional[Dict[str, str]] = None,
+                 backward_passes_per_step: int = 1):
+        self._inner = optimizer
+        self.param_groups = optimizer.param_groups
+        self.defaults = optimizer.defaults
+        self.state = optimizer.state
+        self._compression = compression
+        self._bpps = max(1, int(backward_passes_per_step))
+        self._counts: Dict[torch.nn.Parameter, int] = {}
+        self._handles: Dict[torch.nn.Parameter, Handle] = {}
+        self._hooks = []
+        self._lock = threading.Lock()
+
+        if named_parameters is not None:
+            named = [(n, p) for n, p in named_parameters if p.requires_grad]
+        else:
+            named = [(f"param.{gi}.{pi}", p)
+                     for gi, g in enumerate(optimizer.param_groups)
+                     for pi, p in enumerate(g["params"]) if p.requires_grad]
+        self._named = named
+        # declare in a fixed order on every process so keys (and therefore
+        # priorities) line up (reference declares at optimizer creation)
+        for n, _ in named:
+            _api.declare(f"torch.grad.{n}")
+        self._name_of = {p: n for n, p in named}
+        for _, p in named:
+            h = p.register_post_accumulate_grad_hook(self._make_hook())
+            self._hooks.append(h)
+
+    def _make_hook(self):
+        # Accumulation is counted per-parameter in *backward passes* (the
+        # reference counts hook firings the same way, torch/__init__.py
+        # _push_pull_grad_async gating): communication fires on every
+        # bpps-th backward of each parameter, so both usage patterns work —
+        # "N backwards then one step()" and "step() after every backward"
+        # (intermediate steps are no-ops).
+        def hook(p: torch.nn.Parameter):
+            with self._lock:
+                self._counts[p] = self._counts.get(p, 0) + 1
+                if self._counts[p] % self._bpps != 0:
+                    return  # accumulation micro-step: no communication
+                self._handles[p] = push_pull_async(
+                    p.grad, average=True,
+                    name=f"torch.grad.{self._name_of[p]}",
+                    compression=self._compression)
+        return hook
+
+    def zero_grad(self, set_to_none: bool = True):
+        return self._inner.zero_grad(set_to_none=set_to_none)
+
+    def step(self, closure=None):
+        with self._lock:
+            handles, self._handles = self._handles, {}
+        if not handles and self._bpps > 1:
+            return None  # micro-step: no grads were communicated
+        for p, h in handles.items():
+            out = h.wait()
+            with torch.no_grad():
+                avg = _to_torch(out, p.grad)
+                if self._bpps > 1:
+                    # p.grad accumulated bpps micro-grads; make it their mean
+                    avg = avg / self._bpps
+                p.grad.copy_(avg)
+        return self._inner.step(closure)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._inner.load_state_dict(sd)
+
+    def __del__(self):
+        for h in getattr(self, "_hooks", []):
+            try:
+                h.remove()
+            except Exception:  # noqa: BLE001
+                pass

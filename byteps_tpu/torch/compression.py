@@ -1,0 +1,43 @@
+"""Torch-level compression shims (reference torch/compression.py:1-89).
+
+The reference ships a tensor-level Compression enum (none | fp16) applied
+around push_pull in the plugin, separate from the core compressor engine.
+Same surface here; the heavy compressors (onebit/topk/...) are reached by
+passing a kwargs dict to DistributedOptimizer/push_pull instead (they run
+inside the engine on-device, where they belong on TPU).
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class NoneCompressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace mirroring the reference's ``bps.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,159 @@
+"""Torch adapter tests — the reference's op-correctness shape
+(tests/test_mxnet.py sums tensors against numpy; torch plugin semantics
+from torch/__init__.py).  Single process == the reference's single-worker
+forced-distributed mode: push_pull over one process is identity for
+average, identity for sum."""
+
+import numpy as np
+import pytest
+import torch
+
+import byteps_tpu.torch as bps_torch
+
+
+@pytest.fixture
+def session():
+    bps_torch.init()
+    yield
+    bps_torch.shutdown()
+
+
+def test_push_pull_identity_single_process(session):
+    t = torch.randn(17, 3)
+    out = bps_torch.push_pull(t, average=True, name="t1")
+    np.testing.assert_allclose(out.numpy(), t.numpy(), rtol=1e-5, atol=1e-6)
+    out2 = bps_torch.push_pull(t, average=False, name="t1")
+    np.testing.assert_allclose(out2.numpy(), t.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_push_pull_async_poll_synchronize(session):
+    t = torch.ones(64)
+    h = bps_torch.push_pull_async(t, average=False, name="t2")
+    assert bps_torch.poll(h) in (False, True)  # may complete at any time
+    out = bps_torch.synchronize(h, like=t)
+    assert bps_torch.poll(h)  # after wait it must report done
+    np.testing.assert_allclose(out.numpy(), np.ones(64), rtol=1e-6)
+
+
+def test_broadcast_parameters_inplace(session):
+    model = torch.nn.Linear(4, 2)
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+    bps_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    for k, v in model.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), before[k].numpy(), rtol=1e-6)
+
+
+def test_distributed_optimizer_trains(session):
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(torch.nn.Linear(8, 16), torch.nn.ReLU(),
+                                torch.nn.Linear(16, 1))
+    opt = bps_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    x = torch.randn(32, 8)
+    y = x.sum(dim=1, keepdim=True)
+    losses = []
+    for _ in range(30):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_distributed_optimizer_matches_plain_sgd(session):
+    """Single process: DistributedOptimizer == plain SGD exactly."""
+    torch.manual_seed(1)
+    m1 = torch.nn.Linear(5, 3)
+    m2 = torch.nn.Linear(5, 3)
+    m2.load_state_dict(m1.state_dict())
+    o1 = torch.optim.SGD(m1.parameters(), lr=0.05)
+    o2 = bps_torch.DistributedOptimizer(
+        torch.optim.SGD(m2.parameters(), lr=0.05),
+        named_parameters=m2.named_parameters())
+    x = torch.randn(16, 5)
+    y = torch.randn(16, 3)
+    for _ in range(5):
+        for o, m in ((o1, m1), (o2, m2)):
+            o.zero_grad()
+            torch.nn.functional.mse_loss(m(x), y).backward()
+            o.step()
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.detach().numpy(), p2.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_accumulation_bpps(session):
+    torch.manual_seed(2)
+    m = torch.nn.Linear(4, 1)
+    ref = torch.nn.Linear(4, 1)
+    ref.load_state_dict(m.state_dict())
+    opt = bps_torch.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=0.1),
+        named_parameters=m.named_parameters(),
+        backward_passes_per_step=2)
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+    x = torch.randn(8, 4)
+    y = torch.randn(8, 1)
+    # two micro-batches through the distributed optimizer
+    for i in range(2):
+        xb, yb = x[i * 4:(i + 1) * 4], y[i * 4:(i + 1) * 4]
+        loss = torch.nn.functional.mse_loss(m(xb), yb)
+        loss.backward()
+        opt.step()
+    opt.zero_grad()
+    # reference: average of the two micro-grads in one step
+    ref_opt.zero_grad()
+    l1 = torch.nn.functional.mse_loss(ref(x[:4]), y[:4])
+    l2 = torch.nn.functional.mse_loss(ref(x[4:]), y[4:])
+    ((l1 + l2) / 2).backward()
+    ref_opt.step()
+    for p1, p2 in zip(m.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p1.detach().numpy(), p2.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_accumulation_horovod_pattern(session):
+    """Reference/Horovod style: N backwards, then ONE step()."""
+    torch.manual_seed(3)
+    m = torch.nn.Linear(4, 1)
+    ref = torch.nn.Linear(4, 1)
+    ref.load_state_dict(m.state_dict())
+    opt = bps_torch.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=0.1),
+        named_parameters=m.named_parameters(),
+        backward_passes_per_step=2)
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+    x = torch.randn(8, 4)
+    y = torch.randn(8, 1)
+    torch.nn.functional.mse_loss(m(x[:4]), y[:4]).backward()
+    torch.nn.functional.mse_loss(m(x[4:]), y[4:]).backward()
+    opt.step()  # must sync and update (not silently no-op)
+    ref_opt.zero_grad()
+    l1 = torch.nn.functional.mse_loss(ref(x[:4]), y[:4])
+    l2 = torch.nn.functional.mse_loss(ref(x[4:]), y[4:])
+    ((l1 + l2) / 2).backward()
+    ref_opt.step()
+    for p1, p2 in zip(m.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p1.detach().numpy(), p2.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_broadcast_optimizer_state(session):
+    m = torch.nn.Linear(3, 2)
+    opt = torch.optim.Adam(m.parameters(), lr=1e-3)
+    m(torch.randn(4, 3)).sum().backward()
+    opt.step()
+    bps_torch.broadcast_optimizer_state(opt, root_rank=0)  # no crash, values kept
+    assert len(opt.state_dict()["state"]) > 0
+
+
+def test_fp16_compression_shim():
+    from byteps_tpu.torch.compression import Compression
+    t = torch.randn(10)
+    c, ctx = Compression.fp16.compress(t)
+    assert c.dtype == torch.float16
+    d = Compression.fp16.decompress(c, ctx)
+    assert d.dtype == t.dtype
+    np.testing.assert_allclose(d.numpy(), t.numpy(), atol=1e-2)
