@@ -1,0 +1,112 @@
+"""Chrome-trace timeline of communication.
+
+Reference behavior (SURVEY.md §5): BYTEPS_TRACE_ON/START_STEP/END_STEP/DIR
+select a window of training steps; per-stage begin timestamps are recorded
+as tasks enter queues and durations closed in FinishOrProceed; an async
+JSON emitter writes a chrome://tracing-compatible file per local rank
+(reference global.cc:113-124,469-564, scheduled_queue.cc:105-123,
+docs/timeline.md).
+
+TPU collapse: the interesting stages are ENQUEUE (push_pull called ->
+scheduler), DISPATCH (scheduler -> collective issued) and EXECUTE
+(issue -> device completion observed).  Events are emitted per chunk with
+the tensor name as the track, so the timeline shows exactly what the
+reference's shows: which gradients waited on the scheduler and how
+communication overlapped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .config import get_config
+from .logging import get_logger
+
+
+class Tracer:
+    """Collects per-chunk phase events and writes chrome trace JSON."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 start_step: Optional[int] = None,
+                 end_step: Optional[int] = None,
+                 out_dir: Optional[str] = None):
+        cfg = get_config()
+        self.enabled = cfg.trace_on if enabled is None else enabled
+        self.start_step = (cfg.trace_start_step if start_step is None
+                           else start_step)
+        self.end_step = cfg.trace_end_step if end_step is None else end_step
+        self.out_dir = cfg.trace_dir if out_dir is None else out_dir
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._step: Dict[str, int] = {}   # tensor name -> seen pushes
+        self._written = False
+
+    # -- step bookkeeping ---------------------------------------------------
+    def on_push(self, name: str) -> int:
+        """Count per-tensor pushes; the max defines the global step
+        (the reference keys its window on per-tensor step counts too)."""
+        with self._lock:
+            self._step[name] = self._step.get(name, 0) + 1
+            return self._step[name]
+
+    def _in_window(self, step: int) -> bool:
+        return self.start_step <= step <= self.end_step
+
+    # -- event recording ----------------------------------------------------
+    def record(self, name: str, key: int, phase: str, t_begin: float,
+               t_end: float, step: int, nbytes: int = 0) -> None:
+        if not self.enabled:
+            return
+        if step > self.end_step:
+            # first event past the window: every in-window event has been
+            # recorded, emit once (shutdown covers the no-later-steps case)
+            self.flush()
+            return
+        if not self._in_window(step):
+            return
+        with self._lock:
+            self._events.append({
+                "name": phase,
+                "cat": "comm",
+                "ph": "X",                      # complete event
+                "ts": t_begin * 1e6,            # chrome wants microseconds
+                "dur": max(0.0, (t_end - t_begin) * 1e6),
+                "pid": os.getpid(),
+                "tid": name,                    # one track per tensor
+                "args": {"key": key, "step": step, "bytes": nbytes},
+            })
+
+    # -- emission -----------------------------------------------------------
+    def flush(self, path: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            if not self.enabled or (self._written and path is None):
+                return None
+            events = list(self._events)
+            self._written = True
+        if not events:
+            return None
+        if path is None:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir,
+                                f"bps_trace_rank0_{os.getpid()}.json")
+        # map string tids to ints (chrome requires numeric tid) but keep
+        # names via metadata events, as the reference's emitter does
+        tids = {}
+        out = []
+        for e in events:
+            tid = tids.setdefault(e["tid"], len(tids))
+            out.append({**e, "tid": tid})
+        for name, tid in tids.items():
+            out.append({"name": "thread_name", "ph": "M", "pid": os.getpid(),
+                        "tid": tid, "args": {"name": name}})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+        get_logger().info("wrote comm trace: %s (%d events)", path, len(out))
+        return path
+
+    def now(self) -> float:
+        return time.monotonic()

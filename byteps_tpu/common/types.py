@@ -142,6 +142,10 @@ class ChunkTask:
     # set by the engine for compressed tensors: the per-chunk compression
     # slot (reference BPSContext.compressor_list, common.h:177-205)
     compression: Any = None
+    # tracing (reference recorderTs, scheduled_queue.cc:105-123)
+    step: int = 0
+    t_enqueue: float = 0.0
+    t_dispatch: float = 0.0
 
     # Sort order matches the reference's addTask comparator: priority desc,
     # then key asc (scheduled_queue.cc:82-102).

@@ -37,6 +37,7 @@ from ..common.logging import get_logger
 from ..common.registry import TensorRegistry
 from ..common.scheduler import ChunkScheduler
 from ..common.telemetry import SpeedMonitor
+from ..common.tracing import Tracer
 from ..common.types import ChunkTask, Status, TensorContext
 
 
@@ -106,6 +107,7 @@ class PushPullEngine:
         self.handles = HandleManager()
         self.scheduler = ChunkScheduler(credit_bytes=cfg.scheduling_credit)
         self.speed = SpeedMonitor()
+        self.tracer = Tracer()
         self._sync_q: "queue.Queue" = queue.Queue()
         self._running = True
         self._dispatcher = threading.Thread(
@@ -153,6 +155,11 @@ class PushPullEngine:
             version = ctx.version
 
         self._ensure_compression(ctx, stacked.dtype)
+        if self.tracer.enabled:
+            step = self.tracer.on_push(name)
+            t_enq = self.tracer.now()
+        else:  # keep the hot enqueue path lock-free when tracing is off
+            step, t_enq = 0, 0.0
         flat = stacked.reshape(r, -1)
         itemsize = np.dtype(stacked.dtype).itemsize
         nchunks = len(ctx.chunk_bounds)
@@ -165,6 +172,7 @@ class PushPullEngine:
                 data=chunk,
                 compression=(ctx.compressor[part_idx]
                              if ctx.compressor else None),
+                step=step, t_enqueue=t_enq,
             )
             task.callback = self._make_chunk_callback(pending, part_idx)
             self.scheduler.add_task(task)
@@ -223,6 +231,7 @@ class PushPullEngine:
             task = self.scheduler.get_task(block=True, timeout=0.05)
             if task is None:
                 continue
+            task.t_dispatch = self.tracer.now()
             try:
                 slot = task.compression
                 rollback = None
@@ -265,6 +274,14 @@ class PushPullEngine:
                         slot.wstates = wst
                         slot.sstate = sst
             self.scheduler.report_finish(task.nbytes)
+            if self.tracer.enabled:
+                t_done = self.tracer.now()
+                self.tracer.record(task.name, task.key, "queued",
+                                   task.t_enqueue, task.t_dispatch,
+                                   task.step, task.nbytes)
+                self.tracer.record(task.name, task.key, "push_pull",
+                                   task.t_dispatch, t_done, task.step,
+                                   task.nbytes)
             if self.cfg.telemetry_on:
                 # push + pull wire bytes; compressed chunks report payload
                 # size, which is the point of the feature
@@ -294,6 +311,7 @@ class PushPullEngine:
         self._sync_q.put(_SHUTDOWN)
         self._syncer.join(timeout=5)
         self.handles.clear()
+        self.tracer.flush()
 
     def push_pull(self, stacked, name: str, **kw):
         """Synchronous push_pull; returns the reduced array."""

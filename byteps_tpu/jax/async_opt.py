@@ -1,0 +1,70 @@
+"""Asynchronous-PS training mode (BYTEPS_ENABLE_ASYNC equivalent).
+
+Reference behavior (torch/__init__.py:186-214, server.cc:310-314): each
+worker trains locally, pushes the *weight delta* of its step to the server
+(summed on arrival, no barrier), and pulls the current global weights —
+trading gradient-consistency for the absence of stragglers' barriers.
+
+Here the server is the host-side KVStore (byteps_tpu.server): the same
+push-delta / pull-fresh cycle, per named leaf, with no step barrier between
+workers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..server import KVStore
+
+
+class AsyncDistributedOptimizer:
+    """optax wrapper implementing the async weight-delta protocol."""
+
+    def __init__(self, tx: optax.GradientTransformation,
+                 store: Optional[KVStore] = None,
+                 name_prefix: str = "async"):
+        self._tx = tx
+        self._store = store if store is not None else KVStore()
+        self._prefix = name_prefix
+        self._names = None
+
+    @property
+    def store(self) -> KVStore:
+        return self._store
+
+    def _leaf_names(self, tree):
+        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return [self._prefix + jax.tree_util.keystr(p) for p, _ in paths]
+
+    def init(self, params):
+        """Registers every parameter leaf with the store (the init-push
+        barrier of the reference, server.cc:261-289) and returns optax
+        state."""
+        self._names = self._leaf_names(params)
+        for name, leaf in zip(self._names,
+                              jax.tree_util.tree_leaves(params)):
+            self._store.init_key(name, np.asarray(leaf))
+        return self._tx.init(params)
+
+    def update_and_sync(self, grads, state, params) -> Tuple:
+        """One async step: local update -> push delta -> pull fresh.
+
+        Returns (fresh_params, new_state).  No barrier: concurrent workers
+        interleave their deltas in arrival order, exactly the server's
+        sum-on-arrival semantics.
+        """
+        updates, state = self._tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        leaves_old = jax.tree_util.tree_leaves(params)
+        leaves_new = jax.tree_util.tree_leaves(new_params)
+        treedef = jax.tree_util.tree_structure(params)
+        fresh = []
+        for name, old, new in zip(self._names, leaves_old, leaves_new):
+            self._store.push_delta(name, np.asarray(new) - np.asarray(old))
+            fresh.append(jnp.asarray(self._store.pull(name)))
+        return jax.tree_util.tree_unflatten(treedef, fresh), state

@@ -1,0 +1,10 @@
+"""Asynchronous parameter-store semantics (reference byteps/server/).
+
+On TPU the synchronous path needs no server at all — ``psum`` over the mesh
+*is* the sum-and-barrier (SURVEY.md §2.3).  What still needs server
+semantics is asynchronous training (BYTEPS_ENABLE_ASYNC, reference
+server.cc:310-314,417-419): workers push weight *deltas* and pull fresh
+weights with no barrier.  kv_store.py provides that as a host-side store.
+"""
+
+from .kv_store import KVStore  # noqa: F401

@@ -1,0 +1,68 @@
+"""Host-side KV store: async-PS semantics without a server process.
+
+Reference behavior being reproduced (server.cc):
+- init-push allocates the store and acks after all workers arrive — a
+  barrier (server.cc:261-289); here ``init_key`` is idempotent and the
+  mesh bootstrap is the barrier.
+- async mode: pushes are summed into the store on arrival, no per-step
+  barrier (server.cc:310-314); pulls return the current value immediately
+  (server.cc:371-404).
+- per-key engine-thread assignment and priority queues (server.cc:77-198)
+  collapse away: summation here is numpy on the host (or the engine's
+  collective when several local ranks contribute one delta each).
+
+Single-process scope: this store backs the async training mode for all
+ranks under one controller.  A cross-host replicated store (gossip over
+DCN collectives) is the natural extension and rides the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class KVStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, np.ndarray] = {}
+        self._versions: Dict[str, int] = {}
+
+    def init_key(self, key: str, value) -> None:
+        """Idempotent first-push initialization (reference init-push
+        barrier, server.cc:261-289)."""
+        with self._lock:
+            if key not in self._store:
+                self._store[key] = np.array(value, copy=True)
+                self._versions[key] = 0
+
+    def push_delta(self, key: str, delta) -> int:
+        """Sum a delta into the store (async SUM_RECV path); returns the
+        new version."""
+        with self._lock:
+            if key not in self._store:
+                raise KeyError(f"key {key!r} not initialized")
+            self._store[key] += np.asarray(delta)
+            self._versions[key] += 1
+            return self._versions[key]
+
+    def pull(self, key: str) -> np.ndarray:
+        """Return the current value (no barrier — async pull,
+        server.cc:371-404)."""
+        with self._lock:
+            return self._store[key].copy()
+
+    def version(self, key: str) -> int:
+        with self._lock:
+            return self._versions.get(key, -1)
+
+    def keys(self):
+        with self._lock:
+            return list(self._store)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+            self._versions.clear()

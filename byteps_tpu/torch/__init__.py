@@ -54,6 +54,18 @@ def _to_torch(arr, like: torch.Tensor) -> torch.Tensor:
     return torch.from_numpy(np.array(arr)).to(dtype=like.dtype)
 
 
+_anon_counter = [0]
+_anon_lock = threading.Lock()
+
+
+def _anon_name() -> str:
+    # monotonic, never reused (id()-based names collide when CPython
+    # recycles addresses of freed tensors)
+    with _anon_lock:
+        _anon_counter[0] += 1
+        return f"torch.tensor_{_anon_counter[0]}"
+
+
 def push_pull_async(tensor: torch.Tensor, average: bool = True,
                     name: Optional[str] = None,
                     priority: Optional[int] = None,
@@ -62,7 +74,7 @@ def push_pull_async(tensor: torch.Tensor, average: bool = True,
     (reference byteps_torch_push_pull_async_*, torch/ops.py:69-76)."""
     eng = _api._require()
     return eng.push_pull_local_async(
-        _to_jnp(tensor), name or f"torch.tensor_{id(tensor)}",
+        _to_jnp(tensor), name or _anon_name(),
         op="average" if average else "sum",
         priority=priority, compression=compression)
 
@@ -96,12 +108,13 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
         items = [(k, v) for k, v in params if torch.is_tensor(v)]
     from ..comm.collectives import broadcast as _bcast
     from ..comm.mesh import get_comm
-    import jax.numpy as jnp
     comm = get_comm()
     for name, t in items:
-        stacked = jnp.broadcast_to(
-            jnp.asarray(_to_jnp(t))[None],
-            (comm.num_ranks,) + tuple(t.shape))
+        # zero-copy host broadcast view: device_put inside the collective
+        # reads one [1, n] slice per device (a device-side broadcast_to
+        # would materialize num_ranks x param in HBM first)
+        arr = _to_jnp(t)
+        stacked = np.broadcast_to(arr[None], (comm.num_ranks,) + arr.shape)
         out = _bcast(comm, stacked, root=root_rank)
         with torch.no_grad():
             t.copy_(_to_torch(out, t))

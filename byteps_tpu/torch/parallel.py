@@ -1,0 +1,172 @@
+"""DistributedDataParallel and CrossBarrier for the torch frontend.
+
+Reference components (SURVEY.md §2.4/§2.6):
+
+- ``DistributedDataParallel`` (reference torch/parallel/distributed.py:
+  13-287): module wrapper that allreduces gradients during backward, with
+  ``no_sync()`` for gradient-accumulation windows and group-sync counting.
+- ``CrossBarrier`` (reference torch/cross_barrier.py:28-120, the
+  ByteScheduler idea): remove the global end-of-iteration barrier —
+  ``optimizer.step()`` returns immediately and each layer's update is
+  applied just-in-time by a forward pre-hook when the *next* iteration
+  first touches that layer, so communication of late layers overlaps the
+  next forward pass.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional
+
+import torch
+
+from ..common.handles import Handle
+from . import push_pull_async, _to_torch
+
+
+class DistributedDataParallel(torch.nn.Module):
+    """Drop-in DDP: gradients are engine-push_pulled during backward and
+    written back before backward returns (an autograd engine callback),
+    so any optimizer can step immediately after ``loss.backward()``."""
+
+    def __init__(self, module: torch.nn.Module,
+                 compression: Optional[Dict[str, str]] = None):
+        super().__init__()
+        self.module = module
+        self._compression = compression
+        self._sync = True
+        self._handles: Dict[torch.nn.Parameter, Handle] = {}
+        self._callback_queued = False
+        self._lock = threading.Lock()
+        self._name_of = {p: n for n, p in module.named_parameters()
+                         if p.requires_grad}
+        from ..core import api as _api
+        for n in self._name_of.values():
+            _api.declare(f"ddp.grad.{n}")
+        for p in self._name_of:
+            p.register_post_accumulate_grad_hook(self._hook)
+
+    # -- sync control (reference no_sync, parallel/distributed.py:184-207)
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Skip gradient synchronization inside the context (accumulation);
+        the next backward outside communicates the accumulated grads."""
+        old = self._sync
+        self._sync = False
+        try:
+            yield
+        finally:
+            self._sync = old
+
+    def _hook(self, p: torch.nn.Parameter):
+        if not self._sync:
+            return
+        with self._lock:
+            self._handles[p] = push_pull_async(
+                p.grad, average=True, name=f"ddp.grad.{self._name_of[p]}",
+                compression=self._compression)
+            if not self._callback_queued:
+                # fires once after the whole backward graph executed —
+                # the point where reference DDP's reducer finalizes
+                torch.autograd.Variable._execution_engine.queue_callback(
+                    self._finalize_backward)
+                self._callback_queued = True
+
+    def _finalize_backward(self):
+        with self._lock:
+            handles, self._handles = self._handles, {}
+            self._callback_queued = False
+        for p, h in handles.items():
+            out = h.wait()
+            with torch.no_grad():
+                p.grad.copy_(_to_torch(out, p.grad))
+
+    def forward(self, *args, **kwargs):
+        return self.module(*args, **kwargs)
+
+
+class CrossBarrier:
+    """Cross-iteration scheduling: step() returns without waiting; each
+    layer's averaged gradient is applied just-in-time when the next forward
+    reaches that layer (reference cross_barrier.py:28-120).
+
+    Wraps (model, optimizer).  Per-layer application uses the grad=None
+    masking property of torch optimizers (params with ``grad is None`` are
+    skipped), so any optimizer works unmodified.
+    """
+
+    def __init__(self, model: torch.nn.Module,
+                 optimizer: torch.optim.Optimizer,
+                 compression: Optional[Dict[str, str]] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self._compression = compression
+        self._pending: Dict[torch.nn.Parameter, Handle] = {}
+        self._lock = threading.Lock()
+        self._name_of = {p: n for n, p in model.named_parameters()
+                         if p.requires_grad}
+        from ..core import api as _api
+        for n in self._name_of.values():
+            _api.declare(f"xb.grad.{n}")
+        for p in self._name_of:
+            p.register_post_accumulate_grad_hook(self._grad_hook)
+        # forward pre-hooks: the "locks" of the reference design
+        for mod in model.modules():
+            own = [p for p in mod.parameters(recurse=False)
+                   if p in self._name_of]
+            if own:
+                mod.register_forward_pre_hook(self._make_gate(own))
+
+    def _grad_hook(self, p: torch.nn.Parameter):
+        with self._lock:
+            self._pending[p] = push_pull_async(
+                p.grad, average=True, name=f"xb.grad.{self._name_of[p]}",
+                compression=self._compression)
+
+    def step(self) -> None:
+        """Non-blocking: updates apply lazily at the next forward.
+        (The reference's wrapped step similarly returns before pulls
+        complete.)"""
+        return None
+
+    def _apply_params(self, params: List[torch.nn.Parameter]) -> None:
+        with self._lock:
+            todo = [(p, self._pending.pop(p)) for p in params
+                    if p in self._pending]
+        if not todo:
+            return
+        for p, h in todo:
+            out = h.wait()
+            with torch.no_grad():
+                avg = _to_torch(out, p)
+                if p.grad is None:   # zero_grad(set_to_none=True) ran
+                    p.grad = avg
+                else:
+                    p.grad.copy_(avg)
+        # step only these params: mask everything else with grad=None
+        saved = []
+        group_params = [q for g in self.optimizer.param_groups
+                        for q in g["params"]]
+        chosen = set(id(p) for p, _ in todo)
+        for q in group_params:
+            if id(q) not in chosen and q.grad is not None:
+                saved.append((q, q.grad))
+                q.grad = None
+        try:
+            self.optimizer.step()
+        finally:
+            for q, g in saved:
+                q.grad = g
+        for p, _ in todo:
+            p.grad = None
+
+    def _make_gate(self, params: List[torch.nn.Parameter]):
+        def gate(module, inputs):
+            self._apply_params(params)
+        return gate
+
+    def synchronize(self) -> None:
+        """Barrier: apply every pending update now (end of training, eval,
+        checkpointing)."""
+        self._apply_params(list(self._name_of))
