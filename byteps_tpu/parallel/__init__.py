@@ -2,8 +2,9 @@
 
 The reference implements data parallelism only (SURVEY.md §2.6); this
 package holds its TPU-native equivalent (data_parallel.py: fused DP training
-steps over the (dcn, ici) mesh) plus the DDP-style module wrapper and
-cross-barrier pipelining as they land.
+steps over the (dcn, ici) mesh) plus first-class sequence/context
+parallelism (sequence.py: ring attention over ppermute, Ulysses
+all-to-all) that the reference lacks but long-context TPU training needs.
 """
 
 from .data_parallel import (  # noqa: F401
@@ -11,4 +12,12 @@ from .data_parallel import (  # noqa: F401
     make_dp_train_step,
     replicate,
     shard_batch,
+)
+from .sequence import (  # noqa: F401
+    full_attention,
+    make_sp_attention,
+    make_sp_mesh,
+    ring_attention,
+    sp_mesh_from_comm,
+    ulysses_attention,
 )

@@ -1,0 +1,215 @@
+"""Sequence / context parallelism: ring attention + Ulysses all-to-all.
+
+The reference implements data parallelism only (SURVEY.md §2.6) — its unit
+of partitioning is the gradient byte-stream, never the sequence axis.  For a
+TPU-native framework long-context training is first-class, so this module
+adds the two standard sequence-parallel attention schemes as traceable
+collectives over a named mesh axis:
+
+- :func:`ring_attention` — blockwise attention with the K/V shards rotating
+  around the ring via ``lax.ppermute`` while a flash-style online softmax
+  (running max / running normalizer) accumulates the output.  Memory per
+  device is O(T/sp); the K/V rotation rides the ICI ring.
+- :func:`ulysses_attention` — DeepSpeed-Ulysses-style: two ``all_to_all``s
+  reshard (seq-sharded, all heads) → (head-sharded, full seq), run exact
+  local attention, and reshard back.  Cheaper compute, needs heads % sp == 0.
+
+Both are pure jnp + collective primitives, hence differentiable and fusable
+by XLA; both match single-device full attention bit-for-bit up to float
+associativity (see tests/test_sequence_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Finite stand-in for -inf: exp(NEG - anything_real) underflows to exactly 0
+# in f32, so fully-masked blocks contribute nothing once a real block lands.
+_NEG = -1e30
+
+DP_AXIS = "dp"
+SP_AXIS = "sp"
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   causal: bool = False,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Reference exact attention. [B, Tq, H, D] x [B, Tk, H, D] -> [B, Tq, H, D]."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        # Decode-style alignment: q covers the *last* Tq positions of the
+        # key sequence (no-op when Tq == Tk).
+        q_pos = (k.shape[1] - q.shape[1]) + jnp.arange(q.shape[1])
+        k_pos = jnp.arange(k.shape[1])
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = SP_AXIS, *,
+                   causal: bool = False,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over sequence shards.  Call inside shard_map.
+
+    Every device holds [B, T/sp, H, D] shards of q/k/v (sequence axis 1
+    sharded over ``axis_name`` in ring order).  The K/V block circulates the
+    ring; each of the sp steps does one blockwise attention against the
+    resident block and folds it into the online-softmax accumulators.
+
+    Returns the attention output for the local q shard, same shape/dtype
+    as q.  Differentiable (pure lax ops — JAX transposes the ppermutes).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+
+    m = jnp.full((b, h, tq), _NEG, dtype=jnp.float32)
+    l = jnp.zeros((b, h, tq), dtype=jnp.float32)
+    o = jnp.zeros((b, h, tq, d), dtype=jnp.float32)
+    q_pos = my * tq + jnp.arange(tq)
+
+    def fold(m, l, o, k, v, step):
+        # The resident block started at rank (my - step) mod n.
+        src = (my - step) % n
+
+        def attend(m, l, o):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) * scale
+            if causal:
+                k_pos = src * tk + jnp.arange(tk)
+                s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + p.sum(axis=-1)
+            o = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+            return m_new, l, o
+
+        if not causal:
+            return attend(m, l, o)
+        # Skip blocks that are entirely in the future (all masked): without
+        # this ~half the ring's QK^T/PV FLOPs compute _NEG blocks only to be
+        # underflowed away.  The predicate diverges across devices, which is
+        # safe — attend() contains no collectives (the ppermute lives in the
+        # caller, outside the cond).
+        visible = src * tk <= my * tq + (tq - 1)
+        return lax.cond(visible, attend, lambda m, l, o: (m, l, o), m, l, o)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        m, l, o, k, v = carry
+        m, l, o = fold(m, l, o, k, v, step)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return m, l, o, k, v
+
+    # Last block is folded outside the loop so its rotation (whose result
+    # would be discarded) never hits the ring.
+    m, l, o, k, v = lax.fori_loop(0, n - 1, body, (m, l, o, k, v))
+    m, l, o = fold(m, l, o, k, v, n - 1)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = SP_AXIS, *,
+                      causal: bool = False,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """Ulysses sequence parallelism: all-to-all reshard, exact local attention.
+
+    Input shards are [B, T/sp, H, D]; the first all_to_all makes them
+    [B, T, H/sp, D] (full sequence, a slice of heads), attention is exact,
+    and the second all_to_all restores the sequence sharding.  Requires
+    H % sp == 0.  Call inside shard_map.
+    """
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by sp ({n})")
+
+    def seq_to_head(x):  # [B, T/sp, H, D] -> [B, T, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head_to_seq(x):  # [B, T, H/sp, D] -> [B, T/sp, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    out = full_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    return head_to_seq(out)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-level wrappers
+# ---------------------------------------------------------------------------
+
+def make_sp_mesh(devices: Optional[Sequence] = None,
+                 n_sp: Optional[int] = None) -> Mesh:
+    """A (dp, sp) mesh over ``devices``.  sp defaults to all devices.
+
+    The sp axis is laid out over the fastest-varying device dimension so
+    the K/V rotation rides neighboring ICI links.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    n_sp = n_sp or devs.size
+    if devs.size % n_sp:
+        raise ValueError(f"{devs.size} devices not divisible by sp={n_sp}")
+    return Mesh(devs.reshape(devs.size // n_sp, n_sp),
+                axis_names=(DP_AXIS, SP_AXIS))
+
+
+def sp_mesh_from_comm(comm, n_sp: Optional[int] = None) -> Mesh:
+    """Derive a (dp, sp) mesh from a bootstrapped CommContext.
+
+    Bridges the (dcn, ici) communication mesh to sequence parallelism:
+    the sp ring is carved out of the ICI dimension (never across DCN —
+    rotating K/V blocks over the data-center network would gate every
+    attention layer on DCN latency), dp covers the rest.
+    """
+    n_sp = n_sp or comm.n_ici
+    if comm.n_ici % n_sp:
+        raise ValueError(
+            f"ici size {comm.n_ici} not divisible by sp={n_sp}")
+    return make_sp_mesh(comm.mesh.devices.reshape(-1), n_sp)
+
+
+def make_sp_attention(mesh: Mesh, kind: str = "ring", *,
+                      causal: bool = False,
+                      sm_scale: Optional[float] = None) -> Callable:
+    """Shard-mapped attention over a (dp, sp) mesh.
+
+    Returns ``attn(q, k, v)`` taking [B, T, H, D] arrays (batch sharded
+    over dp, sequence over sp) and returning the same.  ``kind`` is
+    "ring" or "ulysses".
+    """
+    if kind == "ring":
+        inner = functools.partial(ring_attention, axis_name=SP_AXIS,
+                                  causal=causal, sm_scale=sm_scale)
+    elif kind == "ulysses":
+        inner = functools.partial(ulysses_attention, axis_name=SP_AXIS,
+                                  causal=causal, sm_scale=sm_scale)
+    else:
+        raise ValueError(f"unknown sequence-parallel kind: {kind!r}")
+
+    spec = P(DP_AXIS, SP_AXIS, None, None)
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
