@@ -43,7 +43,7 @@ class Tracer:
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self._step: Dict[str, int] = {}   # tensor name -> seen pushes
-        self._written = False
+        self._written_count = 0           # events already on disk
 
     # -- step bookkeeping ---------------------------------------------------
     def on_push(self, name: str) -> int:
@@ -62,9 +62,15 @@ class Tracer:
         if not self.enabled:
             return
         if step > self.end_step:
-            # first event past the window: every in-window event has been
-            # recorded, emit once (shutdown covers the no-later-steps case)
-            self.flush()
+            # completions arrive in scheduler order, not step order: another
+            # tensor's in-window chunks may still be in flight, so only emit
+            # once EVERY tracked tensor has stepped past the window
+            # (shutdown's flush covers runs that stop inside it; flush is
+            # idempotent-rewrite, so a late straggler is never lost)
+            with self._lock:
+                done = all(s > self.end_step for s in self._step.values())
+            if done:
+                self.flush()
             return
         if not self._in_window(step):
             return
@@ -83,16 +89,25 @@ class Tracer:
     # -- emission -----------------------------------------------------------
     def flush(self, path: Optional[str] = None) -> Optional[str]:
         with self._lock:
-            if not self.enabled or (self._written and path is None):
+            if not self.enabled:
                 return None
             events = list(self._events)
-            self._written = True
+            if path is None and len(events) == self._written_count:
+                return None          # nothing new since the last write
+            self._written_count = len(events)
         if not events:
             return None
         if path is None:
             os.makedirs(self.out_dir, exist_ok=True)
+            # one file per process rank, like the reference's per-local-rank
+            # emitter (global.cc:469-564); pid keeps restarts distinct
+            try:
+                import jax
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
             path = os.path.join(self.out_dir,
-                                f"bps_trace_rank0_{os.getpid()}.json")
+                                f"bps_trace_rank{rank}_{os.getpid()}.json")
         # map string tids to ints (chrome requires numeric tid) but keep
         # names via metadata events, as the reference's emitter does
         tids = {}

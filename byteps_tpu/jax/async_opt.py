@@ -58,6 +58,11 @@ class AsyncDistributedOptimizer:
         interleave their deltas in arrival order, exactly the server's
         sum-on-arrival semantics.
         """
+        if self._names is None:
+            raise RuntimeError(
+                "AsyncDistributedOptimizer.init(params) must be called "
+                "before update_and_sync — it registers the parameter keys "
+                "with the store (the reference's init-push barrier)")
         updates, state = self._tx.update(grads, state, params)
         new_params = optax.apply_updates(params, updates)
         leaves_old = jax.tree_util.tree_leaves(params)

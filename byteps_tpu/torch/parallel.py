@@ -104,6 +104,8 @@ class CrossBarrier:
         self._compression = compression
         self._pending: Dict[torch.nn.Parameter, Handle] = {}
         self._lock = threading.Lock()
+        self._opt_params: Optional[List[torch.nn.Parameter]] = None
+        self._opt_count = -1
         self._name_of = {p: n for n, p in model.named_parameters()
                          if p.requires_grad}
         from ..core import api as _api
@@ -120,8 +122,12 @@ class CrossBarrier:
 
     def _grad_hook(self, p: torch.nn.Parameter):
         with self._lock:
+            # clone: the handle outlives this backward (it resolves at the
+            # NEXT forward's gate), so the engine must not hold a view of
+            # p.grad that the user may zero/mutate between iterations
             self._pending[p] = push_pull_async(
-                p.grad, average=True, name=f"xb.grad.{self._name_of[p]}",
+                p.grad.detach().clone(), average=True,
+                name=f"xb.grad.{self._name_of[p]}",
                 compression=self._compression)
 
     def step(self) -> None:
@@ -146,8 +152,7 @@ class CrossBarrier:
                     p.grad.copy_(avg)
         # step only these params: mask everything else with grad=None
         saved = []
-        group_params = [q for g in self.optimizer.param_groups
-                        for q in g["params"]]
+        group_params = self._flat_opt_params()
         chosen = set(id(p) for p, _ in todo)
         for q in group_params:
             if id(q) not in chosen and q.grad is not None:
@@ -160,6 +165,16 @@ class CrossBarrier:
                 q.grad = g
         for p, _ in todo:
             p.grad = None
+
+    def _flat_opt_params(self) -> List[torch.nn.Parameter]:
+        """Flattened optimizer params, cached — gates fire every forward,
+        so the flatten must not be O(groups*params) per module."""
+        count = sum(len(g["params"]) for g in self.optimizer.param_groups)
+        if self._opt_params is None or count != self._opt_count:
+            self._opt_params = [q for g in self.optimizer.param_groups
+                                for q in g["params"]]
+            self._opt_count = count
+        return self._opt_params
 
     def _make_gate(self, params: List[torch.nn.Parameter]):
         def gate(module, inputs):
