@@ -62,15 +62,12 @@ class Tracer:
         if not self.enabled:
             return
         if step > self.end_step:
-            # completions arrive in scheduler order, not step order: another
-            # tensor's in-window chunks may still be in flight, so only emit
-            # once EVERY tracked tensor has stepped past the window
-            # (shutdown's flush covers runs that stop inside it; flush is
-            # idempotent-rewrite, so a late straggler is never lost)
-            with self._lock:
-                done = all(s > self.end_step for s in self._step.values())
-            if done:
-                self.flush()
+            # flush as soon as any tensor steps past the window: flush is an
+            # idempotent rewrite gated on unwritten events, so in-flight
+            # stragglers from other tensors just trigger one more rewrite
+            # later (waiting for ALL tensors would lose the trace when a
+            # frozen/conditional tensor never advances and the job is killed)
+            self.flush()
             return
         if not self._in_window(step):
             return
@@ -91,9 +88,9 @@ class Tracer:
         with self._lock:
             if not self.enabled:
                 return None
-            events = list(self._events)
-            if path is None and len(events) == self._written_count:
+            if path is None and len(self._events) == self._written_count:
                 return None          # nothing new since the last write
+            events = list(self._events)
             self._written_count = len(events)
         if not events:
             return None

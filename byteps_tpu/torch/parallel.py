@@ -104,8 +104,6 @@ class CrossBarrier:
         self._compression = compression
         self._pending: Dict[torch.nn.Parameter, Handle] = {}
         self._lock = threading.Lock()
-        self._opt_params: Optional[List[torch.nn.Parameter]] = None
-        self._opt_count = -1
         self._name_of = {p: n for n, p in model.named_parameters()
                          if p.requires_grad}
         from ..core import api as _api
@@ -167,14 +165,10 @@ class CrossBarrier:
             p.grad = None
 
     def _flat_opt_params(self) -> List[torch.nn.Parameter]:
-        """Flattened optimizer params, cached — gates fire every forward,
-        so the flatten must not be O(groups*params) per module."""
-        count = sum(len(g["params"]) for g in self.optimizer.param_groups)
-        if self._opt_params is None or count != self._opt_count:
-            self._opt_params = [q for g in self.optimizer.param_groups
-                                for q in g["params"]]
-            self._opt_count = count
-        return self._opt_params
+        """Flattened optimizer params, read fresh each call so param-group
+        edits (including same-length swaps) are always seen."""
+        return [q for g in self.optimizer.param_groups
+                for q in g["params"]]
 
     def _make_gate(self, params: List[torch.nn.Parameter]):
         def gate(module, inputs):
