@@ -77,6 +77,9 @@ class Config:
     # --- compression ---
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES
 
+    # --- native core ---
+    use_native: bool = True          # BYTEPS_NATIVE: C++ scheduler/reducer
+
     # --- modes ---
     enable_async: bool = False       # BYTEPS_ENABLE_ASYNC (async-PS weight deltas)
 
@@ -114,6 +117,7 @@ class Config:
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
             enable_priority=_env_bool("BYTEPS_ENABLE_PRIORITY", True),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            use_native=_env_bool("BYTEPS_NATIVE", True),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC", False),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON", False),

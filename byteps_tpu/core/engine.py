@@ -105,7 +105,7 @@ class PushPullEngine:
         self.cfg = cfg
         self.registry = TensorRegistry()
         self.handles = HandleManager()
-        self.scheduler = ChunkScheduler(credit_bytes=cfg.scheduling_credit)
+        self.scheduler = self._make_scheduler(cfg)
         self.speed = SpeedMonitor()
         self.tracer = Tracer()
         self._sync_q: "queue.Queue" = queue.Queue()
@@ -116,6 +116,19 @@ class PushPullEngine:
             target=self._sync_loop, name="bps-sync", daemon=True)
         self._dispatcher.start()
         self._syncer.start()
+
+    @staticmethod
+    def _make_scheduler(cfg: Config):
+        """Native C++ priority/credit queue when available (the reference's
+        scheduler is C++ too, scheduled_queue.cc); Python heap otherwise."""
+        if cfg.use_native:
+            try:
+                from ..native import NativeChunkScheduler
+                return NativeChunkScheduler(
+                    credit_bytes=cfg.scheduling_credit)
+            except Exception:  # noqa: BLE001 - toolchain may be absent
+                get_logger().info("falling back to Python chunk scheduler")
+        return ChunkScheduler(credit_bytes=cfg.scheduling_credit)
 
     # ------------------------------------------------------------------ API
     def push_pull_async(self, stacked, name: str,

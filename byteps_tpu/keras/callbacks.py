@@ -29,14 +29,8 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
     def on_batch_end(self, batch, logs=None):
         if self.broadcast_done:
             return
-        from ..tensorflow import broadcast_variables
-        broadcast_variables(self.model.variables, self.root_rank)
-        if getattr(self.model, "optimizer", None) is not None:
-            opt_vars = getattr(self.model.optimizer, "variables", None)
-            if callable(opt_vars):  # tf.keras legacy exposes a method
-                opt_vars = opt_vars()
-            if opt_vars:
-                broadcast_variables(opt_vars, self.root_rank)
+        from . import broadcast_global_variables
+        broadcast_global_variables(self.model, self.root_rank)
         self.broadcast_done = True
 
 

@@ -1,0 +1,268 @@
+"""ctypes bindings for the native runtime core (core.cc).
+
+The reference ships its runtime as C++ shared libraries built by setup.py
+and loaded with ctypes (byteps/common/__init__.py:52-139 BytePSBasics).
+Same shape here: ``load()`` compiles core.cc once (g++, cached next to the
+source keyed by content hash) and returns the CDLL; everything degrades to
+the pure-Python implementations when the toolchain is unavailable or
+BYTEPS_NATIVE=0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "core.cc")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(os.path.dirname(__file__),
+                        f"_libbyteps_native_{digest}.so")
+
+
+def _compile(out: str) -> None:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", out + ".tmp"]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(out + ".tmp", out)  # atomic: parallel builders race safely
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the native core library, building it on first use; None when
+    disabled or the build fails (callers fall back to Python)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    # single gate shared with the engine: Config parses BYTEPS_NATIVE (and
+    # programmatic set_config(use_native=False) must win over the env)
+    from ..common.config import get_config
+    if not get_config().use_native:
+        return None  # not latched: a later config may re-enable native
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            path = _build_path()
+            if not os.path.exists(path):
+                _compile(path)
+            lib = ctypes.CDLL(path)
+            _declare_signatures(lib)
+            if lib.bps_native_abi_version() != 1:
+                raise RuntimeError("native ABI mismatch")
+            _lib = lib
+        except Exception:
+            _load_failed = True
+            from ..common.logging import get_logger
+            get_logger().warning(
+                "native core unavailable (build or load failed); "
+                "using pure-Python scheduler/reducer", exc_info=True)
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _declare_signatures(lib: ctypes.CDLL) -> None:
+    i64, u64, f32, f64 = (ctypes.c_int64, ctypes.c_uint64, ctypes.c_float,
+                          ctypes.c_double)
+    p = ctypes.c_void_p
+    lib.bps_make_key.restype = u64
+    lib.bps_make_key.argtypes = [u64, u64]
+    lib.bps_key_declared.restype = u64
+    lib.bps_key_declared.argtypes = [u64]
+    lib.bps_key_part.restype = u64
+    lib.bps_key_part.argtypes = [u64]
+    lib.bps_chunk_bounds.restype = i64
+    lib.bps_chunk_bounds.argtypes = [i64, i64, i64, i64,
+                                     ctypes.POINTER(i64),
+                                     ctypes.POINTER(i64), i64]
+    lib.bps_sched_create.restype = p
+    lib.bps_sched_create.argtypes = [i64]
+    lib.bps_sched_destroy.argtypes = [p]
+    lib.bps_sched_add.argtypes = [p, i64, i64, u64, i64]
+    lib.bps_sched_get.restype = i64
+    lib.bps_sched_get.argtypes = [p, ctypes.c_int, f64,
+                                  ctypes.POINTER(i64)]
+    lib.bps_sched_report_finish.argtypes = [p, i64]
+    lib.bps_sched_wake.argtypes = [p]
+    lib.bps_sched_pending.restype = i64
+    lib.bps_sched_pending.argtypes = [p]
+    lib.bps_sched_in_flight.restype = i64
+    lib.bps_sched_in_flight.argtypes = [p]
+    lib.bps_sched_drain.restype = i64
+    lib.bps_sched_drain.argtypes = [p, ctypes.POINTER(i64), i64]
+    for name, ct in (("bps_reduce_sum_f32", f32), ("bps_reduce_sum_f64", f64)):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.POINTER(ct), ctypes.POINTER(ct), i64,
+                       ctypes.c_int]
+    lib.bps_reduce_sum_i32.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                                       ctypes.POINTER(ctypes.c_int32), i64,
+                                       ctypes.c_int]
+    lib.bps_reduce_sum_i64.argtypes = [ctypes.POINTER(i64),
+                                       ctypes.POINTER(i64), i64,
+                                       ctypes.c_int]
+    lib.bps_reduce_scaled_f32.argtypes = [ctypes.POINTER(f32),
+                                          ctypes.POINTER(f32), f32, i64,
+                                          ctypes.c_int]
+    lib.bps_reduce_sum_bf16.argtypes = [ctypes.POINTER(ctypes.c_uint16),
+                                        ctypes.POINTER(ctypes.c_uint16),
+                                        i64, ctypes.c_int]
+    lib.bps_native_abi_version.restype = ctypes.c_int
+
+
+# --------------------------------------------------------------- scheduler
+
+class NativeChunkScheduler:
+    """Drop-in for common.scheduler.ChunkScheduler backed by the C++
+    priority/credit queue.  Python keeps the task objects; only the ordering
+    state (priority, key, nbytes, credit window) lives native."""
+
+    def __init__(self, credit_bytes: int = 0, lib: Optional[ctypes.CDLL]
+                 = None):
+        self._lib = lib or load()
+        if self._lib is None:
+            raise RuntimeError("native core not available")
+        self._h = self._lib.bps_sched_create(credit_bytes)
+        self._tasks = {}
+        self._next_id = 0
+        self._mu = threading.Lock()
+
+    def add_task(self, task) -> None:
+        with self._mu:
+            tid = self._next_id
+            self._next_id += 1
+            self._tasks[tid] = task
+        self._lib.bps_sched_add(self._h, tid, task.priority, task.key,
+                                task.nbytes)
+
+    def get_task(self, block: bool = False,
+                 timeout: Optional[float] = None):
+        nbytes = ctypes.c_int64(0)
+        tid = self._lib.bps_sched_get(
+            self._h, 1 if block else 0,
+            -1.0 if timeout is None else float(timeout),
+            ctypes.byref(nbytes))
+        if tid < 0:
+            return None
+        with self._mu:
+            return self._tasks.pop(tid)
+
+    def report_finish(self, nbytes: int) -> None:
+        self._lib.bps_sched_report_finish(self._h, nbytes)
+
+    @property
+    def pending(self) -> int:
+        return int(self._lib.bps_sched_pending(self._h))
+
+    @property
+    def bytes_in_flight(self) -> int:
+        return int(self._lib.bps_sched_in_flight(self._h))
+
+    def drain(self) -> list:
+        cap = max(1, self.pending)
+        ids = (ctypes.c_int64 * cap)()
+        n = self._lib.bps_sched_drain(self._h, ids, cap)
+        with self._mu:
+            return [self._tasks.pop(ids[i]) for i in range(n)
+                    if ids[i] in self._tasks]
+
+    def wake(self) -> None:
+        """Release any blocked get_task (engine shutdown)."""
+        self._lib.bps_sched_wake(self._h)
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.bps_sched_destroy(h)
+            self._h = None
+
+
+# -------------------------------------------------------------- partitioner
+
+def chunk_bounds(num_elems: int, itemsize: int, partition_bytes: int,
+                 align_elems: int = 512) -> List[Tuple[int, int]]:
+    """Native version of common.partitioner.chunk_bounds (same contract)."""
+    lib = load()
+    if lib is None:
+        from ..common import partitioner as pp
+        return pp.chunk_bounds(num_elems, itemsize, partition_bytes)
+    cap = max(2, num_elems * itemsize // max(1, partition_bytes) + 2)
+    off = (ctypes.c_int64 * cap)()
+    ln = (ctypes.c_int64 * cap)()
+    n = lib.bps_chunk_bounds(num_elems, itemsize, partition_bytes,
+                             align_elems, off, ln, cap)
+    if n < 0:
+        raise ValueError(
+            f"bps_chunk_bounds failed ({n}) for num_elems={num_elems}")
+    return [(int(off[i]), int(ln[i])) for i in range(n)]
+
+
+# -------------------------------------------------------------- cpu reducer
+
+_REDUCE_FNS = {
+    np.dtype(np.float32): ("bps_reduce_sum_f32", ctypes.c_float),
+    np.dtype(np.float64): ("bps_reduce_sum_f64", ctypes.c_double),
+    np.dtype(np.int32): ("bps_reduce_sum_i32", ctypes.c_int32),
+    np.dtype(np.int64): ("bps_reduce_sum_i64", ctypes.c_int64),
+}
+
+
+def inplace_add(dst: np.ndarray, src: np.ndarray,
+                nthreads: int = 0) -> np.ndarray:
+    """dst += src via the native multithreaded reducer; numpy fallback for
+    unsupported dtypes/layouts.  Returns dst."""
+    lib = load()
+    if (lib is None or dst.dtype != src.dtype
+            or dst.dtype not in _REDUCE_FNS
+            or not dst.flags.c_contiguous or not src.flags.c_contiguous
+            or dst.shape != src.shape):
+        np.add(dst, src, out=dst)
+        return dst
+    if nthreads <= 0:
+        nthreads = min(8, os.cpu_count() or 1)
+    name, ct = _REDUCE_FNS[dst.dtype]
+    fn = getattr(lib, name)
+    fn(dst.ctypes.data_as(ctypes.POINTER(ct)),
+       src.ctypes.data_as(ctypes.POINTER(ct)), dst.size, nthreads)
+    return dst
+
+
+def inplace_scaled_add(dst: np.ndarray, src: np.ndarray, alpha: float,
+                       nthreads: int = 0) -> np.ndarray:
+    """dst += alpha * src (f32 native path, numpy otherwise)."""
+    lib = load()
+    if (lib is None or dst.dtype != np.float32 or src.dtype != np.float32
+            or not dst.flags.c_contiguous or not src.flags.c_contiguous
+            or dst.shape != src.shape):
+        dst += (alpha * src).astype(dst.dtype, copy=False)
+        return dst
+    if nthreads <= 0:
+        nthreads = min(8, os.cpu_count() or 1)
+    lib.bps_reduce_scaled_f32(
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        float(alpha), dst.size, nthreads)
+    return dst
+
+
+def make_key(declared: int, part: int) -> int:
+    lib = load()
+    if lib is None:
+        return (declared << 16) | (part & 0xFFFF)
+    return int(lib.bps_make_key(declared, part))

@@ -1,0 +1,299 @@
+// byteps_tpu native runtime core — C ABI, loaded via ctypes.
+//
+// TPU-native counterpart of the reference's C++ core runtime
+// (byteps/common/scheduled_queue.cc, operations.cc:140-180 PartitionTensor,
+// global.cc:628-677 EncodeDefaultKey, cpu_reducer.cc).  The reference runs a
+// 12-stage threaded pipeline because its stages span CUDA streams, shm and a
+// network PS; on TPU the per-chunk pipeline collapses into one fused XLA
+// program, so what remains native is exactly what must be fast and
+// lock-disciplined on the host: the priority/credit chunk scheduler feeding
+// the dispatch loop, the byte-bound partition arithmetic, key packing, and a
+// multithreaded host reducer for staging buffers (async-PS KV store, torch
+// host tensors).
+//
+// No pybind11 in the image — plain extern "C" symbols only.
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- scheduler
+
+struct Task {
+  int64_t task_id;
+  int64_t priority;
+  uint64_t key;
+  int64_t nbytes;
+  int64_t seq;
+};
+
+// Priority desc, then key asc, then FIFO (reference scheduled_queue.cc:82-102
+// sorts by priority then key; seq keeps equal entries stable).
+struct TaskLess {
+  bool operator()(const Task& a, const Task& b) const {
+    if (a.priority != b.priority) return a.priority < b.priority;  // max-heap
+    if (a.key != b.key) return a.key > b.key;
+    return a.seq > b.seq;
+  }
+};
+
+struct Scheduler {
+  std::priority_queue<Task, std::vector<Task>, TaskLess> heap;
+  std::mutex mu;
+  std::condition_variable cv;
+  int64_t credit_limit;
+  int64_t in_flight = 0;
+  int64_t seq = 0;
+  bool shutdown = false;
+
+  bool eligible() const {
+    if (heap.empty()) return false;
+    if (credit_limit <= 0) return true;
+    // always let one oversized task through (reference clamps oversized
+    // partitions into the window, scheduled_queue.cc:136-150)
+    return in_flight == 0 || in_flight + heap.top().nbytes <= credit_limit;
+  }
+};
+
+// -------------------------------------------------------------- cpu reducer
+
+template <typename T>
+void add_range(T* dst, const T* src, int64_t begin, int64_t end) {
+  for (int64_t i = begin; i < end; ++i) dst[i] += src[i];
+}
+
+template <typename T>
+void scaled_range(T* dst, const T* src, T alpha, int64_t begin, int64_t end) {
+  for (int64_t i = begin; i < end; ++i) dst[i] += alpha * src[i];
+}
+
+inline float bf16_to_f32(uint16_t v) {
+  uint32_t u = static_cast<uint32_t>(v) << 16;
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  // round-to-nearest-even on the truncated 16 bits
+  uint32_t rounding = 0x7fff + ((u >> 16) & 1);
+  return static_cast<uint16_t>((u + rounding) >> 16);
+}
+
+// Split [0, n) across up to nthreads workers; tiny inputs stay inline —
+// thread spawn costs ~10us, worth it only for multi-MB buffers.
+template <typename Fn>
+void parallel_for(int64_t n, int nthreads, Fn fn) {
+  const int64_t kMinPerThread = 1 << 18;  // 256k elements
+  int workers = static_cast<int>(std::min<int64_t>(
+      nthreads, (n + kMinPerThread - 1) / kMinPerThread));
+  if (workers <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(workers);
+  int64_t per = (n + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    int64_t b = w * per, e = std::min<int64_t>(n, b + per);
+    if (b >= e) break;
+    ts.emplace_back([=] { fn(b, e); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------ key encoding
+// Reference key space: declared_key<<16 gives 2^16 tensors x 2^16 partitions
+// (operations.cc:302-311).
+uint64_t bps_make_key(uint64_t declared, uint64_t part) {
+  return (declared << 16) | (part & 0xffff);
+}
+uint64_t bps_key_declared(uint64_t key) { return key >> 16; }
+uint64_t bps_key_part(uint64_t key) { return key & 0xffff; }
+
+// ------------------------------------------------------------- partitioner
+// Byte-bounded chunk bounds with element alignment (reference
+// operations.cc:140-180; ALIGN keeps boundaries on vreg-tile multiples).
+// Returns the number of chunks written (<= cap), or the required count if
+// out buffers are null.
+int64_t bps_chunk_bounds(int64_t num_elems, int64_t itemsize,
+                         int64_t partition_bytes, int64_t align_elems,
+                         int64_t* out_off, int64_t* out_len, int64_t cap) {
+  if (num_elems < 0 || itemsize <= 0 || partition_bytes <= 0) return -1;
+  if (num_elems == 0) {
+    if (out_off && cap >= 1) { out_off[0] = 0; out_len[0] = 0; }
+    return 1;
+  }
+  int64_t max_elems = std::max<int64_t>(1, partition_bytes / itemsize);
+  if (num_elems <= max_elems) {
+    if (out_off && cap >= 1) { out_off[0] = 0; out_len[0] = num_elems; }
+    return 1;
+  }
+  if (align_elems > 0 && max_elems > align_elems)
+    max_elems -= max_elems % align_elems;
+  int64_t n = 0, off = 0;
+  while (off < num_elems) {
+    int64_t ln = std::min(max_elems, num_elems - off);
+    if (out_off) {
+      if (n >= cap) return -2;  // caller's buffer too small
+      out_off[n] = off;
+      out_len[n] = ln;
+    }
+    ++n;
+    off += ln;
+  }
+  return n;
+}
+
+// --------------------------------------------------------------- scheduler
+
+void* bps_sched_create(int64_t credit_bytes) {
+  auto* s = new Scheduler();
+  s->credit_limit = credit_bytes;
+  return s;
+}
+
+void bps_sched_destroy(void* p) { delete static_cast<Scheduler*>(p); }
+
+void bps_sched_add(void* p, int64_t task_id, int64_t priority, uint64_t key,
+                   int64_t nbytes) {
+  auto* s = static_cast<Scheduler*>(p);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->heap.push(Task{task_id, priority, key, nbytes, s->seq++});
+  }
+  s->cv.notify_one();
+}
+
+// Pop the best eligible task.  Returns task_id, or -1 when none is eligible
+// within the timeout.  timeout_s < 0 with block means wait forever.
+int64_t bps_sched_get(void* p, int block, double timeout_s,
+                      int64_t* out_nbytes) {
+  auto* s = static_cast<Scheduler*>(p);
+  std::unique_lock<std::mutex> lk(s->mu);
+  auto pred = [s] { return s->shutdown || s->eligible(); };
+  if (block) {
+    if (timeout_s < 0) {
+      s->cv.wait(lk, pred);
+    } else {
+      s->cv.wait_for(lk, std::chrono::duration<double>(timeout_s), pred);
+    }
+  }
+  if (!s->eligible()) return -1;
+  Task t = s->heap.top();
+  s->heap.pop();
+  s->in_flight += t.nbytes;
+  if (out_nbytes) *out_nbytes = t.nbytes;
+  return t.task_id;
+}
+
+void bps_sched_report_finish(void* p, int64_t nbytes) {
+  auto* s = static_cast<Scheduler*>(p);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->in_flight = std::max<int64_t>(0, s->in_flight - nbytes);
+  }
+  s->cv.notify_all();
+}
+
+// Wake every blocked bps_sched_get (shutdown path); queue contents survive
+// for drain.
+void bps_sched_wake(void* p) {
+  auto* s = static_cast<Scheduler*>(p);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->shutdown = true;
+  }
+  s->cv.notify_all();
+}
+
+int64_t bps_sched_pending(void* p) {
+  auto* s = static_cast<Scheduler*>(p);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<int64_t>(s->heap.size());
+}
+
+int64_t bps_sched_in_flight(void* p) {
+  auto* s = static_cast<Scheduler*>(p);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->in_flight;
+}
+
+// Pop everything in priority order regardless of credit; returns count.
+int64_t bps_sched_drain(void* p, int64_t* out_ids, int64_t cap) {
+  auto* s = static_cast<Scheduler*>(p);
+  std::lock_guard<std::mutex> lk(s->mu);
+  int64_t n = 0;
+  while (!s->heap.empty() && n < cap) {
+    out_ids[n++] = s->heap.top().task_id;
+    s->heap.pop();
+  }
+  return n;
+}
+
+// -------------------------------------------------------------- cpu reducer
+// dst += src (reference CpuReducer::sum, cpu_reducer.cc — OpenMP there,
+// std::thread fan-out here; numpy's single-threaded add is the Python
+// fallback).
+
+void bps_reduce_sum_f32(float* dst, const float* src, int64_t n,
+                        int nthreads) {
+  parallel_for(n, nthreads,
+               [=](int64_t b, int64_t e) { add_range(dst, src, b, e); });
+}
+
+void bps_reduce_sum_f64(double* dst, const double* src, int64_t n,
+                        int nthreads) {
+  parallel_for(n, nthreads,
+               [=](int64_t b, int64_t e) { add_range(dst, src, b, e); });
+}
+
+void bps_reduce_sum_i32(int32_t* dst, const int32_t* src, int64_t n,
+                        int nthreads) {
+  parallel_for(n, nthreads,
+               [=](int64_t b, int64_t e) { add_range(dst, src, b, e); });
+}
+
+void bps_reduce_sum_i64(int64_t* dst, const int64_t* src, int64_t n,
+                        int nthreads) {
+  parallel_for(n, nthreads,
+               [=](int64_t b, int64_t e) { add_range(dst, src, b, e); });
+}
+
+// dst += alpha * src (compressor decorators use the scaled form,
+// cpu_reducer.h:67-180)
+void bps_reduce_scaled_f32(float* dst, const float* src, float alpha,
+                           int64_t n, int nthreads) {
+  parallel_for(n, nthreads, [=](int64_t b, int64_t e) {
+    scaled_range(dst, src, alpha, b, e);
+  });
+}
+
+// bf16 sum in f32 precision with round-to-nearest-even writeback (the
+// reference's software half_t serves the same purpose for its CUDA-less
+// server, half.h).
+void bps_reduce_sum_bf16(uint16_t* dst, const uint16_t* src, int64_t n,
+                         int nthreads) {
+  parallel_for(n, nthreads, [=](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i)
+      dst[i] = f32_to_bf16(bf16_to_f32(dst[i]) + bf16_to_f32(src[i]));
+  });
+}
+
+int bps_native_abi_version() { return 1; }
+
+}  // extern "C"

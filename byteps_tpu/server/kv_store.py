@@ -23,12 +23,17 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..native import inplace_add, load as _native_load
+
 
 class KVStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._store: Dict[str, np.ndarray] = {}
         self._versions: Dict[str, int] = {}
+        # force the one-time native build/load here, NOT under self._lock in
+        # push_delta (the first load may g++-compile core.cc for seconds)
+        _native_load()
 
     def init_key(self, key: str, value) -> None:
         """Idempotent first-push initialization (reference init-push
@@ -44,7 +49,9 @@ class KVStore:
         with self._lock:
             if key not in self._store:
                 raise KeyError(f"key {key!r} not initialized")
-            self._store[key] += np.asarray(delta)
+            # native multithreaded sum when available (reference server
+            # engine threads sum with the C++ CpuReducer, server.cc:77-198)
+            inplace_add(self._store[key], np.asarray(delta))
             self._versions[key] += 1
             return self._versions[key]
 
