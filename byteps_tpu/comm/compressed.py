@@ -58,9 +58,9 @@ def compressed_all_reduce(comm: CommContext, stacked,
             # "push": only compressed bytes cross the interconnect
             gathered = jax.tree.map(
                 lambda p: lax.all_gather(p, axes, axis=0), payload)
-            # "server": decompress every rank's payload and sum
-            y = jax.vmap(worker_comp.decompress)(gathered) \
-                .astype(jnp.float32).sum(axis=0)
+            # "server": decompress every rank's payload and sum (fused
+            # single-pass kernel when the compressor provides one)
+            y = worker_comp.decompress_sum(gathered).astype(jnp.float32)
             if worker_comp.bidirectional:
                 # "re-compressed pull" (server.cc re-compresses merged data)
                 p2, sst2 = server_comp.compress(y, sst)

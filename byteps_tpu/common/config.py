@@ -79,6 +79,7 @@ class Config:
 
     # --- native core ---
     use_native: bool = True          # BYTEPS_NATIVE: C++ scheduler/reducer
+    use_pallas: bool = True          # BYTEPS_PALLAS: TPU kernels for hot ops
 
     # --- modes ---
     enable_async: bool = False       # BYTEPS_ENABLE_ASYNC (async-PS weight deltas)
@@ -118,6 +119,7 @@ class Config:
             enable_priority=_env_bool("BYTEPS_ENABLE_PRIORITY", True),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             use_native=_env_bool("BYTEPS_NATIVE", True),
+            use_pallas=_env_bool("BYTEPS_PALLAS", True),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC", False),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON", False),

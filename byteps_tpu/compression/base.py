@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -47,6 +48,15 @@ class Compressor:
 
     def decompress(self, payload: Payload) -> Any:
         return payload["values"]
+
+    def decompress_sum(self, gathered: Payload) -> Any:
+        """Merge R gathered payloads (leaves stacked on axis 0) into the
+        f32 sum of their decompressions — the "server sum" of the
+        compressed all-reduce (reference server.cc:87-113).  Subclasses
+        with a fused kernel override this to skip materializing the
+        (R, numel) intermediate."""
+        return jax.vmap(self.decompress)(gathered) \
+            .astype(jnp.float32).sum(axis=0)
 
     # -- accounting --------------------------------------------------------
     def payload_nbytes(self) -> int:

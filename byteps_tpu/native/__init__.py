@@ -59,7 +59,12 @@ def load() -> Optional[ctypes.CDLL]:
             path = _build_path()
             if not os.path.exists(path):
                 _compile(path)
-            lib = ctypes.CDLL(path)
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                # existing binary from another platform/ABI: rebuild once
+                _compile(path)
+                lib = ctypes.CDLL(path)
             _declare_signatures(lib)
             if lib.bps_native_abi_version() != 1:
                 raise RuntimeError("native ABI mismatch")
@@ -202,11 +207,18 @@ def chunk_bounds(num_elems: int, itemsize: int, partition_bytes: int,
     if lib is None:
         from ..common import partitioner as pp
         return pp.chunk_bounds(num_elems, itemsize, partition_bytes)
-    cap = max(2, num_elems * itemsize // max(1, partition_bytes) + 2)
-    off = (ctypes.c_int64 * cap)()
-    ln = (ctypes.c_int64 * cap)()
+    # first call with a NULL buffer returns the exact chunk count (the
+    # 512-element alignment shrink can make it much larger than the naive
+    # bytes/partition_bytes estimate)
     n = lib.bps_chunk_bounds(num_elems, itemsize, partition_bytes,
-                             align_elems, off, ln, cap)
+                             align_elems, None, None, 0)
+    if n < 0:
+        raise ValueError(
+            f"bps_chunk_bounds failed ({n}) for num_elems={num_elems}")
+    off = (ctypes.c_int64 * n)()
+    ln = (ctypes.c_int64 * n)()
+    n = lib.bps_chunk_bounds(num_elems, itemsize, partition_bytes,
+                             align_elems, off, ln, n)
     if n < 0:
         raise ValueError(
             f"bps_chunk_bounds failed ({n}) for num_elems={num_elems}")

@@ -104,7 +104,6 @@ class CrossBarrier:
         self._compression = compression
         self._pending: Dict[torch.nn.Parameter, Handle] = {}
         self._lock = threading.Lock()
-        self._opt_params_cache: Optional[List[torch.nn.Parameter]] = None
         self._name_of = {p: n for n, p in model.named_parameters()
                          if p.requires_grad}
         from ..core import api as _api
@@ -162,21 +161,15 @@ class CrossBarrier:
         finally:
             for q, g in saved:
                 q.grad = g
-            # param_groups may be edited between steps (incl. same-length
-            # swaps); a step boundary is the only safe cache lifetime
-            self._opt_params_cache = None
         for p, _ in todo:
             p.grad = None
 
     def _flat_opt_params(self) -> List[torch.nn.Parameter]:
-        """Flattened optimizer params, cached between optimizer steps —
-        gates fire per module forward, and the cache is dropped at each
-        step boundary so param-group edits (including same-length swaps)
-        are seen before the next gate."""
-        if self._opt_params_cache is None:
-            self._opt_params_cache = [q for g in self.optimizer.param_groups
-                                      for q in g["params"]]
-        return self._opt_params_cache
+        """Flattened optimizer params, re-read each call: every
+        _apply_params runs a step, and param_groups may be edited between
+        steps, so there is no safe lifetime to cache across."""
+        return [q for g in self.optimizer.param_groups
+                for q in g["params"]]
 
     def _make_gate(self, params: List[torch.nn.Parameter]):
         def gate(module, inputs):

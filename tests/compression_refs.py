@@ -14,20 +14,30 @@ from byteps_tpu.compression.prng import uniform_np
 
 # --- onebit ----------------------------------------------------------------
 
+def _onebit_lanes(numel):
+    # mirror ops/pallas_kernels.py padded_lanes: words rounded to 128 lanes
+    words = -(-numel // 32)
+    return -(-words // 128) * 128
+
+
 def onebit_compress(x, scaling=True):
+    # sublane-major layout (compression/onebit.py): bit i of word j is the
+    # sign of padded element i*L + j, L lane-aligned
     x = x.astype(np.float32)
-    scale = np.abs(x).mean() if scaling else np.float32(1.0)
-    bits = (x >= 0).astype(np.uint32)
-    words = len(bits)
-    pad = (-words) % 32
-    bits = np.pad(bits, (0, pad))
-    packed = (bits.reshape(-1, 32) << np.arange(32, dtype=np.uint32)) \
-        .sum(axis=1).astype(np.uint32)
+    scale = (np.abs(x).sum() / len(x)).astype(np.float32) \
+        if scaling else np.float32(1.0)
+    L = _onebit_lanes(len(x))
+    # pad x (not the bits): pad elements are 0 and 0>=0 packs as 1, same
+    # as the kernel; decompress slices the padding off before use
+    bits = (np.pad(x, (0, 32 * L - len(x))) >= 0).astype(np.uint32)
+    packed = (bits.reshape(32, L)
+              << np.arange(32, dtype=np.uint32)[:, None]) \
+        .sum(axis=0).astype(np.uint32)
     return packed, np.float32(scale)
 
 
 def onebit_decompress(packed, scale, numel):
-    bits = ((packed[:, None] >> np.arange(32, dtype=np.uint32)) & 1)
+    bits = ((packed[None, :] >> np.arange(32, dtype=np.uint32)[:, None]) & 1)
     bits = bits.reshape(-1)[:numel]
     return (bits.astype(np.float32) * 2.0 - 1.0) * scale
 
