@@ -48,39 +48,61 @@ def _cached(comm: CommContext, key, builder):
     return fn
 
 
-def _all_reduce_fn(comm: CommContext, average: bool):
+def _acc(x):
+    """Accumulation cast: f16/bf16 summands accumulate in f32, like the
+    reference's CpuReducer (f16 -> f32 convert-sum-convert,
+    cpu_reducer.h:67-180) and the server's software half (half.h) — an
+    R-way fp16 sum overflows at |x| > 65504/R long before the averaged
+    result does."""
+    if x.dtype in (jnp.float16, jnp.bfloat16):
+        return x.astype(jnp.float32)
+    return x
+
+
+def _all_reduce_fn(comm: CommContext, average: bool, keep_acc: bool = False):
     def build():
         axes = comm.dp_axes
 
         def body(x):
-            r = lax.psum(x[0], axes)
+            x0 = x[0]
+            r = lax.psum(_acc(x0), axes)
             if average:
-                r = (r / comm.num_ranks).astype(x.dtype)
-            return r
+                return (r / comm.num_ranks).astype(x0.dtype)
+            if keep_acc:
+                # engine-internal SUM: f16/bf16 stays f32 so the caller's
+                # over-count division happens before any downcast (fp16
+                # R-way sums top out at 65504/R)
+                return r
+            return r.astype(x0.dtype)
 
         # No donation: the input frequently aliases a user-held gradient
         # array (engine passes a reshape view), which donation would delete
         # on TPU.
         return jax.jit(jax.shard_map(body, mesh=comm.mesh,
                                      in_specs=P(axes), out_specs=P()))
-    return _cached(comm, ("all_reduce", average), build)
+    return _cached(comm, ("all_reduce", average, keep_acc), build)
 
 
-def _hierarchical_fn(comm: CommContext, average: bool):
+def _hierarchical_fn(comm: CommContext, average: bool,
+                     keep_acc: bool = False):
     n_ici = comm.n_ici
 
     def build():
         def body(x):
             x = x[0]  # [n], n % n_ici == 0
             # intra-slice reduce-scatter: each device owns a summed shard
-            s = lax.psum_scatter(x, ICI_AXIS, scatter_dimension=0, tiled=True)
+            # (f32 accumulation for sub-f32 floats, see _acc)
+            s = lax.psum_scatter(_acc(x), ICI_AXIS, scatter_dimension=0,
+                                 tiled=True)
             # inter-slice exchange of the shard only (ps push+pull
             # equivalent); a size-1 dcn axis makes this a no-op but keeps
             # the value replication statically provable.
             s = lax.psum(s, DCN_AXIS)
             if average:
-                s = (s / comm.num_ranks).astype(x.dtype)
-            return s
+                return (s / comm.num_ranks).astype(x.dtype)
+            if keep_acc:
+                return s  # see _all_reduce_fn
+            return s.astype(x.dtype)
 
         # The reference finishes with an intra-node AllGather ("BROADCAST"
         # stage, core_loops.cc:254-268).  Here the gather is implicit: the
@@ -105,7 +127,7 @@ def _hierarchical_fn(comm: CommContext, average: bool):
 
         return jax.jit(fn)
 
-    return _cached(comm, ("hierarchical", average), build)
+    return _cached(comm, ("hierarchical", average, keep_acc), build)
 
 
 def _broadcast_fn(comm: CommContext, root: int):
@@ -135,15 +157,20 @@ def _as_stacked(comm: CommContext, stacked) -> jax.Array:
     return jax.device_put(stacked, sharding)
 
 
-def all_reduce(comm: CommContext, stacked, op: str = "sum") -> jax.Array:
-    """Sum (or average) rank-stacked tensors; returns the replicated result."""
-    return _all_reduce_fn(comm, op == "average")(_as_stacked(comm, stacked))
+def all_reduce(comm: CommContext, stacked, op: str = "sum",
+               keep_acc: bool = False) -> jax.Array:
+    """Sum (or average) rank-stacked tensors; returns the replicated result.
+    ``keep_acc=True`` (engine-internal) returns f16/bf16 SUMs in their f32
+    accumulation dtype so post-division can precede the downcast."""
+    return _all_reduce_fn(comm, op == "average",
+                          keep_acc)(_as_stacked(comm, stacked))
 
 
-def hierarchical_all_reduce(comm: CommContext, stacked,
-                            op: str = "sum") -> jax.Array:
+def hierarchical_all_reduce(comm: CommContext, stacked, op: str = "sum",
+                            keep_acc: bool = False) -> jax.Array:
     """Two-level RS -> DCN-psum -> AG reduction of rank-stacked tensors."""
-    return _hierarchical_fn(comm, op == "average")(_as_stacked(comm, stacked))
+    return _hierarchical_fn(comm, op == "average",
+                            keep_acc)(_as_stacked(comm, stacked))
 
 
 def broadcast(comm: CommContext, stacked, root: int = 0) -> jax.Array:
@@ -154,10 +181,11 @@ def broadcast(comm: CommContext, stacked, root: int = 0) -> jax.Array:
 
 
 def push_pull_array(comm: CommContext, stacked, op: str = "average",
-                    hierarchical: Optional[bool] = None) -> jax.Array:
+                    hierarchical: Optional[bool] = None,
+                    keep_acc: bool = False) -> jax.Array:
     """The collective behind bps.push_pull: picks the strategy by topology."""
     if hierarchical is None:
         hierarchical = comm.n_dcn > 1
     if hierarchical:
-        return hierarchical_all_reduce(comm, stacked, op)
-    return all_reduce(comm, stacked, op)
+        return hierarchical_all_reduce(comm, stacked, op, keep_acc)
+    return all_reduce(comm, stacked, op, keep_acc)

@@ -94,6 +94,11 @@ class _PendingTensor:
                 out = out / self.denom
             else:
                 out = out // self.denom
+        # f16/bf16 chunks come back as f32 sums (collectives keep the
+        # accumulation dtype so the over-count division above happens
+        # before any downcast); restore the declared dtype here
+        if out.dtype != np.dtype(self.ctx.dtype_name):
+            out = out.astype(self.ctx.dtype_name)
         return out
 
 
@@ -262,7 +267,8 @@ class PushPullEngine:
                     slot.wstates = new_wst
                     slot.sstate = new_sst
                 else:
-                    out = push_pull_array(self.comm, task.data, op="sum")
+                    out = push_pull_array(self.comm, task.data, op="sum",
+                                          keep_acc=True)
                 self._sync_q.put((task, out, rollback, None))
             except Exception as e:  # noqa: BLE001
                 get_logger().error("dispatch failed for %s: %s", task.name, e)

@@ -32,6 +32,7 @@ __all__ = [
     "push_pull", "push_pull_async", "poll", "synchronize", "declare",
     "DistributedOptimizer", "broadcast_parameters",
     "broadcast_optimizer_state", "Compression",
+    "HalfPrecisionDistributedOptimizer",
 ]
 
 init = _api.init
@@ -225,3 +226,6 @@ class DistributedOptimizer(torch.optim.Optimizer):
                 h.remove()
             except Exception:  # noqa: BLE001
                 pass
+
+
+from .half_precision import HalfPrecisionDistributedOptimizer  # noqa: E402

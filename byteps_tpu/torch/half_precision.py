@@ -1,0 +1,145 @@
+"""Manual mixed-precision distributed optimizer (fp16 grads on the wire,
+fp32 master weights).
+
+Reference parity: ``_HalfPrecisionDistributedOptimizer`` in
+byteps/misc/imagenet18/__init__.py:39- (SURVEY.md §2.4 Misc): the model
+holds fp16 parameters, gradients are push_pulled in fp16 (half the wire
+bytes), and the optimizer steps fp32 master copies which are then copied
+back into the fp16 model.  Loss scaling guards against fp16 underflow.
+
+TPU note: on-device training should prefer bf16 via byteps_tpu.jax (no
+loss scale needed); this class is the torch-frontend equivalent for
+checkpoints/models that are fp16-native.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import torch
+
+from ..core import api as _api
+from . import push_pull_async, _to_torch
+from ..common.handles import Handle
+
+
+class HalfPrecisionDistributedOptimizer(torch.optim.Optimizer):
+    """fp16 model / fp32 master distributed optimizer.
+
+    ``optimizer`` must already be constructed over the fp32 master params
+    (one per fp16 model param, same order).  Typical setup::
+
+        model.half()
+        fp16_params = [p for p in model.parameters() if p.requires_grad]
+        fp32_params = [p.detach().clone().float().requires_grad_()
+                       for p in fp16_params]
+        opt = torch.optim.SGD(fp32_params, lr=0.1)
+        opt = HalfPrecisionDistributedOptimizer(
+            opt, fp16_params=fp16_params, fp32_params=fp32_params,
+            loss_scale=1024.0)
+        ...
+        opt.scale_loss(loss).backward(); opt.step(); opt.zero_grad()
+    """
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 fp16_params: Iterable[torch.nn.Parameter],
+                 fp32_params: Iterable[torch.nn.Parameter],
+                 loss_scale: float = 1024.0,
+                 named_parameters: Optional[
+                     Iterable[Tuple[str, torch.nn.Parameter]]] = None,
+                 compression: Optional[Dict[str, str]] = None):
+        self._inner = optimizer
+        self.param_groups = optimizer.param_groups
+        self.defaults = optimizer.defaults
+        self.state = optimizer.state
+        self.fp16_params = list(fp16_params)
+        self.fp32_params = list(fp32_params)
+        if len(self.fp16_params) != len(self.fp32_params):
+            raise ValueError("fp16_params and fp32_params must pair up")
+        self.loss_scale = float(loss_scale)
+        self._compression = compression
+        self._handles: Dict[torch.nn.Parameter, Handle] = {}
+        self._hooks = []
+        self._lock = threading.Lock()
+
+        if named_parameters is not None:
+            names = {p: n for n, p in named_parameters}
+            dups = len(names) != len(set(names.values()))
+            if dups:
+                raise ValueError("parameter names must be unique")
+        else:
+            names = {p: f"param.{i}" for i, p in
+                     enumerate(self.fp16_params)}
+        self._name_of = names
+        # fixed declare order on every process (same key/priority layout);
+        # two loops like the reference for server load-balance parity
+        for p in self.fp16_params:
+            _api.declare(f"Gradient.{self._name_of[p]}")
+        for p in self.fp16_params:
+            _api.declare(f"Parameter.{self._name_of[p]}")
+
+        for p in self.fp16_params:
+            if p.requires_grad:
+                h = p.register_post_accumulate_grad_hook(self._make_hook())
+                self._hooks.append(h)
+
+    # -- loss scaling ------------------------------------------------------
+
+    def scale_loss(self, loss: torch.Tensor) -> torch.Tensor:
+        return loss * self.loss_scale
+
+    # -- hooks -------------------------------------------------------------
+
+    def _make_hook(self):
+        def hook(p: torch.nn.Parameter):
+            with self._lock:
+                # fp16 gradient goes on the wire (half the bytes)
+                self._handles[p] = push_pull_async(
+                    p.grad, average=True,
+                    name=f"Gradient.{self._name_of[p]}",
+                    compression=self._compression)
+        return hook
+
+    # -- optimizer protocol ------------------------------------------------
+
+    def zero_grad(self, set_to_none: bool = True):
+        self._inner.zero_grad(set_to_none=set_to_none)
+        for p in self.fp16_params:
+            if set_to_none:
+                p.grad = None
+            elif p.grad is not None:
+                p.grad.detach_().zero_()
+
+    def step(self, closure=None):
+        with self._lock:
+            handles, self._handles = self._handles, {}
+        inv = 1.0 / self.loss_scale
+        with torch.no_grad():
+            for p16, p32 in zip(self.fp16_params, self.fp32_params):
+                h = handles.get(p16)
+                if h is not None:
+                    avg = _to_torch(h.wait(), p16.grad)
+                    p16.grad.copy_(avg)
+                if p16.grad is None:
+                    continue
+                # fp32 unscaled master gradient
+                p32.grad = p16.grad.float().mul_(inv)
+        out = self._inner.step(closure)
+        with torch.no_grad():
+            for p16, p32 in zip(self.fp16_params, self.fp32_params):
+                p16.copy_(p32.to(p16.dtype))
+        return out
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._inner.load_state_dict(sd)
+
+    def __del__(self):
+        for h in getattr(self, "_hooks", []):
+            try:
+                h.remove()
+            except Exception:  # noqa: BLE001
+                pass

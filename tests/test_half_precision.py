@@ -1,0 +1,87 @@
+"""HalfPrecisionDistributedOptimizer (reference
+misc/imagenet18/__init__.py:39- — SURVEY.md §2.4 Misc): fp16 model params,
+fp16 gradients on the wire, fp32 master weights, static loss scaling."""
+
+import numpy as np
+import pytest
+import torch
+
+import byteps_tpu.torch as bps
+
+
+@pytest.fixture
+def session():
+    bps.init()
+    yield
+    bps.shutdown()
+
+
+def _setup(loss_scale=1024.0):
+    torch.manual_seed(0)
+    model = torch.nn.Linear(8, 4).half()
+    fp16_params = [p for p in model.parameters() if p.requires_grad]
+    fp32_params = [p.detach().clone().float().requires_grad_()
+                   for p in fp16_params]
+    inner = torch.optim.SGD(fp32_params, lr=0.1)
+    opt = bps.HalfPrecisionDistributedOptimizer(
+        inner, fp16_params=fp16_params, fp32_params=fp32_params,
+        loss_scale=loss_scale,
+        named_parameters=[(n, p) for n, p in model.named_parameters()])
+    return model, fp16_params, fp32_params, opt
+
+
+def test_step_updates_masters_and_copies_back(session):
+    model, fp16s, fp32s, opt = _setup()
+    before32 = [p.detach().clone() for p in fp32s]
+    x = torch.randn(16, 8).half()
+    loss = model(x).float().pow(2).mean()
+    opt.scale_loss(loss).backward()
+    opt.step()
+    for b, p32, p16 in zip(before32, fp32s, fp16s):
+        assert not torch.equal(b, p32)          # master moved
+        assert p16.dtype == torch.float16
+        np.testing.assert_allclose(p16.detach().float().numpy(),
+                                   p32.detach().numpy(),
+                                   rtol=1e-2, atol=1e-3)  # copied back
+
+
+def test_loss_scale_cancels(session):
+    """The applied update must be invariant to the loss scale (grads are
+    scaled up for the fp16 wire and unscaled before the master step)."""
+    results = []
+    for scale in (1.0, 4096.0):
+        model, fp16s, fp32s, opt = _setup(loss_scale=scale)
+        x = torch.ones(4, 8).half()
+        loss = model(x).float().sum()
+        opt.scale_loss(loss).backward()
+        opt.step()
+        results.append([p.detach().clone().numpy() for p in fp32s])
+        opt.zero_grad()
+        bps.shutdown(); bps.init()
+    for a, b in zip(*results):
+        np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-3)
+
+
+def test_training_reduces_loss(session):
+    model, fp16s, fp32s, opt = _setup(loss_scale=128.0)
+    x = torch.randn(64, 8).half()
+    # realizable target so the loss can actually go to ~0
+    w_true = torch.randn(8, 4).half()
+    y = (x @ w_true).half()
+    losses = []
+    for _ in range(25):
+        opt.zero_grad()
+        loss = (model(x) - y).float().pow(2).mean()
+        losses.append(float(loss.detach()))
+        opt.scale_loss(loss).backward()
+        opt.step()
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_mismatched_param_lists_raise(session):
+    model = torch.nn.Linear(2, 2).half()
+    fp16_params = list(model.parameters())
+    with pytest.raises(ValueError):
+        bps.HalfPrecisionDistributedOptimizer(
+            torch.optim.SGD([torch.nn.Parameter(torch.zeros(2))], lr=0.1),
+            fp16_params=fp16_params, fp32_params=[])
