@@ -1,0 +1,76 @@
+"""BytePS-backed tf.distribute strategy (reference
+distribute/mirrored_strategy.py + cross_device_ops.py — SURVEY.md §2.4).
+Single process == the reference's single-worker forced-distributed mode:
+cross-worker push_pull is identity, so strategy semantics (replica-local
+reduction, MEAN/SUM, broadcast-on-create) are what is under test."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import byteps_tpu.tensorflow as bps_tf  # noqa: E402
+from byteps_tpu.tensorflow.distribute import (BytePSCrossDeviceOps,  # noqa: E402
+                                              MirroredStrategy)
+
+
+@pytest.fixture
+def session():
+    bps_tf.init()
+    yield
+    bps_tf.shutdown()
+
+
+def test_cross_device_ops_reduce_sum_and_mean(session):
+    ops = BytePSCrossDeviceOps()
+    x = tf.constant(np.random.randn(8, 3).astype(np.float32))
+    out = ops.reduce(tf.distribute.ReduceOp.SUM, x, destinations=x)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5)
+    out = ops.reduce(tf.distribute.ReduceOp.MEAN, x, destinations=x)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5)
+
+
+def test_mirrored_strategy_reduce(session):
+    strat = MirroredStrategy(["/cpu:0"])
+    assert isinstance(strat.extended._inferred_cross_device_ops
+                      if hasattr(strat.extended,
+                                 "_inferred_cross_device_ops")
+                      else strat.extended._cross_device_ops,
+                      BytePSCrossDeviceOps)
+
+    def step():
+        ctx = tf.distribute.get_replica_context()
+        return tf.constant(3.0)
+
+    per_replica = strat.run(step)
+    tot = strat.reduce(tf.distribute.ReduceOp.SUM, per_replica, axis=None)
+    assert float(tot) == pytest.approx(3.0)
+
+
+def test_mirrored_strategy_training_step(session):
+    strat = MirroredStrategy(["/cpu:0"])
+    with strat.scope():
+        v = tf.Variable(2.0)
+    opt = tf.keras.optimizers.SGD(0.5)
+
+    @tf.function
+    def step():
+        def replica_fn():
+            with tf.GradientTape() as tape:
+                loss = v * v
+            g = tape.gradient(loss, v)
+            opt.apply_gradients([(g, v)])
+            return loss
+
+        return strat.run(replica_fn)
+
+    losses = [float(strat.reduce(tf.distribute.ReduceOp.MEAN, step(),
+                                 axis=None)) for _ in range(3)]
+    assert losses[0] > losses[-1]  # v: 2.0 -> 0.0 under lr .5 on v^2
+
+
+def test_broadcast_mirrors_root_value(session):
+    ops = BytePSCrossDeviceOps()
+    x = tf.constant(np.arange(6, dtype=np.float32))
+    out = ops.broadcast(x, destinations=x)
+    np.testing.assert_allclose(tf.convert_to_tensor(out).numpy(), x.numpy())
