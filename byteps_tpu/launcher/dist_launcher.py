@@ -1,0 +1,169 @@
+"""bpslaunch-dist: multi-host ssh fan-out launcher.
+
+TPU-native counterpart of the reference's launcher/dist_launcher.py
+(SURVEY.md §2.5): read a hostfile, ssh the training command to every host
+with the bootstrap env injected, stream logs to ``sshlog/``.
+
+Differences by design:
+- no server/scheduler hosts: the TPU mesh replaces the PS processes, so
+  there is one host list (the workers) and the *coordinator* is simply
+  worker 0 — its address is exported as DMLC_PS_ROOT_URI/PORT for
+  DMLC-env compatibility and consumed by ``jax.distributed.initialize``
+  inside ``bps.init()``.  ``--server-hostfile`` is accepted and ignored
+  with a notice so reference launch scripts keep working.
+- commands are passed to ssh as argument vectors (no shell string
+  interpolation); env is injected via ``env KEY=VALUE ...`` on the remote
+  side.
+
+Usage:
+    bpslaunch-dist -H hostfile [--port 9100] [--env K:V]... CMD [ARGS...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# env vars forwarded from the launcher's own environment when set
+_FORWARD_KEYS = ("OMP_NUM_THREADS", "KMP_AFFINITY", "BYTEPS_LOG_LEVEL")
+
+
+def parse_hostfile(path: str) -> List[Tuple[str, str]]:
+    """Lines of ``host[:ssh_port]`` -> [(host, port)]; blanks/# skipped."""
+    hosts: List[Tuple[str, str]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            host, _, port = line.partition(":")
+            hosts.append((host, port or "22"))
+    if not hosts:
+        raise ValueError(f"hostfile {path!r} contains no hosts")
+    return hosts
+
+
+def parse_envs(pairs: Sequence[str]) -> Dict[str, str]:
+    """``KEY:VALUE`` pairs (reference --env syntax) -> dict."""
+    out: Dict[str, str] = {}
+    for item in pairs:
+        key, sep, val = item.partition(":")
+        if sep:
+            out[key] = val
+    return out
+
+
+def build_env(hosts: List[Tuple[str, str]], worker_id: int,
+              coordinator_port: int, extra: Dict[str, str]) -> Dict[str, str]:
+    env = {
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(len(hosts)),
+        "DMLC_WORKER_ID": str(worker_id),
+        "DMLC_PS_ROOT_URI": hosts[0][0],
+        "DMLC_PS_ROOT_PORT": str(coordinator_port),
+    }
+    for k in _FORWARD_KEYS:
+        v = os.environ.get(k)
+        if v is not None:
+            env[k] = v
+    env.update(extra)
+    return env
+
+
+def ssh_argv(host: str, port: str, env: Dict[str, str], cmd: Sequence[str],
+             username: Optional[str] = None) -> List[str]:
+    """One ssh invocation as an argv list: env injected remotely via
+    ``env K=V ... CMD``."""
+    argv = ["ssh", "-o", "StrictHostKeyChecking=no", "-p", port]
+    if username:
+        argv += ["-l", username]
+    remote = ["env"] + [f"{k}={v}" for k, v in sorted(env.items())] + \
+        list(cmd)
+    argv += [host, " ".join(shlex.quote(a) for a in remote)]
+    return argv
+
+
+def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
+           coordinator_port: int = 9100,
+           extra_env: Optional[Dict[str, str]] = None,
+           username: Optional[str] = None,
+           log_dir: str = "sshlog",
+           ssh_runner=None) -> List[int]:
+    """Fan the command out to every host; block until all exit.  Returns
+    per-host exit codes.  ``ssh_runner(argv, stdout, stderr) -> int`` is
+    injectable (tests use a local stub instead of real ssh)."""
+    os.makedirs(log_dir, exist_ok=True)
+    if ssh_runner is None:
+        def ssh_runner(argv, stdout, stderr):
+            return subprocess.call(argv, stdout=stdout, stderr=stderr)
+
+    codes: List[Optional[int]] = [None] * len(hosts)
+
+    def run(i: int, host: str, port: str) -> None:
+        env = build_env(hosts, i, coordinator_port, extra_env or {})
+        argv = ssh_argv(host, port, env, cmd, username)
+        base = os.path.join(log_dir, f"worker{i}")
+        with open(base + ".stdout", "wb") as out, \
+                open(base + ".stderr", "wb") as err:
+            codes[i] = ssh_runner(argv, out, err)
+
+    threads = [threading.Thread(target=run, args=(i, h, p), daemon=True)
+               for i, (h, p) in enumerate(hosts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [c if c is not None else 1 for c in codes]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed byteps_tpu job over ssh")
+    ap.add_argument("-H", "-WH", "--hostfile", "--worker-hostfile",
+                    dest="hostfile", required=True,
+                    help="file with one host[:ssh_port] per line")
+    ap.add_argument("-SH", "--server-hostfile", dest="server_hostfile",
+                    default=None,
+                    help="accepted for reference compatibility; ignored "
+                         "(no server processes on TPU)")
+    ap.add_argument("--port", "--scheduler-port", dest="port", type=int,
+                    default=9100, help="coordinator port on worker 0")
+    ap.add_argument("--env", action="append", default=[],
+                    help="KEY:VALUE exported on every host (repeatable)")
+    ap.add_argument("--username", default=None, help="ssh username")
+    ap.add_argument("--log-dir", default="sshlog")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run on every host")
+    args = ap.parse_args(argv)
+
+    if args.server_hostfile:
+        print("bpslaunch-dist: --server-hostfile ignored (XLA collectives "
+              "replace the parameter server on TPU)", file=sys.stderr)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":   # strip only the leading separator: the
+        cmd = cmd[1:]            # command's own "--" tokens must survive
+    if not cmd:
+        ap.error("no command given")
+
+    hosts = parse_hostfile(args.hostfile)
+    print(f"Launching {len(hosts)} workers "
+          f"(coordinator {hosts[0][0]}:{args.port})")
+    codes = launch(hosts, cmd, coordinator_port=args.port,
+                   extra_env=parse_envs(args.env), username=args.username,
+                   log_dir=args.log_dir)
+    for i, c in enumerate(codes):
+        if c != 0:
+            print(f"worker{i} exited with {c} (see "
+                  f"{args.log_dir}/worker{i}.stderr)", file=sys.stderr)
+    # signal deaths are negative return codes; max() would mask them
+    # behind any worker that exited 0
+    return next((abs(c) for c in codes if c != 0), 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""dist_launcher: hostfile parsing, env construction, ssh fan-out
+(reference launcher/dist_launcher.py — SURVEY.md §2.5).  ssh is stubbed
+with a local runner so the fan-out, env injection, and exit-code paths are
+exercised without a network."""
+
+import os
+import subprocess
+
+import pytest
+
+from byteps_tpu.launcher import dist_launcher as dl
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# comment\nhost-a\nhost-b:2222\n\nhost-c : ignored\n")
+    hosts = dl.parse_hostfile(str(hf))
+    assert hosts[0] == ("host-a", "22")
+    assert hosts[1] == ("host-b", "2222")
+    with pytest.raises(ValueError):
+        empty = tmp_path / "empty"
+        empty.write_text("\n# nothing\n")
+        dl.parse_hostfile(str(empty))
+
+
+def test_build_env_dmlc_protocol():
+    hosts = [("w0", "22"), ("w1", "22"), ("w2", "22")]
+    env = dl.build_env(hosts, worker_id=1, coordinator_port=9100,
+                       extra={"FOO": "bar"})
+    assert env["DMLC_ROLE"] == "worker"
+    assert env["DMLC_NUM_WORKER"] == "3"
+    assert env["DMLC_WORKER_ID"] == "1"
+    assert env["DMLC_PS_ROOT_URI"] == "w0"
+    assert env["DMLC_PS_ROOT_PORT"] == "9100"
+    assert env["FOO"] == "bar"
+
+
+def test_ssh_argv_no_shell_injection():
+    argv = dl.ssh_argv("host-a", "22", {"A": "1"},
+                       ["python", "train.py", "--name", "a b; rm -rf /"])
+    assert argv[0] == "ssh"
+    remote = argv[-1]
+    # the dangerous arg arrives as ONE quoted token
+    assert "'a b; rm -rf /'" in remote
+    assert remote.startswith("env A=1 python train.py")
+
+
+def test_launch_fans_out_and_collects_exit_codes(tmp_path):
+    hf_hosts = [("h0", "22"), ("h1", "22"), ("h2", "22")]
+    seen = {}
+
+    def fake_ssh(argv, stdout, stderr):
+        host = argv[argv.index("-p") + 2]  # ssh ... -p 22 host 'cmd'
+        seen[host] = argv[-1]
+        stdout.write(f"hello from {host}\n".encode())
+        return 0 if host != "h2" else 3
+
+    codes = dl.launch(hf_hosts, ["python", "-c", "pass"],
+                      extra_env={"X": "y"},
+                      log_dir=str(tmp_path / "logs"), ssh_runner=fake_ssh)
+    assert codes == [0, 0, 3]
+    assert set(seen) == {"h0", "h1", "h2"}
+    # per-worker env baked into the remote command
+    assert "DMLC_WORKER_ID=0" in seen["h0"]
+    assert "DMLC_WORKER_ID=2" in seen["h2"]
+    assert "X=y" in seen["h1"]
+    assert (tmp_path / "logs" / "worker0.stdout").read_bytes() \
+        .startswith(b"hello from h0")
+
+
+def test_launch_signal_death_not_masked(tmp_path, monkeypatch):
+    """A worker killed by a signal (negative code) must fail the launch
+    even when other workers exit 0."""
+    hf = tmp_path / "hosts"
+    hf.write_text("h0\nh1\n")
+
+    def fake_ssh(argv, stdout, stderr):
+        host = argv[argv.index("-p") + 2]
+        return 0 if host == "h0" else -9
+
+    orig = dl.launch
+    monkeypatch.setattr(dl, "launch",
+                        lambda hosts, cmd, **kw: orig(
+                            hosts, cmd, **{**kw, "ssh_runner": fake_ssh}))
+    rc = dl.main(["-H", str(hf), "--log-dir", str(tmp_path / "l"),
+                  "--", "true"])
+    assert rc == 9
+
+
+def test_inner_double_dash_survives(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("h0\n")
+    seen = {}
+
+    def fake_ssh(argv, stdout, stderr):
+        seen["remote"] = argv[-1]
+        return 0
+
+    import byteps_tpu.launcher.dist_launcher as mod
+    orig = mod.launch
+
+    def patched(hosts, cmd, **kw):
+        kw["ssh_runner"] = fake_ssh
+        return orig(hosts, cmd, **kw)
+
+    mod.launch = patched
+    try:
+        rc = mod.main(["-H", str(hf), "--log-dir", str(tmp_path / "l"),
+                       "--", "git", "log", "--", "path"])
+    finally:
+        mod.launch = orig
+    assert rc == 0
+    # leading separator stripped, inner "--" preserved
+    assert seen["remote"].endswith("git log -- path")
+
+
+def test_main_end_to_end_with_local_sh(tmp_path, monkeypatch):
+    """Full CLI path with ssh replaced by a local shim that executes the
+    remote command on this machine."""
+    hf = tmp_path / "hosts"
+    hf.write_text("localhost\n")
+    shim = tmp_path / "ssh"
+    shim.write_text("#!/bin/sh\n# drop ssh options; run last arg locally\n"
+                    'eval "${@: -1}"\n')
+    shim.chmod(0o755)
+
+    monkeypatch.chdir(tmp_path)
+    real_call = subprocess.call
+
+    def call_with_shim(argv, **kw):
+        assert argv[0] == "ssh"
+        return real_call(["bash", str(shim)] + argv[1:], **kw)
+
+    monkeypatch.setattr(subprocess, "call", call_with_shim)
+    rc = dl.main(["-H", str(hf), "--env", "PROBE:42", "--",
+                  "python", "-c",
+                  "import os; print(os.environ['DMLC_NUM_WORKER'], "
+                  "os.environ['PROBE'])"])
+    assert rc == 0
+    out = (tmp_path / "sshlog" / "worker0.stdout").read_text()
+    assert out.strip() == "1 42"
