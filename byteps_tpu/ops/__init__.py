@@ -13,4 +13,5 @@ from .collective_ops import (  # noqa: F401
     push_pull_tree,
     broadcast_tree,
     hierarchical_push_pull,
+    make_onebit_pair,
 )

@@ -1,0 +1,72 @@
+"""BERT-large MLM training throughput (the reference's headline benchmark,
+README.md:35-41 / BASELINE.md) on the byteps_tpu fused DP path.
+
+Run:  python example/jax/benchmark_bert.py [--steps N] [--batch B]
+      [--seq L] [--compress-dcn]  (onebit on the inter-slice hop)
+CPU smoke uses bert_tiny automatically.
+"""
+
+import argparse
+import time
+
+import jax
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.comm.mesh import get_comm
+from byteps_tpu.models.bert import (BertForMLM, bert_large, bert_tiny,
+                                    mlm_loss, synthetic_batch)
+from byteps_tpu.parallel import make_dp_train_step, replicate, shard_batch
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20 if on_tpu else 3)
+    ap.add_argument("--batch", type=int, default=32 if on_tpu else 2)
+    ap.add_argument("--seq", type=int, default=128 if on_tpu else 32)
+    ap.add_argument("--compress-dcn", action="store_true")
+    args = ap.parse_args()
+
+    bps.init()
+    comm = get_comm()
+    n = comm.num_ranks
+    cfg = bert_large() if on_tpu else bert_tiny()
+    model = BertForMLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    gb = args.batch * n
+    batch = synthetic_batch(rng, cfg, batch=gb, seq_len=args.seq)
+    params = model.init(rng, batch["input_ids"][:1],
+                        batch["attention_mask"][:1])
+    tx = optax.adamw(1e-4)
+
+    def loss_fn(p, b):
+        logits = model.apply(p, b["input_ids"], b["attention_mask"])
+        return mlm_loss(logits, b["labels"])
+
+    compress = None
+    if args.compress_dcn:
+        from byteps_tpu.ops import make_onebit_pair
+        compress = make_onebit_pair()
+
+    step = make_dp_train_step(comm, loss_fn, tx, compress_dcn=compress)
+    params = replicate(comm, params)
+    opt_state = replicate(comm, tx.init(params))
+    batch = shard_batch(comm, batch)
+
+    params, opt_state, loss = step(params, opt_state, batch)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    eps = args.steps * gb / dt
+    print(f"loss {float(loss):.4f}  {eps:.1f} examples/s "
+          f"({eps / n:.1f}/chip, {n} chips)")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()
