@@ -17,10 +17,15 @@ Reference parity map (reference file:line):
   - BYTEPS_FORCE_DISTRIBUTED     global.cc              -> force_distributed
   - DMLC_NUM_WORKER / DMLC_WORKER_ID (docs/env.md:11-17) -> num_hosts / host_id
   - BYTEPS_LOCAL_RANK/LOCAL_SIZE  launch.py:180-206     -> local_rank/local_size
+  - BYTEPS_SERVER_ENGINE_THREAD   server.cc:407-439     -> server_engine_threads
+  - BYTEPS_SERVER_ENABLE_SCHEDULE queue.h:31-104        -> server_enable_schedule
+  - BYTEPS_SERVER_DEBUG_KEY       server.cc:421-425     -> server_debug_key
+  - BYTEPS_KEY_HASH_FN            global.cc:159-176     -> key_hash_fn
+  - BYTEPS_DEBUG_SAMPLE_TENSOR    core_loops.cc:37-67   -> debug_sample_tensor
 
 Knobs that only exist because of the reference's CPU/GPU/NIC split (PCIe switch
-size, NCCL rings, NUMA pinning, server engine threads, shm paths) have no TPU
-meaning and are intentionally absent; unknown BYTEPS_* vars are ignored.
+size, NCCL rings, NUMA pinning, shm paths) have no TPU meaning and are
+intentionally absent; unknown BYTEPS_* vars are ignored.
 """
 
 from __future__ import annotations
@@ -84,6 +89,13 @@ class Config:
     # --- modes ---
     enable_async: bool = False       # BYTEPS_ENABLE_ASYNC (async-PS weight deltas)
 
+    # --- server engine (async-PS merge; reference server.cc) ---
+    server_engine_threads: int = 4   # BYTEPS_SERVER_ENGINE_THREAD
+    server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
+    server_debug_key: str = ""       # BYTEPS_SERVER_DEBUG_KEY
+    key_hash_fn: str = "djb2"        # BYTEPS_KEY_HASH_FN
+    debug_sample_tensor: str = ""    # BYTEPS_DEBUG_SAMPLE_TENSOR substring
+
     # --- observability ---
     log_level: str = "WARNING"       # BYTEPS_LOG_LEVEL
     trace_on: bool = False           # BYTEPS_TRACE_ON
@@ -121,6 +133,12 @@ class Config:
             use_native=_env_bool("BYTEPS_NATIVE", True),
             use_pallas=_env_bool("BYTEPS_PALLAS", True),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC", False),
+            server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
+            server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE",
+                                             False),
+            server_debug_key=_env_str("BYTEPS_SERVER_DEBUG_KEY", ""),
+            key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
+            debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON", False),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

@@ -243,6 +243,31 @@ class PushPullEngine:
                     pending.handle.set_result(None, Status.error(str(e)))
         return cb
 
+    def _debug_sample(self, task, out) -> None:
+        """Stage-wise tensor sampling (reference BYTEPS_DEBUG_SAMPLE_TENSOR,
+        core_loops.cc:37-67): when the configured substring matches the
+        tensor name, log input/output summaries of the chunk's reduction —
+        the grep-able breadcrumb for divergence hunting.  Called from the
+        sync loop, after the collective completed: the host fetch here
+        cannot stall dispatch pipelining."""
+        pat = self.cfg.debug_sample_tensor
+        if not pat or pat not in task.name:
+            return
+        try:
+            i = np.asarray(task.data[0]).astype(np.float64)
+            o = np.asarray(out).astype(np.float64)
+            get_logger().warning(
+                "sample %s key=%d off=%d in[sum=%.6g abs=%.6g first=%.6g] "
+                "out[sum=%.6g abs=%.6g first=%.6g]",
+                task.name, task.key, task.offset_elems,
+                i.sum(), np.abs(i).sum(), i.flat[0],
+                o.sum(), np.abs(o).sum(), o.flat[0])
+        except Exception:  # noqa: BLE001 — sampling must never kill a loop
+            # a dead sampler must be discoverable (e.g. non-addressable
+            # shards under multi-host): say why once per failure
+            get_logger().debug("debug sample for %s failed", task.name,
+                               exc_info=True)
+
     # ---------------------------------------------------------- loops
     def _dispatch_loop(self):
         while self._running:
@@ -292,6 +317,8 @@ class PushPullEngine:
                         slot, wst, sst = rollback
                         slot.wstates = wst
                         slot.sstate = sst
+            if err is None:
+                self._debug_sample(task, out)
             self.scheduler.report_finish(task.nbytes)
             if self.tracer.enabled:
                 t_done = self.tracer.now()

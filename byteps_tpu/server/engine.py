@@ -1,0 +1,225 @@
+"""Server sum-engine semantics: multi-threaded, priority-scheduled merge.
+
+Reference behavior being re-created (SURVEY.md §2.3, server.cc / queue.h):
+
+- N engine threads (``BYTEPS_SERVER_ENGINE_THREAD``, default 4), each
+  draining its own queue; keys are sticky-assigned to the least-loaded
+  thread by accumulated bytes (server.h:149-173 GetThreadID).
+- Sync flow per key and round: the first worker's push is COPY_FIRST
+  (replaces the store), later workers are SUM_RECV (in-place sum via the
+  native reducer), and when all ``num_workers`` arrived (ALL_RECV) the
+  merged version is published and parked pulls are answered
+  (server.cc:290-404).
+- Optional scheduling (``BYTEPS_SERVER_ENABLE_SCHEDULE``): queues pop the
+  message whose key has the *fewest* outstanding pushes first — keys
+  closest to completing a merge go first, unblocking pulls sooner
+  (queue.h:31-104; counters cleared on ALL_RECV).
+- Debug value printing for a key (``BYTEPS_SERVER_DEBUG[_KEY]``,
+  server.cc:115-139).
+
+On TPU the synchronous reduction itself lives in XLA collectives — this
+engine exists for the *stateful* paths that genuinely need a host: the
+async-PS mode (KVStore uses it to merge deltas off the caller's thread)
+and tests that pin the reference's server semantics.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..native import inplace_add
+
+
+@dataclass(order=True)
+class _Msg:
+    sort_key: tuple
+    seq: int = field(compare=False)
+    key: str = field(compare=False)
+    value: Optional[np.ndarray] = field(compare=False, default=None)
+    worker_id: int = field(compare=False, default=0)
+    num_workers: int = field(compare=False, default=1)
+    kind: str = field(compare=False, default="push")  # push | stop
+
+
+class PriorityQueue:
+    """queue.h parity: FIFO by default; with scheduling enabled, pops the
+    entry whose key has the fewest outstanding pushes (ties by arrival)."""
+
+    def __init__(self, enable_schedule: bool):
+        self._sched = enable_schedule
+        self._heap: List[_Msg] = []
+        self._cv = threading.Condition()
+        self._push_cnt: Dict[str, int] = {}
+        self._seq = itertools.count()
+
+    def push(self, msg: _Msg) -> None:
+        with self._cv:
+            seq = next(self._seq)
+            msg.seq = seq
+            if self._sched:
+                cnt = self._push_cnt.get(msg.key, 0) + 1
+                self._push_cnt[msg.key] = cnt
+            # re-keying on pop keeps it simple: priority is evaluated at
+            # push time like the reference (heap re-sorted per operation)
+            msg.sort_key = (self._push_cnt.get(msg.key, 0) if self._sched
+                            else 0, seq)
+            heapq.heappush(self._heap, msg)
+            self._cv.notify()
+
+    def wait_and_pop(self) -> _Msg:
+        with self._cv:
+            self._cv.wait_for(lambda: self._heap)
+            return heapq.heappop(self._heap)
+
+    def clear_counter(self, key: str) -> None:
+        if not self._sched:
+            return
+        with self._cv:
+            self._push_cnt[key] = 0
+
+
+class _KeyState:
+    __slots__ = ("merged", "count", "version", "parked", "lock",
+                 "submitted")
+
+    def __init__(self):
+        self.merged: Optional[np.ndarray] = None
+        self.count = 0          # pushes processed this round
+        self.version = 0        # completed merge rounds
+        self.submitted = 0      # pushes enqueued (caller side)
+        self.parked: List[Callable[[np.ndarray], None]] = []
+        self.lock = threading.Lock()
+
+
+class ServerEngine:
+    """The merge engine: push/pull with the reference's barrier flow."""
+
+    def __init__(self, num_threads: Optional[int] = None,
+                 enable_schedule: Optional[bool] = None,
+                 debug_key: Optional[str] = None):
+        from ..common.config import get_config
+        cfg = get_config()
+        self.num_threads = (num_threads if num_threads is not None
+                            else cfg.server_engine_threads)
+        if self.num_threads < 1:
+            raise ValueError("need at least one engine thread")
+        sched = (enable_schedule if enable_schedule is not None
+                 else cfg.server_enable_schedule)
+        self._debug_key = (debug_key if debug_key is not None
+                           else cfg.server_debug_key)
+        self.queues = [PriorityQueue(sched) for _ in range(self.num_threads)]
+        self._states: Dict[str, _KeyState] = {}
+        self._states_lock = threading.Lock()
+        # sticky least-loaded-by-bytes assignment (server.h GetThreadID)
+        self._tid_of: Dict[str, int] = {}
+        self._acc_load = [0] * self.num_threads
+        self._assign_lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, args=(q,), daemon=True,
+                             name=f"bps-server-engine-{i}")
+            for i, q in enumerate(self.queues)]
+        for t in self._threads:
+            t.start()
+
+    # -- assignment --------------------------------------------------------
+
+    def thread_id(self, key: str, nbytes: int) -> int:
+        with self._assign_lock:
+            tid = self._tid_of.get(key)
+            if tid is None:
+                tid = min(range(self.num_threads),
+                          key=lambda i: self._acc_load[i])
+                self._tid_of[key] = tid
+                self._acc_load[tid] += nbytes
+            return tid
+
+    def _state(self, key: str) -> _KeyState:
+        with self._states_lock:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = _KeyState()
+            return st
+
+    # -- public API --------------------------------------------------------
+
+    def push(self, key: str, value, worker_id: int,
+             num_workers: int) -> None:
+        """One worker's contribution for this round (non-blocking)."""
+        arr = np.asarray(value)
+        st = self._state(key)
+        with st.lock:
+            st.submitted += 1
+        q = self.queues[self.thread_id(key, arr.nbytes)]
+        q.push(_Msg(sort_key=(0, 0), seq=0, key=key, value=arr,
+                    worker_id=worker_id, num_workers=num_workers))
+
+    def pull(self, key: str, timeout: Optional[float] = None) -> np.ndarray:
+        """Blocks until the current round's merge completes (parked-pull
+        semantics, server.cc:371-404)."""
+        st = self._state(key)
+        ev = threading.Event()
+        box: Dict[str, np.ndarray] = {}
+
+        def fulfill(arr: np.ndarray) -> None:
+            box["v"] = arr
+            ev.set()
+
+        with st.lock:
+            # answer immediately only when no round is in flight: all
+            # enqueued pushes have been folded into a published merge
+            # (arrival-order semantics of the reference handler — a pull
+            # enqueued after a round's pushes waits for that round)
+            if st.version > 0 and st.submitted == 0:
+                return np.array(st.merged, copy=True)
+            st.parked.append(fulfill)
+        if not ev.wait(timeout):
+            raise TimeoutError(f"pull({key!r}) timed out")
+        return box["v"]
+
+    def version(self, key: str) -> int:
+        return self._state(key).version
+
+    def shutdown(self) -> None:
+        for q in self.queues:
+            q.push(_Msg(sort_key=(0, 0), seq=0, key="", kind="stop"))
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- engine thread -----------------------------------------------------
+
+    def _run(self, q: PriorityQueue) -> None:
+        while True:
+            msg = q.wait_and_pop()
+            if msg.kind == "stop":
+                return
+            st = self._state(msg.key)
+            with st.lock:
+                st.submitted -= 1
+                if st.count == 0:
+                    # COPY_FIRST: first worker replaces last round's merge
+                    st.merged = np.array(msg.value, copy=True)
+                else:
+                    # SUM_RECV: native multithreaded in-place sum
+                    inplace_add(st.merged, msg.value)
+                st.count += 1
+                if msg.key == self._debug_key:
+                    get_logger().warning(
+                        "server debug key=%s recv %d/%d sum=%.6f",
+                        msg.key, st.count, msg.num_workers,
+                        float(np.sum(st.merged)))
+                if st.count >= msg.num_workers:
+                    # ALL_RECV: publish + flush parked pulls
+                    st.count = 0
+                    st.version += 1
+                    q.clear_counter(msg.key)
+                    parked, st.parked = st.parked, []
+                    out = st.merged
+                    for fulfill in parked:
+                        fulfill(np.array(out, copy=True))

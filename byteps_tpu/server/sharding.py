@@ -1,0 +1,121 @@
+"""Key -> server/shard assignment: the reference's server-choice hashing.
+
+Reference (global.cc:566-677): each 64-bit chunk key is routed to one of
+``num_servers`` by a configurable hash (``BYTEPS_KEY_HASH_FN`` =
+naive | built_in | djb2 | sdbm | mixed), with per-server byte-load
+accounting logged at shutdown.  Mixed mode splits traffic between
+non-colocated and colocated servers by a ratio derived from the cluster
+shape (``BYTEPS_ENABLE_MIXED_MODE`` / ``BYTEPS_MIXED_MODE_BOUND``).
+
+TPU mapping: there are no server processes, but the same assignment
+problem appears when the hierarchical reduction shards chunks across DCN
+slices or when the async KV store is partitioned across hosts — this
+module is that router, hash-compatible with the reference so documented
+tuning advice carries over.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["hash_naive", "hash_built_in", "hash_djb2", "hash_sdbm",
+           "ServerAssigner"]
+
+_MASK = (1 << 64) - 1
+
+
+def hash_naive(key: int) -> int:
+    # global.cc:598 — ((key>>16) + (key%65536)) * 9973
+    return (((key >> 16) + (key % 65536)) * 9973) & _MASK
+
+
+def hash_built_in(key: int) -> int:
+    # std::hash<string> is implementation-defined; Python's spread stands in
+    return (hash(str(key)) * 9973) & _MASK
+
+
+def hash_djb2(key: int) -> int:
+    h = 5381
+    for c in str(key).encode():
+        h = ((h << 5) + h + c) & _MASK      # h*33 + c
+    return h
+
+
+def hash_sdbm(key: int) -> int:
+    h = 0
+    for c in str(key).encode():
+        h = (c + (h << 6) + (h << 16) - h) & _MASK  # h*65599 + c
+    return h
+
+
+_FNS = {"naive": hash_naive, "built_in": hash_built_in,
+        "djb2": hash_djb2, "sdbm": hash_sdbm}
+
+
+class ServerAssigner:
+    """Stable key->server routing with byte-load accounting.
+
+    ``mixed`` mode (global.cc:566-596): with W workers colocated with
+    servers and S total servers, the first ``ratio`` share of hash space
+    goes to the S-W non-colocated servers, the rest to colocated ones —
+    keeping the colocated machines' NICs from double-duty."""
+
+    def __init__(self, num_servers: int, fn: Optional[str] = None,
+                 mixed_mode: bool = False, num_workers: int = 0,
+                 bound: int = 101):
+        if num_servers < 1:
+            raise ValueError("num_servers must be >= 1")
+        if fn is None:
+            # BYTEPS_KEY_HASH_FN (reference global.cc:159-176)
+            from ..common.config import get_config
+            fn = get_config().key_hash_fn
+        if fn not in _FNS:
+            raise ValueError(f"unknown hash fn {fn!r}; one of {list(_FNS)}")
+        self.num_servers = num_servers
+        self.fn_name = fn
+        self._fn = _FNS[fn]
+        self._mixed = mixed_mode
+        self._bound = bound
+        self._num_workers = num_workers
+        if mixed_mode:
+            nonco = num_servers - num_workers
+            if not 0 < nonco <= num_workers:
+                raise ValueError(
+                    "mixed mode needs 0 < num_servers - num_workers <= "
+                    "num_workers (global.cc ratio constraint)")
+            if bound < num_servers:
+                raise ValueError("BYTEPS_MIXED_MODE_BOUND must be >= "
+                                 "num_servers")
+            w = num_workers
+            self._ratio = (2.0 * nonco * (w - 1)) / (
+                w * (w + nonco) - 2 * nonco)
+            self._threshold = self._ratio * bound
+            self._nonco = nonco
+        self._cache: Dict[int, int] = {}
+        self.load_bytes: List[int] = [0] * num_servers
+        self._lock = threading.Lock()
+
+    def assign(self, key: int, nbytes: int = 0) -> int:
+        with self._lock:
+            sid = self._cache.get(key)
+            if sid is None:
+                if self._mixed:
+                    r = hash_djb2(key) % self._bound
+                    if r < self._threshold:
+                        sid = hash_djb2(r) % self._nonco
+                    else:
+                        sid = self._nonco + hash_djb2(r) % self._num_workers
+                else:
+                    sid = self._fn(key) % self.num_servers
+                self._cache[key] = sid
+            self.load_bytes[sid] += nbytes
+            return sid
+
+    def load_summary(self) -> str:
+        """Per-server accumulated bytes (the reference logs this at
+        shutdown for balance debugging)."""
+        total = sum(self.load_bytes) or 1
+        return ", ".join(
+            f"s{i}: {b} ({100.0 * b / total:.1f}%)"
+            for i, b in enumerate(self.load_bytes))

@@ -1,0 +1,177 @@
+"""Server sum-engine + key-sharding semantics (SURVEY.md §2.3:
+server.cc COPY_FIRST/SUM_RECV/ALL_RECV flow, queue.h priority
+scheduling, server.h sticky thread assignment, global.cc hashing)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.server.engine import PriorityQueue, ServerEngine, _Msg
+from byteps_tpu.server.sharding import (ServerAssigner, hash_djb2,
+                                        hash_naive, hash_sdbm)
+
+
+def _msg(key, **kw):
+    return _Msg(sort_key=(0, 0), seq=0, key=key, **kw)
+
+
+# --- merge flow -------------------------------------------------------------
+
+
+def test_push_pull_barrier_flow():
+    eng = ServerEngine(num_threads=2)
+    try:
+        w = 3
+        for r in range(w):
+            eng.push("k", np.full(4, float(r + 1)), worker_id=r,
+                     num_workers=w)
+        out = eng.pull("k", timeout=5)
+        np.testing.assert_allclose(out, 6.0)   # 1+2+3
+        assert eng.version("k") == 1
+        # next round: COPY_FIRST replaces, not accumulates
+        for r in range(w):
+            eng.push("k", np.full(4, 1.0), worker_id=r, num_workers=w)
+        np.testing.assert_allclose(eng.pull("k", timeout=5), 3.0)
+        assert eng.version("k") == 2
+    finally:
+        eng.shutdown()
+
+
+def test_pull_parks_until_all_workers_arrive():
+    eng = ServerEngine(num_threads=1)
+    try:
+        eng.push("p", np.ones(2), worker_id=0, num_workers=2)
+        got = {}
+
+        def puller():
+            got["v"] = eng.pull("p", timeout=5)
+
+        t = threading.Thread(target=puller)
+        t.start()
+        time.sleep(0.15)
+        assert "v" not in got          # parked: only 1/2 pushes in
+        eng.push("p", np.ones(2), worker_id=1, num_workers=2)
+        t.join(timeout=5)
+        np.testing.assert_allclose(got["v"], 2.0)
+    finally:
+        eng.shutdown()
+
+
+def test_many_keys_many_threads_consistent():
+    eng = ServerEngine(num_threads=4)
+    try:
+        w, keys = 4, [f"t{i}" for i in range(16)]
+        for k in keys:
+            for r in range(w):
+                eng.push(k, np.full(8, float(r)), worker_id=r, num_workers=w)
+        for k in keys:
+            np.testing.assert_allclose(eng.pull(k, timeout=5), 0 + 1 + 2 + 3)
+    finally:
+        eng.shutdown()
+
+
+def test_sticky_least_loaded_assignment():
+    eng = ServerEngine(num_threads=2)
+    try:
+        a = eng.thread_id("a", 100)
+        b = eng.thread_id("b", 10)
+        assert a != b                   # second key goes to the idle thread
+        c = eng.thread_id("c", 10)
+        assert c == b                   # b's thread still lighter (20 < 100)
+        assert eng.thread_id("a", 999) == a  # sticky: cached, no rebalance
+    finally:
+        eng.shutdown()
+
+
+# --- priority queue ---------------------------------------------------------
+
+
+def test_priority_queue_fifo_without_schedule():
+    q = PriorityQueue(enable_schedule=False)
+    for i, k in enumerate(["x", "y", "x"]):
+        q.push(_msg(k, worker_id=i))
+    order = [q.wait_and_pop().worker_id for _ in range(3)]
+    assert order == [0, 1, 2]
+
+
+def test_priority_queue_schedule_prefers_fewest_outstanding():
+    """queue.h ComparePriority: the key with fewer outstanding pushes pops
+    first (it is closer to completing its merge)."""
+    q = PriorityQueue(enable_schedule=True)
+    q.push(_msg("busy", worker_id=0))
+    q.push(_msg("busy", worker_id=1))
+    q.push(_msg("fresh", worker_id=2))
+    first = q.wait_and_pop()
+    assert first.key in ("busy", "fresh")
+    # 'fresh' (1 outstanding) must come out before busy's second message
+    popped = [first.key] + [q.wait_and_pop().key for _ in range(2)]
+    assert popped.index("fresh") <= 1
+    q.clear_counter("busy")
+
+
+# --- sharding ---------------------------------------------------------------
+
+
+def test_hash_fns_match_reference_formulas():
+    # djb2/sdbm over the decimal string of the key (global.cc:606-628)
+    assert hash_djb2(0) == (5381 * 33 + ord("0")) & ((1 << 64) - 1)
+    h = 0
+    for c in b"12":
+        h = (c + (h << 6) + (h << 16) - h) & ((1 << 64) - 1)
+    assert hash_sdbm(12) == h
+    assert hash_naive(1 << 16) == 9973  # (key>>16 + 0) * 9973 with key=65536
+
+
+def test_assigner_stable_and_accounted():
+    a = ServerAssigner(num_servers=4, fn="djb2")
+    s1 = a.assign(42, nbytes=100)
+    assert a.assign(42, nbytes=50) == s1      # sticky
+    assert a.load_bytes[s1] == 150
+    spread = {a.assign(k << 16) for k in range(64)}
+    assert len(spread) >= 3                   # keys spread across servers
+    assert "s0" in a.load_summary()
+
+
+def test_assigner_mixed_mode_ranges():
+    # 5 servers, 3 workers -> 2 non-colocated; ratio = 8/11, so both
+    # groups get traffic across many keys
+    a = ServerAssigner(num_servers=5, fn="djb2", mixed_mode=True,
+                       num_workers=3)
+    sids = [a.assign(k << 16) for k in range(200)]
+    assert all(0 <= s < 5 for s in sids)
+    assert any(s < 2 for s in sids) and any(s >= 2 for s in sids)
+    with pytest.raises(ValueError):
+        ServerAssigner(num_servers=2, fn="djb2", mixed_mode=True,
+                       num_workers=2)   # no non-colocated servers
+
+
+def test_debug_sample_tensor_logs():
+    """BYTEPS_DEBUG_SAMPLE_TENSOR emits stage samples for matching names.
+    (The byteps logger has its own handler and does not propagate, so a
+    capture handler is attached directly rather than using caplog.)"""
+    import dataclasses
+    import logging
+    import jax.numpy as jnp
+    import byteps_tpu as bps
+    from byteps_tpu.common.config import get_config, set_config
+    from byteps_tpu.common.logging import get_logger
+    old = get_config()
+    set_config(dataclasses.replace(old, debug_sample_tensor="dbg/"))
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    get_logger().addHandler(handler)
+    try:
+        bps.init()
+        x = jnp.ones((bps.size(), 32), jnp.float32)
+        bps.push_pull(x, "dbg/w")
+        bps.push_pull(x, "quiet/w")
+        msgs = [r.getMessage() for r in records]
+        assert any("sample dbg/w" in m for m in msgs), msgs
+        assert not any("sample quiet" in m for m in msgs)
+    finally:
+        get_logger().removeHandler(handler)
+        bps.shutdown()
+        set_config(old)
