@@ -52,8 +52,10 @@ def main() -> int:
     params = model.init(rng, batch["input_ids"], batch["attention_mask"])
 
     def loss_fn(params, b):
-        logits = model.apply(params, b["input_ids"], b["attention_mask"])
-        return mlm_loss(logits, b["labels"])
+        # gathered MLM head: vocab projection only on masked positions
+        logits = model.apply(params, b["input_ids"], b["attention_mask"],
+                             masked_positions=b["masked_positions"])
+        return mlm_loss(logits, b["masked_labels"])
 
     tx = optax.adamw(1e-4)
     opt_state = tx.init(params)

@@ -113,14 +113,24 @@ class BertEncoder(nn.Module):
 
 
 class BertForMLM(nn.Module):
-    """Masked-LM head — the pretraining objective of the headline bench."""
+    """Masked-LM head — the pretraining objective of the headline bench.
+
+    With ``masked_positions`` ([B, P] indices) the head runs only on the
+    masked tokens: the vocab projection and softmax shrink from [B, T, V]
+    to [B, P, V] — at 15% masking that is ~6x less head FLOPs and HBM
+    traffic (the [B, T, 30k] f32 logits tensor never exists).  Without it,
+    the full-sequence logits are returned (HF-compatible shape)."""
 
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None):
+    def __call__(self, input_ids, attention_mask=None,
+                 masked_positions=None):
         cfg = self.cfg
         x = BertEncoder(cfg, name="encoder")(input_ids, attention_mask)
+        if masked_positions is not None:
+            x = jnp.take_along_axis(
+                x, masked_positions[..., None].astype(jnp.int32), axis=1)
         x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
         x = jax.nn.gelu(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")(x)
@@ -143,11 +153,25 @@ def mlm_loss(logits, labels, weights=None):
 def synthetic_batch(rng: "jax.Array", cfg: BertConfig, batch: int,
                     seq_len: int, mask_frac: float = 0.15):
     """Deterministic fake pretraining batch (reference benchmarks use
-    synthetic data too, example/pytorch/benchmark_byteps.py)."""
-    k1, k2, k3 = jax.random.split(rng, 3)
+    synthetic data too, example/pytorch/benchmark_byteps.py).
+
+    Masks exactly ``P = max(1, int(seq_len * mask_frac))`` positions per
+    example so the gathered-head path has static shapes: the returned
+    ``masked_positions``/``masked_labels`` ([B, P]) feed
+    ``BertForMLM(..., masked_positions=...)``; the full-length ``labels``
+    (-1 on unmasked) remain for the ungathered path."""
+    k1, k2 = jax.random.split(rng, 2)
     ids = jax.random.randint(k1, (batch, seq_len), 0, cfg.vocab_size)
-    is_masked = jax.random.uniform(k2, (batch, seq_len)) < mask_frac
+    n_pred = max(1, int(seq_len * mask_frac))
+    perm = jax.vmap(lambda k: jax.random.permutation(k, seq_len))(
+        jax.random.split(k2, batch))
+    positions = jnp.sort(perm[:, :n_pred].astype(jnp.int32), axis=1)
+    is_masked = jnp.zeros((batch, seq_len), bool)
+    is_masked = jax.vmap(lambda m, p: m.at[p].set(True))(is_masked,
+                                                         positions)
     labels = jnp.where(is_masked, ids, -1)
     input_ids = jnp.where(is_masked, jnp.zeros_like(ids), ids)
+    masked_labels = jnp.take_along_axis(ids, positions, axis=1)
     return {"input_ids": input_ids, "labels": labels,
-            "attention_mask": jnp.ones((batch, seq_len), jnp.int32)}
+            "attention_mask": jnp.ones((batch, seq_len), jnp.int32),
+            "masked_positions": positions, "masked_labels": masked_labels}

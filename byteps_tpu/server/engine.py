@@ -151,10 +151,16 @@ class ServerEngine:
 
     def push(self, key: str, value, worker_id: int,
              num_workers: int) -> None:
-        """One worker's contribution for this round (non-blocking)."""
+        """One worker's contribution for this round (non-blocking).
+        Shape mismatches raise here, in the caller's thread — a bad push
+        must never reach COPY_FIRST/SUM_RECV on the engine thread."""
         arr = np.asarray(value)
         st = self._state(key)
         with st.lock:
+            if st.merged is not None and arr.shape != st.merged.shape:
+                raise ValueError(
+                    f"push({key!r}): shape {arr.shape} != established "
+                    f"{st.merged.shape}")
             st.submitted += 1
         q = self.queues[self.thread_id(key, arr.nbytes)]
         q.push(_Msg(sort_key=(0, 0), seq=0, key=key, value=arr,
@@ -172,11 +178,13 @@ class ServerEngine:
             ev.set()
 
         with st.lock:
-            # answer immediately only when no round is in flight: all
-            # enqueued pushes have been folded into a published merge
-            # (arrival-order semantics of the reference handler — a pull
-            # enqueued after a round's pushes waits for that round)
-            if st.version > 0 and st.submitted == 0:
+            # answer immediately only when no round is in flight: nothing
+            # queued (submitted == 0) AND nothing partially merged
+            # (count == 0) — a popped-but-unfinished round would otherwise
+            # leak one worker's raw contribution (arrival-order semantics
+            # of the reference handler: a pull enqueued after a round's
+            # pushes waits for that round)
+            if st.version > 0 and st.submitted == 0 and st.count == 0:
                 return np.array(st.merged, copy=True)
             st.parked.append(fulfill)
         if not ev.wait(timeout):
@@ -199,27 +207,41 @@ class ServerEngine:
             msg = q.wait_and_pop()
             if msg.kind == "stop":
                 return
-            st = self._state(msg.key)
-            with st.lock:
-                st.submitted -= 1
-                if st.count == 0:
-                    # COPY_FIRST: first worker replaces last round's merge
-                    st.merged = np.array(msg.value, copy=True)
-                else:
-                    # SUM_RECV: native multithreaded in-place sum
-                    inplace_add(st.merged, msg.value)
-                st.count += 1
-                if msg.key == self._debug_key:
-                    get_logger().warning(
-                        "server debug key=%s recv %d/%d sum=%.6f",
-                        msg.key, st.count, msg.num_workers,
-                        float(np.sum(st.merged)))
-                if st.count >= msg.num_workers:
-                    # ALL_RECV: publish + flush parked pulls
+            try:
+                self._process(msg, q)
+            except Exception:  # noqa: BLE001 — a bad push (mismatched
+                # shape/dtype) must not kill the engine thread and strand
+                # every key sticky-assigned to it
+                get_logger().error(
+                    "server engine: merge failed for key=%r (round "
+                    "abandoned; parked pulls will time out)", msg.key,
+                    exc_info=True)
+                st = self._state(msg.key)
+                with st.lock:
                     st.count = 0
-                    st.version += 1
-                    q.clear_counter(msg.key)
-                    parked, st.parked = st.parked, []
-                    out = st.merged
-                    for fulfill in parked:
-                        fulfill(np.array(out, copy=True))
+
+    def _process(self, msg: _Msg, q: PriorityQueue) -> None:
+        st = self._state(msg.key)
+        with st.lock:
+            st.submitted -= 1
+            if st.count == 0:
+                # COPY_FIRST: first worker replaces last round's merge
+                st.merged = np.array(msg.value, copy=True)
+            else:
+                # SUM_RECV: native multithreaded in-place sum
+                inplace_add(st.merged, msg.value)
+            st.count += 1
+            if msg.key == self._debug_key:
+                get_logger().warning(
+                    "server debug key=%s recv %d/%d sum=%.6f",
+                    msg.key, st.count, msg.num_workers,
+                    float(np.sum(st.merged)))
+            if st.count >= msg.num_workers:
+                # ALL_RECV: publish + flush parked pulls
+                st.count = 0
+                st.version += 1
+                q.clear_counter(msg.key)
+                parked, st.parked = st.parked, []
+                out = st.merged
+                for fulfill in parked:
+                    fulfill(np.array(out, copy=True))

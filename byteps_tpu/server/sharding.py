@@ -31,8 +31,13 @@ def hash_naive(key: int) -> int:
 
 
 def hash_built_in(key: int) -> int:
-    # std::hash<string> is implementation-defined; Python's spread stands in
-    return (hash(str(key)) * 9973) & _MASK
+    # std::hash<string> is implementation-defined but stable within a
+    # build; Python's hash() is salted per process (PYTHONHASHSEED), which
+    # would route the same key to different shards on different hosts —
+    # use a deterministic digest instead
+    import hashlib
+    digest = hashlib.blake2b(str(key).encode(), digest_size=8).digest()
+    return (int.from_bytes(digest, "little") * 9973) & _MASK
 
 
 def hash_djb2(key: int) -> int:

@@ -42,8 +42,9 @@ def main():
     tx = optax.adamw(1e-4)
 
     def loss_fn(p, b):
-        logits = model.apply(p, b["input_ids"], b["attention_mask"])
-        return mlm_loss(logits, b["labels"])
+        logits = model.apply(p, b["input_ids"], b["attention_mask"],
+                             masked_positions=b["masked_positions"])
+        return mlm_loss(logits, b["masked_labels"])
 
     compress = None
     if args.compress_dcn:

@@ -1,0 +1,46 @@
+"""BERT flagship model: the gathered MLM head must be mathematically
+identical to the full-sequence head at the masked positions (it exists
+purely to shrink the vocab projection/softmax from [B,T,V] to [B,P,V])."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byteps_tpu.models.bert import (BertForMLM, bert_tiny, mlm_loss,
+                                    synthetic_batch)
+
+
+def test_gathered_head_matches_full_head():
+    cfg = bert_tiny()
+    m = BertForMLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    b = synthetic_batch(rng, cfg, batch=4, seq_len=32)
+    p = m.init(rng, b["input_ids"], b["attention_mask"])
+    full = m.apply(p, b["input_ids"], b["attention_mask"])
+    gath = m.apply(p, b["input_ids"], b["attention_mask"],
+                   masked_positions=b["masked_positions"])
+    sel = jnp.take_along_axis(full, b["masked_positions"][..., None], axis=1)
+    np.testing.assert_allclose(np.asarray(gath), np.asarray(sel),
+                               rtol=2e-4, atol=2e-4)
+    l_full = mlm_loss(full, b["labels"])
+    l_gath = mlm_loss(gath, b["masked_labels"])
+    np.testing.assert_allclose(float(l_full), float(l_gath), rtol=1e-4)
+
+
+def test_synthetic_batch_masks_exactly_p_positions():
+    cfg = bert_tiny()
+    b = synthetic_batch(jax.random.PRNGKey(1), cfg, batch=8, seq_len=64,
+                        mask_frac=0.15)
+    n_pred = int(64 * 0.15)
+    assert b["masked_positions"].shape == (8, n_pred)
+    # full-length labels carry the same P masked slots per row
+    assert int((np.asarray(b["labels"]) >= 0).sum(axis=1).max()) == n_pred
+    assert int((np.asarray(b["labels"]) >= 0).sum(axis=1).min()) == n_pred
+    # masked inputs are zeroed
+    ids = np.asarray(b["input_ids"])
+    pos = np.asarray(b["masked_positions"])
+    for r in range(8):
+        assert (ids[r, pos[r]] == 0).all()
+    # positions are unique per row (permutation-based selection)
+    for r in range(8):
+        assert len(set(pos[r].tolist())) == n_pred

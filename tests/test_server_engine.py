@@ -85,6 +85,58 @@ def test_sticky_least_loaded_assignment():
         eng.shutdown()
 
 
+def test_pull_parks_during_partially_merged_round():
+    """A pull between COPY_FIRST and round completion must park — never
+    return one worker's raw contribution as if it were a merge."""
+    eng = ServerEngine(num_threads=1)
+    try:
+        for r in range(2):
+            eng.push("k", np.ones(2), worker_id=r, num_workers=2)
+        eng.pull("k", timeout=5)
+        eng.push("k", np.full(2, 7.0), worker_id=0, num_workers=2)
+        time.sleep(0.2)  # engine pops COPY_FIRST; round incomplete
+        res = {}
+        t = threading.Thread(
+            target=lambda: res.update(v=eng.pull("k", timeout=5)))
+        t.start()
+        time.sleep(0.2)
+        assert "v" not in res
+        eng.push("k", np.full(2, 1.0), worker_id=1, num_workers=2)
+        t.join(5)
+        np.testing.assert_allclose(res["v"], 8.0)
+    finally:
+        eng.shutdown()
+
+
+def test_bad_push_rejected_caller_side_engine_survives():
+    eng = ServerEngine(num_threads=1)
+    try:
+        for r in range(2):
+            eng.push("k", np.ones(2), worker_id=r, num_workers=2)
+        eng.pull("k", timeout=5)
+        with pytest.raises(ValueError):
+            eng.push("k", np.ones(5), worker_id=0, num_workers=2)
+        for r in range(2):
+            eng.push("k", np.ones(2), worker_id=r, num_workers=2)
+        np.testing.assert_allclose(eng.pull("k", timeout=5), 2.0)
+    finally:
+        eng.shutdown()
+
+
+def test_built_in_hash_deterministic_across_processes():
+    """hash_built_in must not depend on Python's salted hash()."""
+    import subprocess, sys
+    code = ("from byteps_tpu.server.sharding import hash_built_in;"
+            "print(hash_built_in(123456))")
+    outs = {subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           env={"PYTHONHASHSEED": seed, "PATH": "/usr/bin:/bin",
+                                "PYTHONPATH": "/root/repo"},
+                           check=True).stdout.strip()
+            for seed in ("1", "2")}
+    assert len(outs) == 1
+
+
 # --- priority queue ---------------------------------------------------------
 
 
