@@ -77,10 +77,30 @@ def suspend() -> None:
 
 
 def resume(config: Optional[Config] = None,
-           devices: Optional[list] = None) -> None:
+           devices: Optional[list] = None,
+           num_workers: Optional[int] = None,
+           num_servers: Optional[int] = None,
+           global_rank: Optional[int] = None) -> None:
     """Elastic-training resume: re-init with possibly different topology
     (reference byteps_resume, operations.cc:107-119); tensors are re-declared
-    in their original order."""
+    in their original order.
+
+    ``num_workers`` / ``num_servers`` / ``global_rank`` mirror the
+    reference's ``BytePSBasics.resume`` signature
+    (common/__init__.py:75-81): they update the DMLC env the same way
+    (num_servers is accepted and ignored — no server processes on TPU)
+    before re-initializing."""
+    import os
+    if num_workers is not None:
+        os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+    if num_servers is not None:
+        os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+    if global_rank is not None:
+        os.environ["BYTEPS_GLOBAL_RANK"] = str(global_rank)
+        os.environ["DMLC_WORKER_ID"] = str(global_rank)
+    if config is None and (num_workers is not None
+                           or global_rank is not None):
+        config = Config.from_env()
     init(config=config, devices=devices)
 
 

@@ -87,14 +87,17 @@ class PriorityQueue:
 
 class _KeyState:
     __slots__ = ("merged", "count", "version", "parked", "lock",
-                 "submitted")
+                 "submitted", "shape", "dtype", "poisoned")
 
     def __init__(self):
         self.merged: Optional[np.ndarray] = None
         self.count = 0          # pushes processed this round
         self.version = 0        # completed merge rounds
         self.submitted = 0      # pushes enqueued (caller side)
-        self.parked: List[Callable[[np.ndarray], None]] = []
+        self.shape = None       # established by the first push (caller side)
+        self.dtype = None
+        self.poisoned = False   # terminal: an engine-side merge failed
+        self.parked: List[Callable[[Optional[np.ndarray]], None]] = []
         self.lock = threading.Lock()
 
 
@@ -152,15 +155,22 @@ class ServerEngine:
     def push(self, key: str, value, worker_id: int,
              num_workers: int) -> None:
         """One worker's contribution for this round (non-blocking).
-        Shape mismatches raise here, in the caller's thread — a bad push
-        must never reach COPY_FIRST/SUM_RECV on the engine thread."""
+        The key's shape/dtype are established by its first push and every
+        later push is validated here, in the caller's thread — a
+        mismatched push must never reach COPY_FIRST/SUM_RECV on the
+        engine thread (where it would poison the round)."""
         arr = np.asarray(value)
         st = self._state(key)
         with st.lock:
-            if st.merged is not None and arr.shape != st.merged.shape:
+            if st.poisoned:
+                raise RuntimeError(f"key {key!r} is poisoned by an "
+                                   "earlier merge failure")
+            if st.shape is None:
+                st.shape, st.dtype = arr.shape, arr.dtype
+            elif arr.shape != st.shape or arr.dtype != st.dtype:
                 raise ValueError(
-                    f"push({key!r}): shape {arr.shape} != established "
-                    f"{st.merged.shape}")
+                    f"push({key!r}): {arr.shape}/{arr.dtype} != "
+                    f"established {st.shape}/{st.dtype}")
             st.submitted += 1
         q = self.queues[self.thread_id(key, arr.nbytes)]
         q.push(_Msg(sort_key=(0, 0), seq=0, key=key, value=arr,
@@ -173,11 +183,14 @@ class ServerEngine:
         ev = threading.Event()
         box: Dict[str, np.ndarray] = {}
 
-        def fulfill(arr: np.ndarray) -> None:
+        def fulfill(arr: Optional[np.ndarray]) -> None:
             box["v"] = arr
             ev.set()
 
         with st.lock:
+            if st.poisoned:
+                raise RuntimeError(f"key {key!r} is poisoned by an "
+                                   "earlier merge failure")
             # answer immediately only when no round is in flight: nothing
             # queued (submitted == 0) AND nothing partially merged
             # (count == 0) — a popped-but-unfinished round would otherwise
@@ -189,6 +202,9 @@ class ServerEngine:
             st.parked.append(fulfill)
         if not ev.wait(timeout):
             raise TimeoutError(f"pull({key!r}) timed out")
+        if box["v"] is None:
+            raise RuntimeError(f"key {key!r} was poisoned while this "
+                               "pull was parked")
         return box["v"]
 
     def version(self, key: str) -> int:
@@ -209,16 +225,25 @@ class ServerEngine:
                 return
             try:
                 self._process(msg, q)
-            except Exception:  # noqa: BLE001 — a bad push (mismatched
-                # shape/dtype) must not kill the engine thread and strand
-                # every key sticky-assigned to it
+            except Exception:  # noqa: BLE001 — push() pre-validates
+                # shape/dtype, so this is exceptional (OOM etc.); the key
+                # is poisoned terminally rather than half-reset, because a
+                # partial round cannot be repaired without cross-round
+                # message accounting — but the engine thread (and every
+                # other key assigned to it) must survive
                 get_logger().error(
-                    "server engine: merge failed for key=%r (round "
-                    "abandoned; parked pulls will time out)", msg.key,
-                    exc_info=True)
+                    "server engine: merge failed for key=%r — key "
+                    "poisoned; pending and future push/pull raise",
+                    msg.key, exc_info=True)
                 st = self._state(msg.key)
                 with st.lock:
+                    st.poisoned = True
                     st.count = 0
+                    st.merged = None
+                    parked, st.parked = st.parked, []
+                q.clear_counter(msg.key)
+                for fulfill in parked:
+                    fulfill(None)
 
     def _process(self, msg: _Msg, q: PriorityQueue) -> None:
         st = self._state(msg.key)

@@ -16,6 +16,7 @@ tuning advice carries over.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from typing import Dict, List, Optional
 
@@ -35,7 +36,6 @@ def hash_built_in(key: int) -> int:
     # build; Python's hash() is salted per process (PYTHONHASHSEED), which
     # would route the same key to different shards on different hosts —
     # use a deterministic digest instead
-    import hashlib
     digest = hashlib.blake2b(str(key).encode(), digest_size=8).digest()
     return (int.from_bytes(digest, "little") * 9973) & _MASK
 

@@ -1,0 +1,8 @@
+"""Utilities: checkpoint/resume, benchmark timing helpers."""
+
+from .checkpoint import (  # noqa: F401
+    CheckpointManager,
+    restore_and_broadcast,
+    save_checkpoint,
+)
+from .timing import Timer, throughput  # noqa: F401

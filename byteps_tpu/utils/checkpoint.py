@@ -1,0 +1,127 @@
+"""Checkpoint / resume.
+
+The reference has no checkpoint code of its own — it delegates saving to
+the frameworks and guarantees *consistency* by broadcasting parameters
+and optimizer state from rank 0 after restore
+(torch/__init__.py:259-411, _keras/callbacks.py:23-49; SURVEY.md §5).
+The TPU rebuild keeps that contract and supplies the storage half with
+orbax (the JAX-native checkpointer):
+
+- :func:`save_checkpoint` / :class:`CheckpointManager` — root-only
+  orbax writes of a (params, opt_state, step) pytree;
+- :func:`restore_and_broadcast` — restore, then broadcast from root so
+  all replicas resume bit-identical even if their local files diverged
+  (the reference's broadcast-after-restore identity).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core import api as _api
+
+
+def _abstract_tree(template: Any):
+    """ShapeDtypeStruct pytree for orbax restore, accepting arrays and
+    plain scalars alike."""
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        arr = np.asarray(x)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+    return jax.tree.map(one, template)
+
+
+def _broadcast_from_root(state: Any, root_rank: int) -> Any:
+    """Per-leaf broadcast from ``root_rank`` (zero-non-root + sum is how
+    the collective implements it, the reference's broadcast identity)."""
+    from ..comm.collectives import broadcast as _bcast
+    from ..comm.mesh import get_comm
+    comm = get_comm()
+
+    def one(leaf):
+        arr = np.asarray(leaf)
+        stacked = np.broadcast_to(arr[None], (comm.num_ranks,) + arr.shape)
+        out = _bcast(comm, stacked, root=root_rank)
+        return np.asarray(out).astype(arr.dtype).reshape(arr.shape)
+
+    return jax.tree.map(one, state)
+
+
+def _is_root(root_rank: int) -> bool:
+    # one numbering scheme only: the engine's global rank (an AND across
+    # different numberings would let two hosts both believe they're root)
+    return _api.rank() == root_rank
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True,
+                    root_rank: int = 0) -> bool:
+    """Write ``state`` (any pytree) to ``path`` from the root rank only
+    (others return False immediately — the reference likewise saves on
+    rank 0 and broadcasts on load)."""
+    if not _is_root(root_rank):
+        return False
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), state, force=force)
+    ckptr.wait_until_finished()
+    return True
+
+
+def restore_and_broadcast(path: str, template: Any, *,
+                          root_rank: int = 0) -> Any:
+    """Restore a pytree and broadcast it from ``root_rank`` so every
+    replica resumes identical."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(os.path.abspath(path), _abstract_tree(template))
+    return _broadcast_from_root(state, root_rank)
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints with retention (orbax CheckpointManager
+    behind the root-only-save / broadcast-on-restore contract).
+
+    >>> mgr = CheckpointManager(dir, max_to_keep=3)
+    >>> mgr.save(step, {"params": params, "opt": opt_state})
+    >>> step, state = mgr.restore_latest(template)
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 root_rank: int = 0):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(directory)
+        self.root_rank = root_rank
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, step: int, state: Any) -> bool:
+        if not _is_root(self.root_rank):
+            return False
+        import orbax.checkpoint as ocp
+        ok = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+        return bool(ok)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, template: Any) -> Tuple[Optional[int], Any]:
+        """(step, state-broadcast-from-root); (None, template) when no
+        checkpoint exists yet."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, template
+        import orbax.checkpoint as ocp
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_abstract_tree(template)))
+        return step, _broadcast_from_root(state, self.root_rank)
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,48 @@
+"""Benchmark timing helpers used by bench.py and the examples."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+
+class Timer:
+    """Wall-clock span with device completion: ``block_on`` is
+    block_until_ready'd before the clock stops, so async dispatch can't
+    make steps look free."""
+
+    def __init__(self):
+        self.elapsed: Optional[float] = None
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        return False
+
+    def stop(self, block_on=None) -> float:
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        self.elapsed = time.perf_counter() - self._t0
+        return self.elapsed
+
+
+def throughput(fn: Callable, steps: int, items_per_step: int,
+               warmup: int = 1) -> float:
+    """items/s of ``fn()`` over ``steps`` calls (after ``warmup`` calls);
+    the last result is blocked on before the clock stops."""
+    out = None
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out)
+    t = Timer()
+    with t:
+        for _ in range(steps):
+            out = fn()
+        t.stop(block_on=out)
+    return steps * items_per_step / t.elapsed

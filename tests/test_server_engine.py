@@ -123,15 +123,62 @@ def test_bad_push_rejected_caller_side_engine_survives():
         eng.shutdown()
 
 
+def test_dtype_mismatch_rejected_caller_side():
+    eng = ServerEngine(num_threads=1)
+    try:
+        eng.push("d", np.ones(2, np.float32), worker_id=0, num_workers=2)
+        with pytest.raises(ValueError):
+            eng.push("d", np.ones(2, np.float64), worker_id=1,
+                     num_workers=2)
+    finally:
+        eng.shutdown()
+
+
+def test_engine_merge_failure_poisons_key_not_thread(monkeypatch):
+    """If a merge genuinely fails on the engine thread, the key is
+    poisoned (parked + future ops raise) but the thread and other keys
+    survive."""
+    import byteps_tpu.server.engine as eng_mod
+
+    calls = {"n": 0}
+    real = eng_mod.inplace_add
+
+    def flaky(dst, src, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected merge failure")
+        return real(dst, src, *a, **kw)
+
+    monkeypatch.setattr(eng_mod, "inplace_add", flaky)
+    eng = ServerEngine(num_threads=1)
+    try:
+        eng.push("bad", np.ones(2), worker_id=0, num_workers=2)
+        eng.push("bad", np.ones(2), worker_id=1, num_workers=2)  # fails
+        with pytest.raises(RuntimeError):
+            eng.pull("bad", timeout=5)
+        with pytest.raises(RuntimeError):
+            eng.push("bad", np.ones(2), worker_id=0, num_workers=2)
+        # a different key on the same (sole) thread still works
+        for r in range(2):
+            eng.push("good", np.ones(2), worker_id=r, num_workers=2)
+        np.testing.assert_allclose(eng.pull("good", timeout=5), 2.0)
+    finally:
+        eng.shutdown()
+
+
 def test_built_in_hash_deterministic_across_processes():
     """hash_built_in must not depend on Python's salted hash()."""
-    import subprocess, sys
+    import os
+    import subprocess
+    import sys
+    import byteps_tpu
+    repo_root = os.path.dirname(os.path.dirname(byteps_tpu.__file__))
     code = ("from byteps_tpu.server.sharding import hash_built_in;"
             "print(hash_built_in(123456))")
     outs = {subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
-                           env={"PYTHONHASHSEED": seed, "PATH": "/usr/bin:/bin",
-                                "PYTHONPATH": "/root/repo"},
+                           env={**os.environ, "PYTHONHASHSEED": seed,
+                                "PYTHONPATH": repo_root},
                            check=True).stdout.strip()
             for seed in ("1", "2")}
     assert len(outs) == 1

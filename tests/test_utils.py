@@ -1,0 +1,79 @@
+"""utils: checkpoint save/restore-and-broadcast (SURVEY.md §5 — the
+reference's restore-consistency contract), timing helpers."""
+
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.utils import (CheckpointManager, Timer,
+                              restore_and_broadcast, save_checkpoint,
+                              throughput)
+
+
+@pytest.fixture
+def session():
+    bps.init()
+    yield
+    bps.shutdown()
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"params": {"w": rng.randn(4, 3).astype(np.float32),
+                       "b": rng.randn(3).astype(np.float32)},
+            "step": np.int32(7)}
+
+
+def test_save_restore_broadcast_roundtrip(session, tmp_path):
+    state = _state()
+    assert save_checkpoint(str(tmp_path / "ck"), state)
+    tmpl = {"params": {"w": np.zeros((4, 3), np.float32),
+                       "b": np.zeros(3, np.float32)},
+            "step": np.int32(0)}
+    out = restore_and_broadcast(str(tmp_path / "ck"), tmpl)
+    np.testing.assert_allclose(out["params"]["w"], state["params"]["w"])
+    np.testing.assert_allclose(out["params"]["b"], state["params"]["b"])
+    assert int(out["step"]) == 7
+    assert out["params"]["w"].dtype == np.float32
+
+
+def test_checkpoint_manager_retention_and_latest(session, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2)
+    try:
+        for step in (1, 2, 3):
+            st = _state(seed=step)
+            assert mgr.save(step, st)
+        assert mgr.latest_step() == 3
+        step, out = mgr.restore_latest(_state(seed=0))
+        assert step == 3
+        np.testing.assert_allclose(out["params"]["w"],
+                                   _state(seed=3)["params"]["w"])
+        # retention: only 2 kept
+        import os
+        kept = [d for d in os.listdir(tmp_path / "ckpts") if d.isdigit()]
+        assert sorted(int(d) for d in kept) == [2, 3]
+    finally:
+        mgr.close()
+
+
+def test_restore_latest_empty_returns_template(session, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    try:
+        tmpl = _state()
+        step, out = mgr.restore_latest(tmpl)
+        assert step is None and out is tmpl
+    finally:
+        mgr.close()
+
+
+def test_throughput_counts_items():
+    calls = []
+    rate = throughput(lambda: calls.append(1), steps=5, items_per_step=10)
+    assert len(calls) == 6  # 1 warmup + 5 timed
+    assert rate > 0
+
+
+def test_timer_context():
+    with Timer() as t:
+        pass
+    assert t.elapsed >= 0
