@@ -180,6 +180,20 @@ def broadcast(comm: CommContext, stacked, root: int = 0) -> jax.Array:
     return _broadcast_fn(comm, root)(_as_stacked(comm, stacked))
 
 
+def broadcast_host(comm: CommContext, arr, root: int = 0):
+    """Broadcast one host-side array from ``root``: the caller's value is
+    replicated to the rank-stacked layout as a zero-copy numpy *view*
+    (device_put inside the collective reads one [1, n] slice per device)
+    and the root's slice comes back replicated.  This is the shared
+    implementation behind every adapter's broadcast_parameters and the
+    checkpoint restore broadcast."""
+    import numpy as np
+    arr = np.asarray(arr)
+    stacked = np.broadcast_to(arr[None], (comm.num_ranks,) + arr.shape)
+    out = broadcast(comm, stacked, root=root)
+    return np.asarray(out).astype(arr.dtype).reshape(arr.shape)
+
+
 def push_pull_array(comm: CommContext, stacked, op: str = "average",
                     hierarchical: Optional[bool] = None,
                     keep_acc: bool = False) -> jax.Array:

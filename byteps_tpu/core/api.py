@@ -91,6 +91,10 @@ def resume(config: Optional[Config] = None,
     (num_servers is accepted and ignored — no server processes on TPU)
     before re-initializing."""
     import os
+    if initialized():
+        raise RuntimeError(
+            "resume() while the engine is running: call suspend() first "
+            "(reference byteps_resume likewise requires a suspended core)")
     if num_workers is not None:
         os.environ["DMLC_NUM_WORKER"] = str(num_workers)
     if num_servers is not None:

@@ -1,0 +1,131 @@
+"""Causal transformer LM with pluggable attention — the long-context
+flagship.
+
+The reference has no sequence dimension anywhere (SURVEY.md §5
+"Long-context: absent"); this model exists to exercise the framework's
+sequence-parallel attention (parallel/sequence.py) end to end: the
+attention callable is injected, so the same parameters run with exact
+full attention on one device or ring/Ulysses attention over an sp mesh
+axis — outputs match to float tolerance (tests/test_long_context.py).
+
+TPU-first: bf16 compute / f32 params, MXU-aligned dims, static shapes,
+optional per-layer remat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AttnFn = Callable  # (q, k, v, *, causal, sm_scale) -> out
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32768
+    hidden_size: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    intermediate_size: int = 2048
+    max_position: int = 32768        # long-context by default
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+
+def gpt_small() -> GPTConfig:
+    return GPTConfig()
+
+
+def gpt_tiny() -> GPTConfig:
+    """CPU-mesh tests / multichip dry-runs."""
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128, max_position=512)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPTConfig
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        qkv = nn.DenseGeneral((3, cfg.num_heads, head_dim), dtype=cfg.dtype,
+                              name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = self.attn_fn
+        if attn is None:
+            # lazy: parallel/__init__ imports models.gpt (long_context),
+            # so a top-level import back into parallel would be circular
+            from ..parallel.sequence import full_attention as attn
+        ctx = attn(q, k, v, causal=True,
+                   sm_scale=1.0 / math.sqrt(head_dim))
+        return nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1),
+                               dtype=cfg.dtype, name="out")(ctx)
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        x = x + CausalSelfAttention(cfg, self.attn_fn, name="attn")(h)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(h)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(h)
+        return x + h
+
+
+class GPT(nn.Module):
+    """Decoder-only LM.  ``positions`` must be passed when the sequence
+    axis is sharded (each shard holds positions [off, off + T/sp))."""
+
+    cfg: GPTConfig
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        b, t = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(t)[None]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="wte")(input_ids)
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
+                         name="wpe")(positions)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        for i in range(cfg.num_layers):
+            x = block(cfg, self.attn_fn, name=f"h{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def token_nll(logits, labels, ignore: int = -1):
+    """(sum of per-token NLL over valid positions, valid-token count).
+    Shared by local (:func:`lm_loss`) and mesh-global (psum'd,
+    parallel/long_context.py) normalizations."""
+    valid = labels != ignore
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    w = valid.astype(jnp.float32)
+    return -(ll * w).sum(), w.sum()
+
+
+def lm_loss(logits, labels, ignore: int = -1):
+    """Next-token cross-entropy; ``labels == ignore`` positions skipped.
+    Callers shift: labels[t] is the target for logits[t]."""
+    s, c = token_nll(logits, labels, ignore)
+    return s / jnp.maximum(c, 1.0)

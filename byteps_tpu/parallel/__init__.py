@@ -21,3 +21,8 @@ from .sequence import (  # noqa: F401
     sp_mesh_from_comm,
     ulysses_attention,
 )
+from .long_context import (  # noqa: F401
+    make_dp_sp_train_step,
+    shard_lm_batch,
+    synthetic_lm_batch,
+)

@@ -1,0 +1,98 @@
+"""Composite (dp, sp) training: data parallelism x sequence parallelism.
+
+The long-context training mode the reference cannot express (SURVEY.md
+§5): batch sharded over ``dp``, sequence sharded over ``sp``, attention
+running as a ring (K/V rotating over ICI neighbors) or Ulysses
+(all-to-all head resharding) inside one jitted train step.  Gradient
+synchronization is the framework's push_pull over *both* axes — every
+device holds a (batch-shard, sequence-shard) sliver of the loss, so the
+true gradient is the sum over the whole mesh.
+
+Loss normalization is global: token counts are psum'd across the mesh
+inside the (differentiable) loss, so uneven masking across shards cannot
+skew the objective.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gpt import GPT, GPTConfig, token_nll
+from ..ops import push_pull_tree
+from .sequence import (DP_AXIS, SP_AXIS, ring_attention,
+                       ulysses_attention)
+
+
+def shard_lm_batch(mesh: Mesh, batch):
+    """Place {input_ids, labels} [B, T] with batch over dp, seq over sp."""
+    sh = NamedSharding(mesh, P(DP_AXIS, SP_AXIS))
+    return jax.device_put(batch, sh)
+
+
+def replicate(mesh: Mesh, tree):
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
+                          tx: optax.GradientTransformation,
+                          attention: str = "ring",
+                          donate: bool = True) -> Callable:
+    """Build jitted (params, opt_state, batch) -> (params, opt_state, loss)
+    over a (dp, sp) mesh.
+
+    ``batch`` holds ``input_ids`` and ``labels`` (both [B, T], labels
+    already shifted, -1 = ignore), sharded via :func:`shard_lm_batch`.
+    ``attention`` is "ring" or "ulysses".
+    """
+    if attention == "ring":
+        attn = functools.partial(ring_attention, axis_name=SP_AXIS)
+    elif attention == "ulysses":
+        attn = functools.partial(ulysses_attention, axis_name=SP_AXIS)
+    else:
+        raise ValueError(f"unknown attention kind: {attention!r}")
+    model = GPT(cfg, attn_fn=attn)
+    axes = (DP_AXIS, SP_AXIS)
+
+    def step(params, opt_state, batch):
+        ids, labels = batch["input_ids"], batch["labels"]
+        t_local = ids.shape[1]
+        pos = (lax.axis_index(SP_AXIS) * t_local
+               + jnp.arange(t_local))[None]
+
+        def loss_fn(p):
+            logits = model.apply(p, ids, positions=pos)
+            s, c = token_nll(logits, labels)
+            # global normalization: psum is differentiable, so gradients
+            # automatically carry the global 1/count scaling
+            return lax.psum(s, axes) / jnp.maximum(lax.psum(c, axes), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # loss is already global; grads are this device's partial sums —
+        # the framework's push_pull over both mesh axes completes them
+        grads = push_pull_tree(grads, axes, op="sum")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(DP_AXIS, SP_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+def synthetic_lm_batch(rng, cfg: GPTConfig, batch: int, seq_len: int):
+    """[B, T] token ids + shifted labels (last position ignored)."""
+    ids = jax.random.randint(rng, (batch, seq_len), 0, cfg.vocab_size)
+    labels = jnp.concatenate(
+        [ids[:, 1:], jnp.full((batch, 1), -1, ids.dtype)], axis=1)
+    return {"input_ids": ids, "labels": labels}

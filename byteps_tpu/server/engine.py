@@ -249,6 +249,8 @@ class ServerEngine:
         st = self._state(msg.key)
         with st.lock:
             st.submitted -= 1
+            if st.poisoned:
+                return  # drop: messages queued before the poison landed
             if st.count == 0:
                 # COPY_FIRST: first worker replaces last round's merge
                 st.merged = np.array(msg.value, copy=True)

@@ -152,13 +152,11 @@ def push_pull_async(tensor, name: Optional[str] = None, average: bool = True,
 # ------------------------------------------------------------ broadcast
 
 def _broadcast_host_value(arr: np.ndarray, root_rank: int) -> np.ndarray:
-    from ..comm.collectives import broadcast as _bcast
+    from ..comm.collectives import broadcast_host
     from ..comm.mesh import get_comm
     _api._require()
-    comm = get_comm()
-    arr = np.ascontiguousarray(arr)
-    stacked = np.broadcast_to(arr[None], (comm.num_ranks,) + arr.shape)
-    return np.asarray(_bcast(comm, stacked, root=root_rank))
+    return broadcast_host(get_comm(), np.ascontiguousarray(arr),
+                          root=root_rank)
 
 
 def broadcast_variables(variables, root_rank: int = 0, scope: str = "",

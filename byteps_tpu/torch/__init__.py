@@ -107,16 +107,11 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
                  if torch.is_tensor(v)]
     else:
         items = [(k, v) for k, v in params if torch.is_tensor(v)]
-    from ..comm.collectives import broadcast as _bcast
+    from ..comm.collectives import broadcast_host
     from ..comm.mesh import get_comm
     comm = get_comm()
     for name, t in items:
-        # zero-copy host broadcast view: device_put inside the collective
-        # reads one [1, n] slice per device (a device-side broadcast_to
-        # would materialize num_ranks x param in HBM first)
-        arr = _to_jnp(t)
-        stacked = np.broadcast_to(arr[None], (comm.num_ranks,) + arr.shape)
-        out = _bcast(comm, stacked, root=root_rank)
+        out = broadcast_host(comm, _to_jnp(t), root=root_rank)
         with torch.no_grad():
             t.copy_(_to_torch(out, t))
 

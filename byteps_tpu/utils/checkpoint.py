@@ -39,17 +39,11 @@ def _abstract_tree(template: Any):
 def _broadcast_from_root(state: Any, root_rank: int) -> Any:
     """Per-leaf broadcast from ``root_rank`` (zero-non-root + sum is how
     the collective implements it, the reference's broadcast identity)."""
-    from ..comm.collectives import broadcast as _bcast
+    from ..comm.collectives import broadcast_host
     from ..comm.mesh import get_comm
     comm = get_comm()
-
-    def one(leaf):
-        arr = np.asarray(leaf)
-        stacked = np.broadcast_to(arr[None], (comm.num_ranks,) + arr.shape)
-        out = _bcast(comm, stacked, root=root_rank)
-        return np.asarray(out).astype(arr.dtype).reshape(arr.shape)
-
-    return jax.tree.map(one, state)
+    return jax.tree.map(
+        lambda leaf: broadcast_host(comm, leaf, root=root_rank), state)
 
 
 def _is_root(root_rank: int) -> bool:

@@ -20,6 +20,9 @@ def _run(path, *argv):
 @pytest.mark.parametrize("path,argv", [
     ("example/jax/train_mnist_mlp.py", ("--steps", "2", "--batch", "2")),
     ("example/jax/benchmark_bert.py", ("--steps", "1", "--batch", "1")),
+    ("example/jax/train_long_context.py",
+     ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
+      "--batch", "4")),
     ("example/pytorch/train_mnist_byteps.py", ("--steps", "2")),
     ("example/pytorch/benchmark_byteps.py",
      ("--num-iters", "1", "--num-tensors", "2", "--tensor-mb", "0.1")),
