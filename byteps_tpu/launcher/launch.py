@@ -31,6 +31,16 @@ def launch_worker(cmd: list) -> int:
     # host's chip count (resolved lazily by bps.init()).
     env.setdefault("BYTEPS_LOCAL_RANK", "0")
     env.setdefault("DMLC_ROLE", "worker")
+    if env.get("BYTEPS_ENABLE_GDB", "0") == "1":
+        # debug wrapping, reference launch.py:146-149: run the worker
+        # under gdb so a crash drops a backtrace instead of dying silently
+        cmd = ["gdb", "-ex", "run", "-ex", "bt", "--batch",
+               "--args"] + list(cmd)
+    if env.get("BYTEPS_TRACE_ON", "0") == "1":
+        # reference launch.py:150-175: create the per-rank trace dir so
+        # the engine's timeline writer never races on mkdir
+        trace_dir = env.get("BYTEPS_TRACE_DIR", ".")
+        os.makedirs(trace_dir, exist_ok=True)
     proc = subprocess.Popen(cmd, env=env)
     proc.wait()
     return proc.returncode
