@@ -3,31 +3,62 @@
 The reference's headline benchmark is BERT-large pretraining throughput /
 scaling efficiency (reference README.md:35-41; BASELINE.md).  This harness
 runs the fused data-parallel train step (forward + backward + push_pull +
-adamw) on whatever devices are visible — the one real chip under the
-driver, or a virtual CPU mesh for smoke runs — and prints one JSON line:
+adamw) on whatever devices are visible and prints ONE JSON line (the last
+stdout line) with the headline metric plus secondary metrics:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "examples/s", "vs_baseline": N,
+     "mfu": ..., "push_pull_gbps": {...}, "onebit_pallas": {...}}
 
-vs_baseline is the ratio against PUBLISHED_BASELINE below (per-chip
-examples/s); 1.0 marks the first recorded run of this rebuild.
+Robustness (round-1 lesson, VERDICT.md "What's weak" #1): the TPU backend
+init can hang forever or raise transiently.  The outer process never touches
+JAX directly — it probes the backend in a subprocess with a timeout, runs
+the real bench in a subprocess, and on terminal failure falls back to a
+CPU-smoke run so the driver always records a parseable line.
+
+Baseline bookkeeping: the first green TPU run writes its per-chip
+examples/s into BASELINE_MEASURED.json; later runs report vs_baseline
+against it so the BENCH_r{N}.json series shows drift.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+MEASURED_BASELINE_FILE = os.path.join(REPO, "BASELINE_MEASURED.json")
 
-# First-run value recorded on TPU v5e-1 (this repo, round 1, batch 32
-# seq 128 bf16, forced host materialization); later rounds compare against
-# it so the driver's BENCH_r{N}.json series shows drift.
-PUBLISHED_BASELINE_EXAMPLES_PER_SEC = 520.0
+# Approximate peak bf16 matmul FLOP/s per chip, by device_kind substring.
+# Public numbers: v5e 197T, v5p 459T, v6e (Trillium) 918T, v4 275T, v3 123T.
+_PEAK_FLOPS = (
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5litepod", 197e12), ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
 
-def main() -> int:
+def _peak_flops(device_kind: str):
+    dk = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in dk:
+            return peak
+    return None
+
+
+# --------------------------------------------------------------------------
+# Inner bench (runs in a subprocess whose backend is already decided)
+# --------------------------------------------------------------------------
+
+def _bench_train_step(devices):
+    """Headline: fused DP train-step throughput on the flagship model."""
+    import jax
+    import numpy as np
     import optax
 
     from byteps_tpu.comm.mesh import CommContext, _build_mesh
@@ -35,8 +66,7 @@ def main() -> int:
                                         mlm_loss, synthetic_batch)
     from byteps_tpu.parallel import make_dp_train_step, replicate, shard_batch
 
-    devices = jax.devices()
-    on_tpu = devices[0].platform == "tpu"
+    on_tpu = devices[0].platform != "cpu"
     n = len(devices)
     comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1, n_ici=n)
 
@@ -50,6 +80,8 @@ def main() -> int:
     global_batch = per_dev_batch * n
     batch = synthetic_batch(rng, cfg, batch=global_batch, seq_len=seq_len)
     params = model.init(rng, batch["input_ids"], batch["attention_mask"])
+    n_params = int(sum(int(np.prod(x.shape))
+                       for x in jax.tree.leaves(params)))
 
     def loss_fn(params, b):
         # gathered MLM head: vocab projection only on masked positions
@@ -80,19 +112,285 @@ def main() -> int:
     dt, lv = run(steps)
     dt2, lv = run(steps)
     dt = min(dt, dt2)
+    assert np.isfinite(lv), "non-finite loss"
 
     examples_per_sec = steps * global_batch / dt
     per_chip = examples_per_sec / n
-    assert np.isfinite(lv), "non-finite loss"
+
+    # Training FLOPs/example ~= 6 * N * T (fwd 2NT + bwd 4NT); the standard
+    # transformer approximation used by the scaling literature.  N includes
+    # embeddings (a few % overcount on BERT-large).
+    flops_per_example = 6.0 * n_params * seq_len
+    peak = _peak_flops(devices[0].device_kind) if on_tpu else None
+    mfu = (per_chip * flops_per_example / peak) if peak else None
+    return {
+        "on_tpu": on_tpu,
+        "per_chip": per_chip,
+        "tokens_per_sec_per_chip": per_chip * seq_len,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "n_params": n_params,
+        "seq_len": seq_len,
+        "per_dev_batch": per_dev_batch,
+        "device_kind": devices[0].device_kind,
+        "n_devices": n,
+    }
+
+
+def _bench_push_pull(devices, on_tpu):
+    """Secondary: engine-path push_pull bandwidth (the product's own
+    metric — BASELINE.json 'grad push_pull GB/s').
+
+    GB/s = logical gradient bytes / wall time, one direction.  The engine
+    path includes host staging + partitioning + priority scheduling +
+    per-chunk dispatch; 'fused' is the device-resident jitted reduction for
+    comparison (what make_dp_train_step uses in-graph).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from byteps_tpu.comm.mesh import CommContext, _build_mesh
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.core.engine import PushPullEngine
+
+    n = len(devices)
+    comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1, n_ici=n)
+
+    def engine_gbps(nbytes, reps=5, **cfg_kw):
+        cfg = Config(telemetry_on=False, trace_on=False, **cfg_kw)
+        eng = PushPullEngine(comm, cfg)
+        try:
+            x = np.random.RandomState(0).randn(nbytes // 4).astype(np.float32)
+            eng.push_pull_local(x, "bench.pp")  # warmup + compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                eng.push_pull_local(x, "bench.pp")
+            dt = time.perf_counter() - t0
+        finally:
+            eng.shutdown(wait=False)
+        return reps * nbytes / dt / 1e9
+
+    def fused_gbps(nbytes, reps=10):
+        numel = nbytes // 4
+        x = jax.device_put(jnp.zeros((numel,), jnp.float32))
+
+        @jax.jit
+        def red(v):
+            return v * (1.0 / n)  # allreduce epilogue on a 1-proc mesh
+        red(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            x = red(x)
+        x.block_until_ready()
+        return reps * nbytes / (time.perf_counter() - t0) / 1e9
+
+    mb = 1024 * 1024
+    sizes = [mb, 16 * mb, 256 * mb] if on_tpu else [mb, 8 * mb]
+    out = {}
+    for nbytes in sizes:
+        out[f"engine_{nbytes // mb}MB"] = round(engine_gbps(nbytes), 3)
+    big = sizes[-1]
+    out[f"engine_{big // mb}MB_no_partition"] = round(
+        engine_gbps(big, partition_bytes=2**31 - 512), 3)
+    out[f"engine_{big // mb}MB_no_priority"] = round(
+        engine_gbps(big, enable_priority=False), 3)
+    out[f"engine_{big // mb}MB_credit16MB"] = round(
+        engine_gbps(big, scheduling_credit=16 * mb), 3)
+    out[f"fused_{big // mb}MB"] = round(fused_gbps(big), 3)
+    return out
+
+
+def _bench_pallas(devices):
+    """On real TPU: compile the onebit Pallas kernels non-interpreted,
+    bit-compare against the portable numpy refs, and time them (round-1
+    weakness #5: the kernels had never run on hardware)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from byteps_tpu.ops import pallas_kernels as pk
+    from tests import compression_refs as refs
+
+    try:
+        numel = 32 * 128 * 1024  # 16 MiB of f32
+        rng = np.random.RandomState(3)
+        x = rng.randn(numel).astype(np.float32)
+        L = pk.padded_lanes(numel)
+        x2d = jnp.pad(jnp.asarray(x), (0, 32 * L - numel)).reshape(32, L)
+
+        words, abs_sum = pk.onebit_pack(x2d)  # non-interpret: Mosaic
+        words.block_until_ready()
+        ref_words, ref_scale = refs.onebit_compress(x, scaling=True)
+        bitexact = bool(np.array_equal(np.asarray(words), ref_words))
+
+        out2d = pk.onebit_unpack(words, abs_sum / numel)
+        out2d.block_until_ready()
+        ref_dec = refs.onebit_decompress(ref_words, ref_scale, numel)
+        got_dec = np.asarray(out2d).reshape(-1)[:numel]
+        bitexact = bitexact and bool(
+            np.allclose(got_dec, ref_dec, rtol=1e-6))
+
+        def _time(fn, reps=20):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(reps):
+                r = fn()
+            jnp.asarray(
+                r[0] if isinstance(r, tuple) else r).block_until_ready()
+            return time.perf_counter() - t0
+
+        nbytes = numel * 4
+        dt_pack = _time(lambda: pk.onebit_pack(x2d))
+        dt_unpack = _time(lambda: pk.onebit_unpack(words, abs_sum / numel))
+        return {
+            "bitexact_vs_ref": bitexact,
+            "pack_gbps": round(20 * nbytes / dt_pack / 1e9, 2),
+            "unpack_gbps": round(20 * nbytes / dt_unpack / 1e9, 2),
+        }
+    except Exception as e:  # noqa: BLE001 - Mosaic may reject on axon
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
+def inner_main() -> int:
+    """Full bench; assumes the backend choice was made by the environment."""
+    import jax
+
+    note = os.environ.get("_BPS_BENCH_NOTE", "")
+    if os.environ.get("_BPS_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+
+    train = _bench_train_step(devices)
+    push_pull = _bench_push_pull(devices, on_tpu)
+    pallas = _bench_pallas(devices) if on_tpu else {"skipped": "cpu run"}
+
+    per_chip = train["per_chip"]
+    baseline = None
+    if os.path.exists(MEASURED_BASELINE_FILE):
+        try:
+            with open(MEASURED_BASELINE_FILE) as f:
+                baseline = json.load(f).get("per_chip_examples_per_sec")
+        except Exception:  # noqa: BLE001
+            baseline = None
+    if on_tpu and baseline is None:
+        # First green TPU run: record the measured baseline for later rounds.
+        with open(MEASURED_BASELINE_FILE, "w") as f:
+            json.dump({
+                "per_chip_examples_per_sec": round(per_chip, 2),
+                "device_kind": train["device_kind"],
+                "recorded": time.strftime("%Y-%m-%d"),
+                "config": {"model": "bert_large", "seq_len": train["seq_len"],
+                           "per_dev_batch": train["per_dev_batch"]},
+            }, f, indent=1)
+        baseline = per_chip
+
     result = {
-        "metric": "bert_large_mlm_train_throughput_per_chip"
-                  if on_tpu else "bert_tiny_cpu_smoke_throughput_per_chip",
+        "metric": ("bert_large_mlm_train_throughput_per_chip" if on_tpu
+                   else "bert_tiny_cpu_smoke_throughput_per_chip"),
         "value": round(per_chip, 2),
         "unit": "examples/s",
-        "vs_baseline": round(per_chip / PUBLISHED_BASELINE_EXAMPLES_PER_SEC,
-                             3) if on_tpu else 0.0,
+        "vs_baseline": (round(per_chip / baseline, 3)
+                        if (on_tpu and baseline) else 0.0),
+        "mfu": train["mfu"],
+        "tokens_per_sec_per_chip": round(train["tokens_per_sec_per_chip"], 1),
+        "device": train["device_kind"],
+        "n_devices": train["n_devices"],
+        "push_pull_gbps": push_pull,
+        "onebit_pallas": pallas,
     }
+    if note:
+        result["error"] = note
     print(json.dumps(result))
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Outer orchestration: probe -> run -> fallback.  Never imports jax.
+# --------------------------------------------------------------------------
+
+_PROBE_CODE = (
+    "import jax, json;"
+    "ds = jax.devices();"
+    "print('PROBE ' + json.dumps({'platform': ds[0].platform,"
+    " 'n': len(ds), 'kind': ds[0].device_kind}))"
+)
+
+
+def _probe(timeout: float):
+    try:
+        p = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                           capture_output=True, text=True, timeout=timeout,
+                           cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, "backend init timed out after %ds" % timeout
+    for line in p.stdout.splitlines():
+        if line.startswith("PROBE "):
+            return json.loads(line[len("PROBE "):]), None
+    tail = (p.stderr or p.stdout or "").strip().splitlines()
+    return None, (tail[-1] if tail else f"probe rc={p.returncode}")
+
+
+def _run_inner(extra_env=None, timeout=1500.0):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--inner"], capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        return None, "inner bench timed out"
+    for line in reversed(p.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return line, None
+    tail = (p.stderr or p.stdout or "").strip().splitlines()
+    return None, (" | ".join(tail[-3:]) if tail else f"rc={p.returncode}")
+
+
+def main() -> int:
+    if "--inner" in sys.argv:
+        return inner_main()
+
+    errors = []
+    for attempt, probe_timeout in enumerate((240.0, 60.0)):
+        info, err = _probe(probe_timeout)
+        if info is not None:
+            line, err = _run_inner(timeout=1500.0)
+            if line is not None:
+                print(line)
+                return 0
+            errors.append(f"bench on {info['platform']} failed: {err}")
+            # one retry of the full bench for transient failures
+            line, err = _run_inner(timeout=1500.0)
+            if line is not None:
+                print(line)
+                return 0
+            errors.append(f"bench retry failed: {err}")
+            break
+        errors.append(f"probe {attempt + 1}: {err}")
+        time.sleep(10)
+
+    # Terminal fallback: CPU smoke so the driver still records a number.
+    note = "tpu unavailable: " + "; ".join(errors)[:400]
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    env = {
+        "_BPS_BENCH_FORCE_CPU": "1",
+        "_BPS_BENCH_NOTE": note,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (flags +
+                      " --xla_force_host_platform_device_count=8").strip(),
+    }
+    line, err = _run_inner(extra_env=env, timeout=900.0)
+    if line is not None:
+        print(line)
+        return 0
+    print(json.dumps({
+        "metric": "bert_large_mlm_train_throughput_per_chip",
+        "value": 0.0, "unit": "examples/s", "vs_baseline": 0.0,
+        "error": note + f"; cpu fallback also failed: {err}",
+    }))
     return 0
 
 

@@ -235,7 +235,14 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
             super().__init__(param_list, optimizer,
                              optimizer_params=optimizer_params,
                              kvstore=None)
-            self._bps_size = size()
+            # Workers = processes in this data model: byteps_push_pull's
+            # sum is over *processes* (the single-controller engine divides
+            # the local-device over-count back out, engine.push_pull_local),
+            # so the reference's 1/size() pre-scale (mxnet/__init__.py:
+            # 320-343, size = worker count) maps to 1/process_count here —
+            # NOT 1/num_ranks, which would shrink gradients by local_size x.
+            import jax
+            self._bps_num_workers = jax.process_count()
             self.root_rank = root_rank
             self._intra_compressors = {
                 p.name: copy.deepcopy(self._intra_compressor)
@@ -258,7 +265,8 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
                 if param.grad_req == "null":
                     continue
                 g = param._grad[0]
-                g[:] = g.asnumpy() * (1.0 / self._scale / self._bps_size)
+                g[:] = g.asnumpy() * (1.0 / self._scale
+                                      / self._bps_num_workers)
                 comp = self._intra_compressors[param.name]
                 compressed, ctx = comp.compress(g)
                 byteps_push_pull(compressed, is_average=False,

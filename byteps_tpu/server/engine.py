@@ -25,10 +25,10 @@ and tests that pin the reference's server semantics.
 
 from __future__ import annotations
 
-import heapq
+from collections import deque
 import itertools
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -37,46 +37,74 @@ from ..common.logging import get_logger
 from ..native import inplace_add
 
 
-@dataclass(order=True)
+@dataclass
 class _Msg:
-    sort_key: tuple
-    seq: int = field(compare=False)
-    key: str = field(compare=False)
-    value: Optional[np.ndarray] = field(compare=False, default=None)
-    worker_id: int = field(compare=False, default=0)
-    num_workers: int = field(compare=False, default=1)
-    kind: str = field(compare=False, default="push")  # push | stop
+    key: str
+    value: Optional[np.ndarray] = None
+    worker_id: int = 0
+    num_workers: int = 1
+    kind: str = "push"  # push | stop
+    seq: int = 0        # arrival order, stamped by PriorityQueue.push
 
 
 class PriorityQueue:
     """queue.h parity: FIFO by default; with scheduling enabled, pops the
-    entry whose key has the fewest outstanding pushes (ties by arrival)."""
+    entry whose key has the fewest outstanding pushes (ties by arrival).
+
+    Priority is evaluated at *pop* time from the live per-key counter, as
+    the reference does (queue.h ComparePriority reads push_cnt_[key] when
+    ordering): all queued messages of a key share the key's current total
+    count, and clear_counter re-prioritizes messages that are already
+    queued.  The stop sentinel sorts after every data message so pending
+    merges drain before an engine thread exits.
+    """
 
     def __init__(self, enable_schedule: bool):
         self._sched = enable_schedule
-        self._heap: List[_Msg] = []
         self._cv = threading.Condition()
+        # scheduling mode: per-key FIFO lanes; pop picks the lane with the
+        # smallest live (push_cnt, head-arrival) — O(queued keys) per pop,
+        # matching the reference's O(n) heap re-sort per operation.
+        # FIFO mode (default): one global O(1) deque.
+        self._fifos: Dict[str, "deque[_Msg]"] = {}
+        self._fifo: "deque[_Msg]" = deque()
+        self._stops: "deque[_Msg]" = deque()
         self._push_cnt: Dict[str, int] = {}
         self._seq = itertools.count()
+        self._size = 0
 
     def push(self, msg: _Msg) -> None:
         with self._cv:
-            seq = next(self._seq)
-            msg.seq = seq
-            if self._sched:
-                cnt = self._push_cnt.get(msg.key, 0) + 1
-                self._push_cnt[msg.key] = cnt
-            # re-keying on pop keeps it simple: priority is evaluated at
-            # push time like the reference (heap re-sorted per operation)
-            msg.sort_key = (self._push_cnt.get(msg.key, 0) if self._sched
-                            else 0, seq)
-            heapq.heappush(self._heap, msg)
+            msg.seq = next(self._seq)
+            if msg.kind == "stop":
+                self._stops.append(msg)
+            elif self._sched:
+                self._push_cnt[msg.key] = self._push_cnt.get(msg.key, 0) + 1
+                self._fifos.setdefault(msg.key, deque()).append(msg)
+            else:
+                self._fifo.append(msg)
+            self._size += 1
             self._cv.notify()
 
     def wait_and_pop(self) -> _Msg:
         with self._cv:
-            self._cv.wait_for(lambda: self._heap)
-            return heapq.heappop(self._heap)
+            self._cv.wait_for(lambda: self._size > 0)
+            self._size -= 1
+            if not self._sched:
+                if self._fifo:
+                    return self._fifo.popleft()
+                # only the lowest-priority sentinel remains
+                return self._stops.popleft()
+            if not self._fifos:
+                return self._stops.popleft()
+            key = min(self._fifos,
+                      key=lambda k: (self._push_cnt.get(k, 0),
+                                     self._fifos[k][0].seq))
+            dq = self._fifos[key]
+            msg = dq.popleft()
+            if not dq:  # prune empty lanes: pop cost stays O(queued keys)
+                del self._fifos[key]
+            return msg
 
     def clear_counter(self, key: str) -> None:
         if not self._sched:
@@ -173,7 +201,7 @@ class ServerEngine:
                     f"established {st.shape}/{st.dtype}")
             st.submitted += 1
         q = self.queues[self.thread_id(key, arr.nbytes)]
-        q.push(_Msg(sort_key=(0, 0), seq=0, key=key, value=arr,
+        q.push(_Msg(key=key, value=arr,
                     worker_id=worker_id, num_workers=num_workers))
 
     def pull(self, key: str, timeout: Optional[float] = None) -> np.ndarray:
@@ -212,7 +240,7 @@ class ServerEngine:
 
     def shutdown(self) -> None:
         for q in self.queues:
-            q.push(_Msg(sort_key=(0, 0), seq=0, key="", kind="stop"))
+            q.push(_Msg(key="", kind="stop"))
         for t in self._threads:
             t.join(timeout=5)
 

@@ -24,6 +24,7 @@ eagerly; fully-compiled training lives in byteps_tpu.jax.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Optional
 
@@ -50,11 +51,17 @@ class BytePSCrossDeviceOps(tf.distribute.CrossDeviceOps):
     unused here.
     """
 
+    _instances = itertools.count()
+
     def __init__(self, num_packs: int = 1):
         super().__init__()
         self.num_packs = num_packs
         self._lock = threading.Lock()
         self._counter = 0
+        # disambiguates the positional-name fallback: two instances (or two
+        # unnamed reductions of the same shape/dtype) must not alias onto
+        # one engine tensor and share declared state/priority/compression
+        self._instance_id = next(BytePSCrossDeviceOps._instances)
 
     # -- helpers -----------------------------------------------------------
 
@@ -65,12 +72,13 @@ class BytePSCrossDeviceOps(tf.distribute.CrossDeviceOps):
             self._counter += 1
             return -self._counter
 
-    @staticmethod
-    def _stable_name(per_replica_value, destinations, pos: int) -> str:
+    def _stable_name(self, per_replica_value, destinations, pos: int) -> str:
         """Engine tensor name, stable across eager steps: derived from the
         destination variable when there is one (TF variable names are
-        unique), else from position+shape.  A fresh anonymous name per call
-        would grow the engine registry without bound in eager loops."""
+        unique), else from instance+position+shape.  A fresh anonymous name
+        per call would grow the engine registry without bound in eager
+        loops; the instance id keeps unnamed reductions of the same
+        shape/dtype from aliasing across strategy objects."""
         for obj in (destinations,
                     getattr(destinations, "primary", None)):
             name = getattr(obj, "name", None)
@@ -79,7 +87,8 @@ class BytePSCrossDeviceOps(tf.distribute.CrossDeviceOps):
         vals = BytePSCrossDeviceOps._local_values(per_replica_value)
         t = tf.convert_to_tensor(vals[0])
         shape = "x".join(str(d) for d in t.shape.as_list())
-        return f"tf.distribute.reduce.{pos}.{shape}.{t.dtype.name}"
+        return (f"tf.distribute.reduce.i{self._instance_id}"
+                f".{pos}.{shape}.{t.dtype.name}")
 
     def _reduce_values(self, reduce_op, per_replica_value, name: str,
                        priority: Optional[int] = None):

@@ -7,8 +7,9 @@ and optimizer state from rank 0 after restore
 The TPU rebuild keeps that contract and supplies the storage half with
 orbax (the JAX-native checkpointer):
 
-- :func:`save_checkpoint` / :class:`CheckpointManager` — root-only
-  orbax writes of a (params, opt_state, step) pytree;
+- :func:`save_checkpoint` / :class:`CheckpointManager` — orbax writes of
+  a (params, opt_state, step) pytree (root-only when single-process;
+  collective-entry with primary-host writes under multi-host);
 - :func:`restore_and_broadcast` — restore, then broadcast from root so
   all replicas resume bit-identical even if their local files diverged
   (the reference's broadcast-after-restore identity).
@@ -52,18 +53,31 @@ def _is_root(root_rank: int) -> bool:
     return _api.rank() == root_rank
 
 
+def _save_collectively() -> bool:
+    """Multi-host orbax saves are collective: Checkpointer.save begins with
+    a sync_global_processes barrier, so every process must enter it (orbax
+    itself restricts the actual writes to the primary host).  Gating by
+    rank is only safe — and only meaningful — when there is one process."""
+    return jax.process_count() > 1
+
+
 def save_checkpoint(path: str, state: Any, *, force: bool = True,
                     root_rank: int = 0) -> bool:
-    """Write ``state`` (any pytree) to ``path`` from the root rank only
-    (others return False immediately — the reference likewise saves on
-    rank 0 and broadcasts on load)."""
-    if not _is_root(root_rank):
+    """Write ``state`` (any pytree) to ``path``.
+
+    Single process: root rank writes, others return False immediately (the
+    reference likewise saves on rank 0 and broadcasts on load).  Multi-host:
+    every process calls into orbax (its save is a collective with an
+    internal barrier); orbax writes from the primary host only.  Returns
+    True on the process that owns the write.
+    """
+    if not _save_collectively() and not _is_root(root_rank):
         return False
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.abspath(path), state, force=force)
     ckptr.wait_until_finished()
-    return True
+    return jax.process_index() == 0
 
 
 def restore_and_broadcast(path: str, template: Any, *,
@@ -96,12 +110,15 @@ class CheckpointManager:
                                                  create=True))
 
     def save(self, step: int, state: Any) -> bool:
-        if not _is_root(self.root_rank):
+        # Collective under multi-host (see _save_collectively): a root-only
+        # short-circuit would park the primary host at orbax's internal
+        # sync_global_processes barrier forever.
+        if not _save_collectively() and not _is_root(self.root_rank):
             return False
         import orbax.checkpoint as ocp
         ok = self._mgr.save(step, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
-        return bool(ok)
+        return bool(ok) and jax.process_index() == 0
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

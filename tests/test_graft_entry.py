@@ -25,6 +25,9 @@ def test_bench_smoke_cpu():
     import os
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # bench.py's outer process probes/benches in subprocesses that only
+    # inherit env — an in-process config.update would never reach them
+    env["JAX_PLATFORMS"] = "cpu"
     code = (
         "import jax; jax.config.update('jax_platforms','cpu');"
         "import runpy, sys; sys.argv=['bench.py'];"
@@ -36,5 +39,7 @@ def test_bench_smoke_cpu():
     lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
     assert lines, out.stdout + out.stderr
     rec = json.loads(lines[-1])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline",
+                        "push_pull_gbps", "onebit_pallas"}
     assert rec["value"] > 0
+    assert any(k.startswith("engine_") for k in rec["push_pull_gbps"])

@@ -14,7 +14,7 @@ from byteps_tpu.server.sharding import (ServerAssigner, hash_djb2,
 
 
 def _msg(key, **kw):
-    return _Msg(sort_key=(0, 0), seq=0, key=key, **kw)
+    return _Msg(key=key, **kw)
 
 
 # --- merge flow -------------------------------------------------------------
