@@ -8,16 +8,31 @@ via a sequential BitWriter.
 
 TPU redesign: the *math* (levels, normalization, stochastic rounding
 probabilities) is preserved exactly; the *layout* is not — variable-length
-Elias-delta coding is inherently sequential, so the payload is a dense
-signed int8 code per element (level index, sign folded in) + the norm
-scalar.  4x wire reduction for f32 at full vectorization; SURVEY.md §7
-"hard parts" calls out exactly this trade.
+Elias-delta coding is inherently sequential, so two static-shape layouts
+replace it:
+
+- **dense** (default): a signed int8 code per element + the norm scalar.
+  4x wire reduction for f32 at full vectorization; SURVEY.md §7 "hard
+  parts" calls out exactly this trade.
+- **sparse** (``sparse_ratio`` > 0): dithered posteriors are mostly zeros
+  — that sparsity is what the reference's Elias-delta exploits — so keep
+  only the ``k = ceil(ratio * numel)`` largest-|code| entries as
+  (index, int8 code) pairs.  Static shapes (XLA requirement) mean ``k`` is
+  a capacity, not a count: unused slots carry code 0 (decode to nothing),
+  and overflow drops the smallest magnitudes — a loss the error-feedback
+  decorator recovers across steps, exactly as it does for topk.  Wire
+  cost: k * (2 or 4 + 1) + 4 bytes vs numel + 4 dense, so ratios below
+  ~20% beat the dense layout and approach the entropy-coded sizes of
+  reference dithering.cc:51-110 on sparse posteriors.
 """
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax.numpy as jnp
+from jax import lax
 
 from .base import Compressor, Payload, State
 from . import prng
@@ -38,17 +53,24 @@ class DitheringCompressor(Compressor):
 
     def __init__(self, numel: int, dtype=jnp.float32, s: int = 16,
                  partition: str = "linear", normalize: str = "max",
-                 seed: int = 0):
+                 seed: int = 0, sparse_ratio: float = 0.0):
         super().__init__(numel, dtype)
         if not 1 <= s <= 127:
             raise ValueError("s must be in [1, 127] for int8 codes")
         if normalize not in ("max", "l2"):
             raise ValueError(f"unknown normalization: {normalize}")
+        if not 0.0 <= sparse_ratio <= 1.0:
+            raise ValueError("sparse_ratio must be in [0, 1]")
         self.s = s
         self.partition = partition
         self.normalize = normalize
         self.seed = int(seed)
         self.level_table = _levels(partition, s)
+        self.sparse_k = (max(1, math.ceil(sparse_ratio * numel))
+                         if sparse_ratio > 0 else 0)
+        # narrowest index dtype that addresses the chunk (wire accounting
+        # matches what a real DCN hop would carry)
+        self.idx_dtype = jnp.uint16 if numel <= 0xFFFF else jnp.uint32
 
     def init_state(self) -> State:
         return {"counter": jnp.uint32(0)}
@@ -73,17 +95,36 @@ class DitheringCompressor(Compressor):
         code = i + (r < p)
         signed = jnp.where(xf < 0, -code, code).astype(jnp.int8)
         new_state = {"counter": state["counter"] + jnp.uint32(self.numel)}
+        if self.sparse_k:
+            # keep the k largest-|code| entries (ties: lowest index first,
+            # lax.top_k is stable); zero-code slots decode to nothing
+            _, idx = lax.top_k(jnp.abs(signed).astype(jnp.int32),
+                               self.sparse_k)
+            return {"idx": idx.astype(self.idx_dtype),
+                    "codes": jnp.take(signed, idx), "norm": norm}, new_state
         return {"codes": signed, "norm": norm}, new_state
+
+    def _decode_values(self, codes, norm):
+        lv = jnp.asarray(self.level_table)
+        mags = jnp.take(lv, jnp.abs(codes)) * norm
+        return jnp.sign(codes).astype(jnp.float32) * mags
 
     def decompress(self, payload: Payload):
         codes = payload["codes"].astype(jnp.int32)
-        lv = jnp.asarray(self.level_table)
-        mags = jnp.take(lv, jnp.abs(codes)) * payload["norm"]
-        return (jnp.sign(codes).astype(jnp.float32) * mags).astype(self.dtype)
+        vals = self._decode_values(codes, payload["norm"])
+        if self.sparse_k:
+            # top_k indices are distinct, so scatter-set is exact
+            dense = jnp.zeros(self.numel, jnp.float32)
+            vals = dense.at[payload["idx"].astype(jnp.int32)].set(vals)
+        return vals.astype(self.dtype)
 
     def payload_nbytes(self) -> int:
+        if self.sparse_k:
+            idx_b = 2 if self.idx_dtype == jnp.uint16 else 4
+            return self.sparse_k * (idx_b + 1) + 4
         return self.numel + 4  # int8 code per element + norm
 
     def cache_key(self) -> tuple:
         return super().cache_key() + (self.s, self.partition,
-                                      self.normalize, self.seed)
+                                      self.normalize, self.seed,
+                                      self.sparse_k)

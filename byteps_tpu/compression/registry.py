@@ -59,7 +59,8 @@ def _make_dithering(numel, dtype, kwargs):
                          kwargs.get("s", kwargs.get("k", 16)))),
         partition=str(kwargs.get("partition", "linear")),
         normalize=str(kwargs.get("normalize", "max")),
-        seed=int(kwargs.get("seed", 0)))
+        seed=int(kwargs.get("seed", 0)),
+        sparse_ratio=float(kwargs.get("sparse_ratio", 0.0)))
 
 
 def _num(v):

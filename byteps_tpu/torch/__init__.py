@@ -80,12 +80,36 @@ def push_pull_async(tensor: torch.Tensor, average: bool = True,
         priority=priority, compression=compression)
 
 
+class BytePSPushPull(torch.autograd.Function):
+    """Autograd-differentiable push_pull (reference torch/ops.py:109-125):
+    forward reduces the tensor; backward reduces the incoming gradient
+    under the same name/op, so push_pull composes with autograd graphs."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name, compression):
+        ctx.average = average
+        ctx.name = name
+        ctx.compression = compression
+        h = push_pull_async(tensor, average=average, name=name,
+                            compression=compression)
+        return _to_torch(h.wait(), tensor)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        h = push_pull_async(grad_output, average=ctx.average,
+                            name=ctx.name, compression=ctx.compression)
+        return _to_torch(h.wait(), grad_output), None, None, None
+
+
 def push_pull(tensor: torch.Tensor, average: bool = True,
               name: Optional[str] = None,
               compression: Optional[Dict[str, str]] = None) -> torch.Tensor:
-    h = push_pull_async(tensor, average=average, name=name,
-                        compression=compression)
-    return _to_torch(h.wait(), tensor)
+    """Reduce ``tensor`` across processes; differentiable when the input
+    requires grad (reference torch/ops.py:126-160 routes through the
+    BytePSPushPull autograd function the same way)."""
+    # a stable name: forward and backward must key the same engine tensor
+    name = name or _anon_name()
+    return BytePSPushPull.apply(tensor, average, name, compression)
 
 
 def poll(handle: Handle) -> bool:

@@ -1,0 +1,116 @@
+"""Golden-vector pins for the compression wire formats (VERDICT r1 item 9).
+
+The expected values below were generated once from the numpy reference
+implementations (tests/compression_refs.py) — which round 1 bit-pinned
+against the reference's semantics (reference compressor/impl/onebit.cc,
+dithering.cc; test pattern tests/test_onebit.py:32-113) — and are now
+frozen as literals.  Any kernel or layout change that silently drifts the
+wire format fails here, independently of the refs (which could drift with
+the implementation if both were edited together).
+
+The input vector hits the edge cases: exact zeros and signed zeros, exact
+level boundaries for s=4 (0.25/0.5/0.75/1.0 of max), values straddling
+boundaries by <1e-3, tiny magnitudes near the stochastic-rounding floor,
+and the fp16 round-trip of all of it.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from byteps_tpu.compression import create as create_compressor
+
+# Edge-case input (32 elements; see module docstring)
+X = np.array([0.0, -0.0, 2.0, -2.0, 0.5, -0.5, 1.0, -1.0,
+              1.5, -1.5, 0.25, -0.25, 1e-7, -1e-7, 0.125, -0.125,
+              1.999, -1.999, 0.749, 0.751, 1.001, -1.001, 0.374, 0.376,
+              0.06251, -0.06249, 1.75, -1.75, 0.875, -0.875, 1.125, -1.125],
+             dtype=np.float32)
+
+# onebit, scaling=True: word j < 32 carries the sign of element j in bit 0
+# (sublane-major layout, lane-padded to 128); padding packs as 1-bits.
+# 0xFFFFFFFF = positive, 0xFFFFFFFE = negative.
+_P, _N = 0xFFFFFFFF, 0xFFFFFFFE
+ONEBIT_WORDS_HEAD = np.array(
+    [_P, _P, _P, _N, _P, _N, _P, _N, _P, _N, _P, _N, _P, _N, _P, _N,
+     _P, _N, _P, _P, _P, _N, _P, _P, _P, _N, _P, _N, _P, _N, _P, _N],
+    dtype=np.uint32)
+ONEBIT_SCALE = 0.8320313096046448        # mean |x| over 32 elements
+ONEBIT_SCALE_FP16 = 0.83203125           # same, after fp16 round-trip
+
+# dithering, s=4, seed=3, first step (counter=0)
+DITHERING_GOLDEN = {
+    ("linear", "max"): (
+        [0, 0, 4, -4, 1, -1, 2, -2, 3, -3, 1, -1, 0, 0, 0, 0,
+         4, -4, 1, 1, 2, -2, 1, 0, 0, 0, 3, -4, 2, -1, 3, -2], 2.0),
+    ("linear", "l2"): (
+        [0, 0, 1, -1, 0, 0, 0, -1, 1, -1, 0, 0, 0, 0, 0, 0,
+         2, -1, 0, 0, 1, 0, 0, 0, 0, 0, 1, -1, 0, 0, 1, -1],
+        6.062492847442627),
+    ("natural", "max"): (
+        [0, 0, 4, -4, 2, -2, 3, -3, 3, -3, 1, -1, 0, 0, 0, 0,
+         4, -4, 2, 2, 3, -3, 2, 1, 0, 0, 4, -4, 3, -2, 3, -3], 2.0),
+    ("natural", "l2"): (
+        [0, 0, 2, -2, 0, 0, 1, -1, 2, -2, 1, -1, 0, 0, 0, 0,
+         3, -2, 1, 1, 2, -1, 1, 0, 0, 0, 2, -2, 1, -1, 2, -1],
+        6.062492847442627),
+}
+
+
+@pytest.mark.parametrize("fp16", [False, True])
+def test_onebit_golden(fp16):
+    x = X.astype(np.float16).astype(np.float32) if fp16 else X
+    comp = create_compressor({"compressor": "onebit", "scaling": "true"},
+                             len(x))
+    payload, _ = comp.compress(jnp.asarray(x), comp.init_state())
+    words = np.asarray(payload["words"])
+    np.testing.assert_array_equal(words[:32], ONEBIT_WORDS_HEAD)
+    assert (words[32:] == _P).all()  # padding is all-ones
+    expect_scale = ONEBIT_SCALE_FP16 if fp16 else ONEBIT_SCALE
+    np.testing.assert_allclose(float(payload["scale"]), expect_scale,
+                               rtol=1e-6)
+
+
+def test_onebit_golden_pallas_interpret():
+    """The Pallas kernel must produce the identical wire words (interpret
+    mode executes the exact kernel program on CPU)."""
+    from byteps_tpu.ops import pallas_kernels as pk
+    L = pk.padded_lanes(len(X))
+    x2d = jnp.pad(jnp.asarray(X), (0, 32 * L - len(X))).reshape(32, L)
+    words, abs_sum = pk.onebit_pack(x2d, interpret=True)
+    words = np.asarray(words)
+    np.testing.assert_array_equal(words[:32], ONEBIT_WORDS_HEAD)
+    assert (words[32:] == _P).all()
+    np.testing.assert_allclose(float(abs_sum) / len(X), ONEBIT_SCALE,
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("partition,normalize", list(DITHERING_GOLDEN))
+def test_dithering_golden(partition, normalize):
+    codes_exp, norm_exp = DITHERING_GOLDEN[(partition, normalize)]
+    comp = create_compressor(
+        {"compressor": "dithering", "partition_num": "4",
+         "partition": partition, "normalize": normalize, "seed": "3"},
+        len(X))
+    payload, _ = comp.compress(jnp.asarray(X), comp.init_state())
+    np.testing.assert_array_equal(np.asarray(payload["codes"]),
+                                  np.asarray(codes_exp, np.int8))
+    np.testing.assert_allclose(float(payload["norm"]), norm_exp, rtol=1e-6)
+
+
+def test_dithering_golden_sparse_layout():
+    """The sparse layout must decode to the identical dense tensor when the
+    capacity covers every nonzero code."""
+    codes_exp, norm_exp = DITHERING_GOLDEN[("linear", "max")]
+    nnz = int(np.count_nonzero(codes_exp))
+    comp = create_compressor(
+        {"compressor": "dithering", "partition_num": "4", "seed": "3",
+         "sparse_ratio": str((nnz + 2) / len(X))}, len(X))
+    dense = create_compressor(
+        {"compressor": "dithering", "partition_num": "4", "seed": "3"},
+        len(X))
+    ps, _ = comp.compress(jnp.asarray(X), comp.init_state())
+    pd, _ = dense.compress(jnp.asarray(X), dense.init_state())
+    np.testing.assert_allclose(np.asarray(comp.decompress(ps)),
+                               np.asarray(dense.decompress(pd)),
+                               rtol=1e-6, atol=0)

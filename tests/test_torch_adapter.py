@@ -26,6 +26,33 @@ def test_push_pull_identity_single_process(session):
     np.testing.assert_allclose(out2.numpy(), t.numpy(), rtol=1e-5, atol=1e-6)
 
 
+def test_push_pull_differentiable(session):
+    """push_pull is an autograd Function (reference torch/ops.py:109-125):
+    backward push_pulls the incoming gradient.  Single process: y = x, so
+    d(sum(y * w))/dx == w."""
+    x = torch.randn(6, 4, requires_grad=True)
+    w = torch.randn(6, 4)
+    y = bps_torch.push_pull(x, average=True, name="diff1")
+    assert y.requires_grad
+    (y * w).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), w.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_push_pull_through_model(session):
+    """Gradients propagate through a push_pull in the middle of a graph."""
+    torch.manual_seed(7)
+    lin = torch.nn.Linear(5, 3)
+    x = torch.randn(8, 5)
+    out = bps_torch.push_pull(lin(x), average=True, name="diff2")
+    out.sum().backward()
+    assert lin.weight.grad is not None
+    expected = x.sum(dim=0)  # d(sum(Wx+b))/dW rows are sum_b x
+    for row in lin.weight.grad:
+        np.testing.assert_allclose(row.numpy(), expected.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_push_pull_async_poll_synchronize(session):
     t = torch.ones(64)
     h = bps_torch.push_pull_async(t, average=False, name="t2")
