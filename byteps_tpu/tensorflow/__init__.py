@@ -34,6 +34,7 @@ __all__ = [
     "declare", "push_pull", "push_pull_async", "broadcast_variables",
     "broadcast_global_variables", "BroadcastGlobalVariablesHook",
     "DistributedOptimizer", "DistributedGradientTape", "Compression",
+    "make_compiled_train_step", "reduce_gradients_eager",
 ]
 
 init = _api.init
@@ -433,3 +434,82 @@ def DistributedGradientTape(gradtape, device_dense: str = "",
             return reduced[0] if single else reduced
 
     return _DistributedGradientTape(gradtape)
+
+
+# ----------------------------------------------- compiled-compute boundary
+
+def reduce_gradients_eager(grads, scope: Optional[str] = None,
+                           op: str = "average",
+                           compression_kwargs: Optional[dict] = None):
+    """Burst-reduce a list of gradient tensors through the engine, eagerly.
+
+    All gradients are enqueued async before any wait, so the engine
+    scheduler sees the whole burst and priority (-index) orders the chunk
+    issue — the same pattern _reduce_grads uses inside a py_function, but
+    without entering a TF graph at all.  For use at the boundary between
+    two compiled programs (see :func:`make_compiled_train_step`).
+
+    ``scope`` namespaces the engine tensor names and must be stable across
+    steps (engine contexts — compression state, keys, priorities — live
+    under these names).  The default is one shared stable scope: correct
+    for a single model per process; training several models concurrently
+    needs a distinct scope per model (a reused name with different
+    geometry raises, it never silently mixes state).
+    """
+    eng = _api._require()
+    if scope is None:
+        scope = "eager"
+    live = [(i, g) for i, g in enumerate(grads) if g is not None]
+    handles = []
+    for i, g in live:
+        vn = g.numpy() if hasattr(g, "numpy") else np.asarray(g)
+        handles.append((i, vn.shape, eng.push_pull_local_async(
+            np.ascontiguousarray(vn), _stable_grad_name(scope, i),
+            op=op, priority=-i, compression=compression_kwargs)))
+    out = list(grads)
+    for i, shape, h in handles:
+        out[i] = tf.constant(np.asarray(h.wait()).reshape(shape),
+                             dtype=grads[i].dtype)
+        eng.handles.release(h.id)
+    return out
+
+
+def make_compiled_train_step(model, loss_fn, optimizer,
+                             compression_kwargs: Optional[dict] = None,
+                             jit_compile: bool = True):
+    """Training step with XLA-compiled compute and engine communication at
+    the program boundary.
+
+    The reference runs communication *inside* the TF graph as an
+    AsyncOpKernel (reference tensorflow/ops.cc:167-231) because its
+    transport is host/NIC-side and the graph is the only scheduler.  Under
+    XLA the inverse composition is native: forward+backward lower to one
+    compiled program, gradients cross the engine *between* programs (the
+    boundary byteps_tpu.torch's hook design already uses), and the
+    optimizer update is a second compiled program.  ``jit_compile=True``
+    therefore composes with byteps communication — the thing the round-1
+    py_function path could not do.  Overhead is measured, not assumed:
+    docs/performance.md "TensorFlow compiled boundary".
+
+    Returns ``step(x, y) -> loss``.
+    """
+    scope = _next_scope()
+
+    @tf.function(jit_compile=jit_compile)
+    def _forward_backward(x, y):
+        with tf.GradientTape() as tape:
+            loss = loss_fn(model(x, training=True), y)
+        return loss, tape.gradient(loss, model.trainable_variables)
+
+    @tf.function(jit_compile=jit_compile)
+    def _apply(*grads):
+        optimizer.apply_gradients(zip(grads, model.trainable_variables))
+
+    def step(x, y):
+        loss, grads = _forward_backward(x, y)
+        reduced = reduce_gradients_eager(
+            grads, scope=scope, compression_kwargs=compression_kwargs)
+        _apply(*reduced)
+        return loss
+
+    return step

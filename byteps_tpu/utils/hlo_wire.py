@@ -1,0 +1,60 @@
+"""Wire-byte accounting from compiled HLO.
+
+The compiled program's collective shapes are the wire contract: what each
+rank sends over the interconnect per invocation.  This module parses the
+HLO text of a lowered+compiled jit function and attributes per-rank bytes
+to the DCN or ICI axis by inspecting replica groups — the tool behind the
+"only compressed bytes cross DCN" assertion (tests/test_wire_bytes.py) and
+the bench's wire report.
+
+Reference analog: the reference proves its wire economics by construction
+(push/pull moves 1/n-th per server, docs/rationale.md); here XLA owns the
+collectives, so the proof reads the compiled artifact instead.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "u32": 4, "s32": 4, "f16": 2,
+                "bf16": 2, "u16": 2, "s16": 2, "u8": 1, "s8": 1, "pred": 1}
+
+_COLLECTIVE_RE = re.compile(
+    r"= (\w+)\[([\d,]*)\][^=]*?"
+    r"(all-gather|all-reduce|reduce-scatter|collective-permute)"
+    r"[^\n]*?replica_groups=\{(\{[^}]*\})")
+
+
+def collectives(hlo: str) -> Iterator[Tuple[str, int, List[int]]]:
+    """Yield (op, output_nbytes, first_replica_group) per collective."""
+    for m in _COLLECTIVE_RE.finditer(hlo):
+        dtype, dims, op, group0 = m.groups()
+        numel = int(np.prod([int(d) for d in dims.split(",")] if dims
+                            else [1]))
+        yield (op, numel * _DTYPE_BYTES.get(dtype, 4),
+               [int(v) for v in group0.strip("{}").split(",")])
+
+
+def axis_of(group: List[int], n_ici: int) -> str:
+    """Classify a replica group: members >= n_ici apart span slices (DCN,
+    row-major (dcn, ici) device layout); otherwise intra-slice (ICI)."""
+    return "dcn" if any(b - a >= n_ici
+                        for a, b in zip(group, group[1:])) else "ici"
+
+
+def dcn_ici_bytes(hlo: str, n_ici: int) -> Tuple[int, int]:
+    """Per-rank wire bytes moved over (dcn, ici) in one invocation."""
+    dcn = ici = 0
+    for op, nbytes, group in collectives(hlo):
+        # an all-gather's output includes the rank's own shard, which does
+        # not cross the network
+        if op == "all-gather":
+            nbytes = nbytes * (len(group) - 1) // len(group)
+        if axis_of(group, n_ici) == "dcn":
+            dcn += nbytes
+        else:
+            ici += nbytes
+    return dcn, ici

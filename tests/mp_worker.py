@@ -75,9 +75,9 @@ def main() -> int:
 
     # --- torch adapter surface over two real processes ----------------------
     try:
-        import torch  # noqa: F401
+        import torch
         import byteps_tpu.torch as bps_torch
-        t = __import__("torch").full((8,), float(pid + 1))
+        t = torch.full((8,), float(pid + 1))
         tout = bps_torch.push_pull(t, average=True, name="mp.torch")
         np.testing.assert_allclose(tout.numpy(), np.full((8,), 1.5),
                                    rtol=1e-6)

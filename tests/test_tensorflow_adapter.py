@@ -128,3 +128,57 @@ def test_lr_schedule_callback_staircase(session):
                      verbose=0)
     lrs = hist.history["lr"]
     np.testing.assert_allclose(lrs, [1.0, 0.1, 0.01], rtol=1e-5)
+
+
+def test_make_compiled_train_step_matches_plain(session):
+    """Compiled-boundary step == plain jit training on one process (the
+    push_pull averages over 1 process = identity), so the parameters must
+    evolve identically."""
+    tf.random.set_seed(4)
+    loss_fn = tf.keras.losses.MeanSquaredError()
+
+    def build():
+        m = tf.keras.Sequential([
+            tf.keras.layers.Dense(16, activation="relu",
+                                  input_shape=(8,)),
+            tf.keras.layers.Dense(1)])
+        return m
+
+    m1 = build()
+    m2 = build()
+    m2.set_weights(m1.get_weights())
+    o1 = tf.keras.optimizers.SGD(0.05)
+    o2 = tf.keras.optimizers.SGD(0.05)
+
+    rng = np.random.RandomState(4)
+    x = tf.constant(rng.randn(32, 8).astype(np.float32))
+    y = tf.constant(rng.randn(32, 1).astype(np.float32))
+
+    # jit_compile exercises the documented XLA composition; CPU supports it
+    step = bps_tf.make_compiled_train_step(
+        m2, lambda logits, yb: loss_fn(yb, logits), o2, jit_compile=True)
+
+    @tf.function(jit_compile=True)
+    def plain_step(xb, yb):
+        with tf.GradientTape() as tape:
+            loss = loss_fn(yb, m1(xb, training=True))
+        o1.apply_gradients(zip(tape.gradient(loss, m1.trainable_variables),
+                               m1.trainable_variables))
+        return loss
+
+    for _ in range(4):
+        l_plain = float(plain_step(x, y))
+        l_bps = float(step(x, y))
+    np.testing.assert_allclose(l_bps, l_plain, rtol=1e-5)
+    for w1, w2 in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(w2, w1, rtol=1e-4, atol=1e-6)
+
+
+def test_reduce_gradients_eager_priority_burst(session):
+    grads = [tf.constant(np.full((4,), float(i + 1), np.float32))
+             for i in range(3)] + [None]
+    out = bps_tf.reduce_gradients_eager(grads, scope="t", op="average")
+    assert out[3] is None
+    for i in range(3):
+        np.testing.assert_allclose(out[i].numpy(), np.full((4,), i + 1.0),
+                                   rtol=1e-6)

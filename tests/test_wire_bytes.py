@@ -1,0 +1,76 @@
+"""Wire-byte assertions for the compressed DCN hop (VERDICT r1 item 5).
+
+The claim in comm/compressed.py and ops/collective_ops.py — "only
+compressed bytes cross the inter-slice network" — is verified here at the
+XLA level: compile the hierarchical reduction on a (dcn=2, ici=4) mesh and
+account the bytes each collective moves, classified by which mesh axis its
+replica groups span.  This does not need two real slices: the compiled
+HLO's collective shapes ARE the wire contract (what a 2-slice pod would
+move over DCN), so the 32x saving is asserted, not just claimed.
+
+Reference anchor: compression wraps exactly the PUSH/PULL stages
+(reference operations.cc:199-204); the DCN hop is this design's analog.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from byteps_tpu.ops.collective_ops import (hierarchical_push_pull,
+                                           make_onebit_pair)
+from byteps_tpu.utils.hlo_wire import dcn_ici_bytes as _dcn_ici_bytes
+
+
+def _compile_hierarchical(mesh, n, compressed: bool):
+    compress, decompress = (make_onebit_pair() if compressed
+                            else (None, None))
+
+    def body(x):
+        return hierarchical_push_pull(x[0], op="sum", compress=compress,
+                                      decompress=decompress)
+
+    # body returns the full reduced array (it all-gathers internally), so
+    # the output is replicated
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(("dcn", "ici")),
+                              out_specs=P(), check_vma=False))
+    x = jnp.zeros((mesh.size, n), jnp.float32)
+    return f, f.lower(x).compile().as_text()
+
+
+@pytest.fixture
+def mesh():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("dcn", "ici"))
+
+
+def test_onebit_dcn_hop_is_32x_smaller(mesh):
+    n = 1 << 20  # 4 MB of f32 per rank
+    _, hlo_u = _compile_hierarchical(mesh, n, compressed=False)
+    _, hlo_c = _compile_hierarchical(mesh, n, compressed=True)
+    dcn_u, ici_u = _dcn_ici_bytes(hlo_u, n_ici=4)
+    dcn_c, ici_c = _dcn_ici_bytes(hlo_c, n_ici=4)
+    # uncompressed DCN hop: the full f32 1/n_ici shard (1 MB here)
+    assert dcn_u >= (n // 4) * 4
+    # compressed: sign bits (1/32 of f32) + the scale scalar; assert the
+    # end-to-end ratio with headroom for the scale/padding overhead
+    assert dcn_c * 25 < dcn_u, (dcn_c, dcn_u)
+    # compression must not touch intra-slice traffic (full-precision ICI)
+    assert ici_c == ici_u, (ici_c, ici_u)
+
+
+def test_compressed_hop_executes_and_is_signwise_correct(mesh):
+    n = 4096
+    f, _ = _compile_hierarchical(mesh, n, compressed=True)
+    rng = np.random.RandomState(3)
+    base = rng.randn(n).astype(np.float32)
+    x = jnp.asarray(np.broadcast_to(base, (8, n)).copy())
+    out = np.asarray(f(x))
+    assert out.shape == (n,)
+    assert np.isfinite(out).all()
+    # all ranks contribute identical tensors: the onebit hop preserves
+    # the sign structure of the sum exactly
+    np.testing.assert_array_equal(np.sign(out), np.sign(base * 8).astype(out.dtype))
