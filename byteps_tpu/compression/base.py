@@ -73,6 +73,29 @@ class Compressor:
         be shared across same-config chunks."""
         return (self.name, self.numel, str(self.dtype))
 
+    # -- host wire format --------------------------------------------------
+    # The reference moves compressed payloads over a real network (ps-lite
+    # ZPush/ZPull of the compressor's output buffer); the TPU analog is any
+    # host-side hop — the async-PS KV server, a host-staged DCN transport.
+    # The generic frame serializes the payload pytree; compressors with an
+    # entropy-codable layout override (dithering: Elias-delta).
+
+    def wire_encode(self, payload: Payload) -> bytes:
+        import io
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in payload.items()})
+        return buf.getvalue()
+
+    def wire_decode(self, data: bytes) -> Payload:
+        import io
+        with np.load(io.BytesIO(data)) as z:
+            return {k: jnp.asarray(z[k]) for k in z.files}
+
+    def wire_nbytes(self, payload: Payload) -> int:
+        """Measured wire size (data-dependent for entropy-coded layouts,
+        framing overhead included for the generic one)."""
+        return len(self.wire_encode(payload))
+
 
 class IdentityCompressor(Compressor):
     """No-op compressor (used when a tensor is below the compression size

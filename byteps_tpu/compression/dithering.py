@@ -124,6 +124,51 @@ class DitheringCompressor(Compressor):
             return self.sparse_k * (idx_b + 1) + 4
         return self.numel + 4  # int8 code per element + norm
 
+    # -- host-side entropy-coded wire format (reference parity) -----------
+    def _dense_codes(self, payload: Payload) -> np.ndarray:
+        codes = np.asarray(payload["codes"], np.int8)
+        if self.sparse_k:
+            dense = np.zeros(self.numel, np.int8)
+            dense[np.asarray(payload["idx"], np.int64)] = codes
+            return dense
+        return codes
+
+    def wire_encode(self, payload: Payload) -> bytes:
+        """Entropy-code a payload for a host-side hop (async-PS KV push,
+        host-staged DCN) — the reference's Elias-delta gap/sign/level wire
+        (dithering.cc:51-110), which the static-shape device layouts trade
+        away.  Sequential, so host-only; see compression/elias.py."""
+        from .elias import encode_wire
+        return encode_wire(self._dense_codes(payload),
+                           float(payload["norm"]))
+
+    def wire_decode(self, data: bytes) -> Payload:
+        """Inverse of :meth:`wire_encode`; returns a dense-layout payload
+        (decompress handles it regardless of the compressor's device
+        layout)."""
+        from .elias import decode_wire
+        codes, norm = decode_wire(data)
+        if codes.shape[0] != self.numel:
+            raise ValueError(
+                f"wire payload numel {codes.shape[0]} != {self.numel}")
+        payload: Payload = {"codes": jnp.asarray(codes),
+                            "norm": jnp.float32(norm)}
+        if self.sparse_k:
+            # re-sparsify so the payload matches this compressor's layout
+            from jax import lax as _lax
+            _, idx = _lax.top_k(jnp.abs(payload["codes"]).astype(jnp.int32),
+                                self.sparse_k)
+            payload = {"idx": idx.astype(self.idx_dtype),
+                       "codes": jnp.take(payload["codes"], idx),
+                       "norm": payload["norm"]}
+        return payload
+
+    def wire_nbytes(self, payload: Payload) -> int:
+        """Measured entropy-coded size of this payload (telemetry /
+        ratio accounting; data-dependent, unlike payload_nbytes)."""
+        from .elias import wire_nbytes
+        return wire_nbytes(self._dense_codes(payload))
+
     def cache_key(self) -> tuple:
         return super().cache_key() + (self.s, self.partition,
                                       self.normalize, self.seed,

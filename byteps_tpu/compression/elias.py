@@ -1,0 +1,161 @@
+"""Elias-delta wire format for sparse quantization codes (host-side).
+
+The reference's dithering compressor ships entropy-coded payloads — per
+nonzero element: gap-to-previous, sign bit, |level|, all Elias-delta coded
+through a sequential BitWriter (reference compressor/impl/dithering.cc:
+51-110, utils.h BitWriter/EliasDelta).  Variable-length sequential coding
+cannot live inside an XLA program (static shapes), so this codec runs on
+the host, where the bytes actually hit a wire: the async-PS KV paths and
+any DCN transport that stages through host memory.  The device-side
+layouts (dense int8, sparse index+code — compression/dithering.py) remain
+static-shape.
+
+Implementation: the hot path is the C++ coder in native/core.cc
+(bps_elias_encode/decode); this module adds a bit-exact numpy twin (the
+test oracle, and the fallback when the native build is unavailable) and
+the framed wire format:
+
+    word[0]   : nbits (uint32)
+    word[1]   : numel (uint32)
+    word[2]   : norm  (float32 bits)
+    word[3:]  : elias-delta bitstream, LSB-first within uint32 words
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------- numpy twin
+
+def _bitlen(x: int) -> int:
+    return int(x).bit_length()
+
+
+def elias_encode_np(codes: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Bit-exact numpy twin of native bps_elias_encode."""
+    codes = np.asarray(codes, dtype=np.int8)
+    bits = []
+    last = -1
+    for i in np.flatnonzero(codes):
+        i = int(i)
+        for x in (i - last,):
+            n = _bitlen(x)
+            ln = _bitlen(n)
+            bits.extend([0] * (ln - 1))
+            bits.extend((n >> k) & 1 for k in range(ln - 1, -1, -1))
+            bits.extend((x >> k) & 1 for k in range(n - 2, -1, -1))
+        c = int(codes[i])
+        bits.append(1 if c < 0 else 0)
+        mag = -c if c < 0 else c
+        n = _bitlen(mag)
+        ln = _bitlen(n)
+        bits.extend([0] * (ln - 1))
+        bits.extend((n >> k) & 1 for k in range(ln - 1, -1, -1))
+        bits.extend((mag >> k) & 1 for k in range(n - 2, -1, -1))
+        last = i
+    nbits = len(bits)
+    words = np.zeros((nbits + 31) // 32, np.uint32)
+    for pos, b in enumerate(bits):
+        if b:
+            words[pos >> 5] |= np.uint32(1 << (pos & 31))
+    return words, nbits
+
+
+def elias_decode_np(words: np.ndarray, nbits: int, n: int) -> np.ndarray:
+    """Bit-exact numpy twin of native bps_elias_decode."""
+    words = np.asarray(words, dtype=np.uint32)
+    out = np.zeros(n, np.int8)
+    pos = 0
+
+    def get() -> int:
+        nonlocal pos
+        if pos >= nbits:
+            raise ValueError("malformed elias-delta stream (truncated)")
+        b = (int(words[pos >> 5]) >> (pos & 31)) & 1
+        pos += 1
+        return b
+
+    def get_elias() -> int:
+        zeros = 0
+        while get() == 0:
+            zeros += 1
+            if zeros > 63:
+                raise ValueError("malformed elias-delta stream")
+        nlen = 1
+        for _ in range(zeros):
+            nlen = (nlen << 1) | get()
+        x = 1
+        for _ in range(nlen - 1):
+            x = (x << 1) | get()
+        return x
+
+    idx = -1
+    while pos < nbits:
+        gap = get_elias()
+        sign = get()
+        mag = get_elias()
+        if not 1 <= mag <= 127:
+            raise ValueError("malformed elias-delta stream (level range)")
+        idx += gap
+        if idx >= n:
+            raise ValueError("malformed elias-delta stream (index range)")
+        out[idx] = -mag if sign else mag
+    return out
+
+
+# ------------------------------------------------------ native dispatch
+
+def elias_encode(codes: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Encode via the C++ coder, numpy twin as fallback."""
+    from ..native import elias_encode as native_encode
+    res = native_encode(codes)
+    if res is not None:
+        return res
+    return elias_encode_np(codes)
+
+
+def elias_decode(words: np.ndarray, nbits: int, n: int) -> np.ndarray:
+    from ..native import elias_decode as native_decode
+    res = native_decode(words, nbits, n)
+    if res is not None:
+        return res
+    return elias_decode_np(words, nbits, n)
+
+
+# --------------------------------------------------------- framed wire
+
+def encode_wire(codes: np.ndarray, norm: float) -> bytes:
+    """Frame a dithering payload (dense signed codes + norm) as wire bytes."""
+    words, nbits = elias_encode(codes)
+    header = np.empty(3, np.uint32)
+    header[0] = np.uint32(nbits)
+    header[1] = np.uint32(len(codes))
+    header[2] = np.float32(norm).view(np.uint32)
+    return header.tobytes() + words.tobytes()
+
+
+def decode_wire(data: bytes) -> Tuple[np.ndarray, float]:
+    """Inverse of :func:`encode_wire`: (dense int8 codes, norm).
+    Validates the frame before the bitstream ever reaches the native
+    decoder — wire bytes are untrusted input."""
+    if len(data) < 12:
+        raise ValueError("wire frame shorter than its header")
+    header = np.frombuffer(data[:12], np.uint32)
+    nbits, numel = int(header[0]), int(header[1])
+    norm = float(header[2:3].view(np.float32)[0])
+    nwords = (nbits + 31) // 32
+    if len(data) < 12 + 4 * nwords:
+        raise ValueError(
+            f"wire frame truncated: header claims {nbits} bits "
+            f"({nwords} words) but carries {len(data) - 12} bytes")
+    words = np.frombuffer(data[12:12 + 4 * nwords], np.uint32)
+    return elias_decode(words, nbits, numel), norm
+
+
+def wire_nbytes(codes: np.ndarray) -> int:
+    """Measured wire size of a payload (header + bitstream)."""
+    words, _ = elias_encode(codes)
+    return 12 + 4 * len(words)

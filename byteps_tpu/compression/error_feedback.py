@@ -51,3 +51,15 @@ class ErrorFeedback(Compressor):
 
     def cache_key(self) -> tuple:
         return ("ef",) + self.inner.cache_key()
+
+    # wire format is the inner compressor's: decorators change state
+    # threading, not the payload layout (a momentum-configured worker and
+    # the momentum-skipping server codec must speak one format)
+    def wire_encode(self, payload):
+        return self.inner.wire_encode(payload)
+
+    def wire_decode(self, data):
+        return self.inner.wire_decode(data)
+
+    def wire_nbytes(self, payload) -> int:
+        return self.inner.wire_nbytes(payload)

@@ -66,7 +66,7 @@ def load() -> Optional[ctypes.CDLL]:
                 _compile(path)
                 lib = ctypes.CDLL(path)
             _declare_signatures(lib)
-            if lib.bps_native_abi_version() != 1:
+            if lib.bps_native_abi_version() != 2:
                 raise RuntimeError("native ABI mismatch")
             _lib = lib
         except Exception:
@@ -128,6 +128,12 @@ def _declare_signatures(lib: ctypes.CDLL) -> None:
     lib.bps_reduce_sum_bf16.argtypes = [ctypes.POINTER(ctypes.c_uint16),
                                         ctypes.POINTER(ctypes.c_uint16),
                                         i64, ctypes.c_int]
+    lib.bps_elias_encode.restype = i64
+    lib.bps_elias_encode.argtypes = [ctypes.POINTER(ctypes.c_int8), i64,
+                                     ctypes.POINTER(ctypes.c_uint32), i64]
+    lib.bps_elias_decode.restype = i64
+    lib.bps_elias_decode.argtypes = [ctypes.POINTER(ctypes.c_uint32), i64,
+                                     ctypes.POINTER(ctypes.c_int8), i64]
     lib.bps_native_abi_version.restype = ctypes.c_int
 
 
@@ -278,3 +284,44 @@ def make_key(declared: int, part: int) -> int:
     if lib is None:
         return (declared << 16) | (part & 0xFFFF)
     return int(lib.bps_make_key(declared, part))
+
+
+# --------------------------------------------------------- elias-delta coder
+
+def elias_encode(codes: np.ndarray) -> Optional[Tuple[np.ndarray, int]]:
+    """Entropy-code signed int8 level codes (gap/sign/|level| triplets,
+    Elias-delta); returns (uint32 words, nbits) or None when the native
+    core is unavailable (callers fall back to the numpy twin in
+    compression.elias)."""
+    lib = load()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.int8)
+    cap = max(4, codes.size + 64)
+    while True:
+        out = np.zeros(cap, np.uint32)
+        nbits = lib.bps_elias_encode(
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)), codes.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), cap)
+        if nbits == -2:
+            cap *= 2
+            continue
+        nwords = (int(nbits) + 31) // 32
+        return out[:nwords].copy(), int(nbits)
+
+
+def elias_decode(words: np.ndarray, nbits: int,
+                 n: int) -> Optional[np.ndarray]:
+    """Inverse of :func:`elias_encode`; returns dense int8 codes or None
+    when the native core is unavailable.  Raises on a malformed stream."""
+    lib = load()
+    if lib is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    out = np.zeros(n, np.int8)
+    rc = lib.bps_elias_decode(
+        words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), int(nbits),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)), n)
+    if rc != 0:
+        raise ValueError("malformed elias-delta stream")
+    return out

@@ -113,6 +113,22 @@ class PriorityQueue:
             self._push_cnt[key] = 0
 
 
+class _Codec:
+    """Per-key compression codec: the server-side compressor chain with
+    its functional state, plus a per-merge-version wire cache (the
+    reference likewise caches compressed pull responses per key,
+    server.cc:34-75)."""
+
+    __slots__ = ("comp", "state", "lock", "cached_version", "cached_wire")
+
+    def __init__(self, comp):
+        self.comp = comp
+        self.state = comp.init_state()
+        self.lock = threading.Lock()
+        self.cached_version = -1
+        self.cached_wire: Optional[bytes] = None
+
+
 class _KeyState:
     __slots__ = ("merged", "count", "version", "parked", "lock",
                  "submitted", "shape", "dtype", "poisoned")
@@ -147,6 +163,7 @@ class ServerEngine:
                            else cfg.server_debug_key)
         self.queues = [PriorityQueue(sched) for _ in range(self.num_threads)]
         self._states: Dict[str, _KeyState] = {}
+        self._codecs: Dict[str, "_Codec"] = {}
         self._states_lock = threading.Lock()
         # sticky least-loaded-by-bytes assignment (server.h GetThreadID)
         self._tid_of: Dict[str, int] = {}
@@ -207,12 +224,21 @@ class ServerEngine:
     def pull(self, key: str, timeout: Optional[float] = None) -> np.ndarray:
         """Blocks until the current round's merge completes (parked-pull
         semantics, server.cc:371-404)."""
+        return self._pull_versioned(key, timeout)[0]
+
+    def _pull_versioned(self, key: str, timeout: Optional[float] = None
+                        ) -> tuple:
+        """(merged array, merge version) — read atomically under the key
+        lock / at publish time, so a caller can key caches by the version
+        that actually produced the array (pull_compressed's wire cache
+        would otherwise tag round k's data with k+1 under overlap)."""
         st = self._state(key)
         ev = threading.Event()
-        box: Dict[str, np.ndarray] = {}
+        box: Dict[str, Any] = {}
 
-        def fulfill(arr: Optional[np.ndarray]) -> None:
+        def fulfill(arr: Optional[np.ndarray], version: int = -1) -> None:
             box["v"] = arr
+            box["ver"] = version
             ev.set()
 
         with st.lock:
@@ -226,14 +252,63 @@ class ServerEngine:
             # of the reference handler: a pull enqueued after a round's
             # pushes waits for that round)
             if st.version > 0 and st.submitted == 0 and st.count == 0:
-                return np.array(st.merged, copy=True)
+                return np.array(st.merged, copy=True), st.version
             st.parked.append(fulfill)
         if not ev.wait(timeout):
             raise TimeoutError(f"pull({key!r}) timed out")
         if box["v"] is None:
             raise RuntimeError(f"key {key!r} was poisoned while this "
                                "pull was parked")
-        return box["v"]
+        return box["v"], box["ver"]
+
+    # -- compressed push/pull (reference server.cc:87-113) -----------------
+
+    def register_compression(self, key: str, kwargs: Dict[str, str],
+                             numel: int, dtype=np.float32) -> None:
+        """Declare a key as compressed: pushes arrive as wire bytes and
+        are decompressed before merging; pulls return the merged result
+        re-compressed (the reference server's compressed mode — it
+        decompresses each push, sums, and re-compresses the merged data,
+        server.cc:87-113).  The codec is the server-side compressor chain
+        (momentum skipped, compressor_registry.cc:39-56)."""
+        from ..compression import registry as compression_registry
+        comp = compression_registry.create(dict(kwargs), numel, dtype,
+                                           for_server=True)
+        with self._states_lock:
+            self._codecs[key] = _Codec(comp)
+
+    def _codec(self, key: str) -> "_Codec":
+        with self._states_lock:
+            codec = self._codecs.get(key)
+        if codec is None:
+            raise KeyError(f"key {key!r} has no registered compression")
+        return codec
+
+    def push_compressed(self, key: str, data: bytes, worker_id: int,
+                        num_workers: int) -> None:
+        """Push one worker's wire-encoded payload; decompressed here (the
+        caller's thread — same placement as shape validation) and merged
+        by the engine threads like any dense push."""
+        comp = self._codec(key).comp
+        value = np.asarray(comp.decompress(comp.wire_decode(data)))
+        self.push(key, value, worker_id, num_workers)
+
+    def pull_compressed(self, key: str,
+                        timeout: Optional[float] = None) -> bytes:
+        """Pull the merged result re-compressed to wire bytes.  Stateful
+        codecs (server-side error feedback) advance once per completed
+        round: the compression is cached under the merge version, so
+        concurrent pullers of one round share a single compression."""
+        import jax.numpy as jnp
+        codec = self._codec(key)
+        merged, version = self._pull_versioned(key, timeout=timeout)
+        with codec.lock:
+            if codec.cached_version != version:
+                payload, codec.state = codec.comp.compress(
+                    jnp.asarray(merged.reshape(-1)), codec.state)
+                codec.cached_wire = codec.comp.wire_encode(payload)
+                codec.cached_version = version
+            return codec.cached_wire
 
     def version(self, key: str) -> int:
         return self._state(key).version
@@ -298,5 +373,6 @@ class ServerEngine:
                 q.clear_counter(msg.key)
                 parked, st.parked = st.parked, []
                 out = st.merged
+                version = st.version
                 for fulfill in parked:
-                    fulfill(np.array(out, copy=True))
+                    fulfill(np.array(out, copy=True), version)

@@ -1,0 +1,192 @@
+"""Elias-delta wire codec tests (VERDICT r1 item 8, reference parity for
+the entropy-coded dithering payload — reference dithering.cc:51-110).
+
+The C++ coder (native/core.cc) and the numpy twin
+(compression/elias.py) must agree bit-for-bit; the framed wire format
+must round-trip through the DitheringCompressor's device layouts; and the
+measured wire bytes must beat both static layouts on sparse posteriors —
+the ratio the reference's entropy coding exists for.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from byteps_tpu.compression import elias
+from byteps_tpu.compression import create as create_compressor
+from byteps_tpu import native
+
+
+def _sparse_codes(n=4096, nnz=80, seed=0, maxlevel=16):
+    rng = np.random.RandomState(seed)
+    codes = np.zeros(n, np.int8)
+    hot = rng.choice(n, nnz, replace=False)
+    codes[hot] = rng.randint(1, maxlevel + 1, nnz) * \
+        rng.choice([-1, 1], nnz).astype(np.int8)
+    return codes
+
+
+@pytest.mark.parametrize("seed,nnz,maxlevel", [(0, 80, 16), (1, 1, 1),
+                                               (2, 4096, 127), (3, 0, 16)])
+def test_numpy_roundtrip(seed, nnz, maxlevel):
+    codes = _sparse_codes(nnz=nnz, seed=seed, maxlevel=maxlevel)
+    words, nbits = elias.elias_encode_np(codes)
+    out = elias.elias_decode_np(words, nbits, len(codes))
+    np.testing.assert_array_equal(out, codes)
+
+
+def test_native_matches_numpy_bit_for_bit():
+    if not native.available():
+        pytest.skip("native core unavailable")
+    for seed in range(5):
+        codes = _sparse_codes(seed=seed, nnz=200, maxlevel=127)
+        w_np, b_np = elias.elias_encode_np(codes)
+        res = native.elias_encode(codes)
+        assert res is not None
+        w_c, b_c = res
+        assert b_c == b_np
+        np.testing.assert_array_equal(w_c, w_np)
+        # cross-decode: each implementation reads the other's stream
+        np.testing.assert_array_equal(
+            native.elias_decode(w_np, b_np, len(codes)), codes)
+        np.testing.assert_array_equal(
+            elias.elias_decode_np(w_c, b_c, len(codes)), codes)
+
+
+def test_edge_positions_and_levels():
+    # first/last element nonzero, max gap, level 1 and 127
+    codes = np.zeros(1000, np.int8)
+    codes[0] = 127
+    codes[999] = -1
+    words, nbits = elias.elias_encode(codes)
+    np.testing.assert_array_equal(
+        elias.elias_decode(words, nbits, 1000), codes)
+
+
+def test_malformed_stream_raises():
+    codes = _sparse_codes(nnz=50)
+    words, nbits = elias.elias_encode(codes)
+    with pytest.raises(ValueError):
+        elias.elias_decode(words, nbits - 3, len(codes))  # truncated
+    bad = words.copy()
+    bad[0] = 0  # a leading run of zeros longer than any valid length field
+    with pytest.raises(ValueError):
+        elias.elias_decode(bad, nbits, len(codes))
+
+
+def test_wire_frame_roundtrip_and_ratio():
+    codes = _sparse_codes(n=8192, nnz=100)
+    data = elias.encode_wire(codes, 2.5)
+    out, norm = elias.decode_wire(data)
+    np.testing.assert_array_equal(out, codes)
+    assert norm == 2.5
+    # entropy coding beats the dense int8 layout ~20x at 1.2% density and
+    # the static sparse (uint16+int8)/element layout too
+    dense_bytes = 8192 + 4
+    sparse_bytes = 100 * 3 + 4
+    assert len(data) < dense_bytes / 15
+    assert len(data) < sparse_bytes * 1.6  # within ~1.6x of exact-k sparse
+    assert elias.wire_nbytes(codes) == len(data)
+
+
+@pytest.mark.parametrize("sparse_ratio", ["0.0", "0.05"])
+def test_dithering_wire_encode_decode(sparse_ratio):
+    rng = np.random.RandomState(9)
+    x = np.zeros(4000, np.float32)
+    hot = rng.choice(4000, 60, replace=False)
+    x[hot] = rng.randn(60).astype(np.float32) * 3
+    comp = create_compressor(
+        {"compressor": "dithering", "partition_num": "16", "seed": "4",
+         "sparse_ratio": sparse_ratio}, len(x))
+    payload, _ = comp.compress(jnp.asarray(x), comp.init_state())
+    data = comp.wire_encode(payload)
+    payload2 = comp.wire_decode(data)
+    np.testing.assert_allclose(np.asarray(comp.decompress(payload2)),
+                               np.asarray(comp.decompress(payload)),
+                               rtol=1e-6, atol=0)
+    # measured wire accounting
+    assert comp.wire_nbytes(payload) == len(data)
+    assert len(data) < comp.payload_nbytes()
+
+
+def _bits_to_words(bits):
+    words = np.zeros((len(bits) + 31) // 32, np.uint32)
+    for pos, b in enumerate(bits):
+        if b:
+            words[pos >> 5] |= np.uint32(1 << (pos & 31))
+    return words
+
+
+def _elias_bits(x):
+    n = int(x).bit_length()
+    ln = n.bit_length()
+    return ([0] * (ln - 1)
+            + [(n >> k) & 1 for k in range(ln - 1, -1, -1)]
+            + [(x >> k) & 1 for k in range(n - 2, -1, -1)])
+
+
+def test_forged_gap_overflow_rejected():
+    """A 64-bit gap >= 2^63 must be rejected, not wrap negative and write
+    before the output buffer (untrusted wire bytes reach this decoder
+    through ServerEngine.push_compressed)."""
+    if not native.available():
+        pytest.skip("native core unavailable")
+    bits = _elias_bits((1 << 63) + 5) + [0] + _elias_bits(3)
+    words = _bits_to_words(bits)
+    with pytest.raises(ValueError):
+        native.elias_decode(words, len(bits), np.int8(0).itemsize * 100)
+
+
+def test_forged_length_field_terminates():
+    """63 leading zeros forge a ~2^63 length field; the decoder must fail
+    fast instead of looping for years."""
+    if not native.available():
+        pytest.skip("native core unavailable")
+    words = np.zeros(4, np.uint32)  # 128 zero bits
+    with pytest.raises(ValueError):
+        native.elias_decode(words, 128, 100)
+    with pytest.raises(ValueError):
+        elias.elias_decode_np(words, 128, 100)
+
+
+def test_gap_past_end_rejected():
+    bits = _elias_bits(50) + [0] + _elias_bits(3)  # gap 50 into n=10
+    words = _bits_to_words(bits)
+    for decode in ((lambda w, b, n: native.elias_decode(w, b, n))
+                   if native.available() else None,
+                   elias.elias_decode_np):
+        if decode is None:
+            continue
+        with pytest.raises(ValueError):
+            decode(words, len(bits), 10)
+
+
+def test_truncated_wire_frame_rejected():
+    codes = _sparse_codes(nnz=40)
+    data = elias.encode_wire(codes, 1.0)
+    with pytest.raises(ValueError):
+        elias.decode_wire(data[:8])  # shorter than the header
+    with pytest.raises(ValueError):
+        elias.decode_wire(data[:-4])  # header claims more words
+
+
+def test_decorated_compressor_wire_matches_server_codec():
+    """Worker chain momentum(ef(dithering)) and the momentum-skipping
+    server codec must speak the same wire format (decorators delegate
+    wire_* to the inner compressor)."""
+    rng = np.random.RandomState(5)
+    x = np.zeros(2048, np.float32)
+    x[rng.choice(2048, 30, replace=False)] = rng.randn(30)
+    kw = {"compressor": "dithering", "partition_num": "16", "seed": "1",
+          "ef": "vanilla", "momentum": "nesterov"}
+    worker = create_compressor(kw, len(x))
+    server = create_compressor(kw, len(x), for_server=True)
+    payload, _ = worker.compress(jnp.asarray(x), worker.init_state())
+    wire = worker.wire_encode(payload)
+    decoded = server.wire_decode(wire)
+    np.testing.assert_allclose(np.asarray(server.decompress(decoded)),
+                               np.asarray(worker.decompress(payload)),
+                               rtol=1e-6, atol=0)
+    # and it IS the tight elias frame, not the generic npz fallback
+    assert not wire.startswith(b"PK")  # zip magic
+    assert len(wire) < 2048 / 4
