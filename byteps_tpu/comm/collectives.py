@@ -59,36 +59,50 @@ def _acc(x):
     return x
 
 
-def _all_reduce_fn(comm: CommContext, average: bool, keep_acc: bool = False):
+def _epilogue(r, x_dtype, comm, average: bool, keep_acc: bool, scale):
+    """Shared reduction epilogue.  ``scale`` (a traced scalar, or None)
+    is the engine's fused denominator: applied to the accumulation-dtype
+    sum BEFORE any downcast, so f16/bf16 averages keep the overflow
+    discipline and f64 keeps full precision (the scale is passed in the
+    accumulation dtype, never forced to f32)."""
+    if scale is not None:
+        return (r * scale).astype(x_dtype)
+    if average:
+        return (r / comm.num_ranks).astype(x_dtype)
+    if keep_acc:
+        # engine-internal SUM: f16/bf16 stays f32 so the caller's
+        # over-count division happens before any downcast (fp16 R-way
+        # sums top out at 65504/R)
+        return r
+    return r.astype(x_dtype)
+
+
+def _all_reduce_fn(comm: CommContext, average: bool, keep_acc: bool = False,
+                   scaled: bool = False):
     def build():
         axes = comm.dp_axes
 
-        def body(x):
+        def body(x, *scale):
             x0 = x[0]
             r = lax.psum(_acc(x0), axes)
-            if average:
-                return (r / comm.num_ranks).astype(x0.dtype)
-            if keep_acc:
-                # engine-internal SUM: f16/bf16 stays f32 so the caller's
-                # over-count division happens before any downcast (fp16
-                # R-way sums top out at 65504/R)
-                return r
-            return r.astype(x0.dtype)
+            return _epilogue(r, x0.dtype, comm, average, keep_acc,
+                             scale[0] if scaled else None)
 
+        in_specs = (P(axes), P()) if scaled else P(axes)
         # No donation: the input frequently aliases a user-held gradient
         # array (engine passes a reshape view), which donation would delete
         # on TPU.
         return jax.jit(jax.shard_map(body, mesh=comm.mesh,
-                                     in_specs=P(axes), out_specs=P()))
-    return _cached(comm, ("all_reduce", average, keep_acc), build)
+                                     in_specs=in_specs, out_specs=P()))
+    return _cached(comm, ("all_reduce", average, keep_acc, scaled), build)
 
 
 def _hierarchical_fn(comm: CommContext, average: bool,
-                     keep_acc: bool = False):
+                     keep_acc: bool = False, scaled: bool = False):
     n_ici = comm.n_ici
 
     def build():
-        def body(x):
+        def body(x, *scale):
             x = x[0]  # [n], n % n_ici == 0
             # intra-slice reduce-scatter: each device owns a summed shard
             # (f32 accumulation for sub-f32 floats, see _acc)
@@ -98,36 +112,34 @@ def _hierarchical_fn(comm: CommContext, average: bool,
             # equivalent); a size-1 dcn axis makes this a no-op but keeps
             # the value replication statically provable.
             s = lax.psum(s, DCN_AXIS)
-            if average:
-                return (s / comm.num_ranks).astype(x.dtype)
-            if keep_acc:
-                return s  # see _all_reduce_fn
-            return s.astype(x.dtype)
+            return _epilogue(s, x.dtype, comm, average, keep_acc,
+                             scale[0] if scaled else None)
 
         # The reference finishes with an intra-node AllGather ("BROADCAST"
         # stage, core_loops.cc:254-268).  Here the gather is implicit: the
         # body returns each device's reduced shard and out_specs=P(ici)
         # stitches the global tensor, so XLA only materializes an all-gather
         # if and where a consumer actually needs unsharded values.
+        in_specs = (P(comm.dp_axes), P()) if scaled else P(comm.dp_axes)
         inner = jax.shard_map(body, mesh=comm.mesh,
-                              in_specs=P(comm.dp_axes),
+                              in_specs=in_specs,
                               out_specs=P(ICI_AXIS))
 
-        def fn(stacked):
+        def fn(stacked, *scale):
             r = stacked.shape[0]
             flat = stacked.reshape(r, -1)
             n = flat.shape[1]
             pad = (-n) % n_ici
             if pad:
                 flat = jnp.pad(flat, ((0, 0), (0, pad)))
-            out = inner(flat)
+            out = inner(flat, *scale)
             if pad:
                 out = out[:n]
             return out.reshape(stacked.shape[1:])
 
         return jax.jit(fn)
 
-    return _cached(comm, ("hierarchical", average, keep_acc), build)
+    return _cached(comm, ("hierarchical", average, keep_acc, scaled), build)
 
 
 def _broadcast_fn(comm: CommContext, root: int):
@@ -218,3 +230,19 @@ def push_pull_array(comm: CommContext, stacked, op: str = "average",
     if hierarchical:
         return hierarchical_all_reduce(comm, stacked, op, keep_acc)
     return all_reduce(comm, stacked, op, keep_acc)
+
+
+def push_pull_array_scaled(comm: CommContext, stacked, scale: float,
+                           hierarchical: Optional[bool] = None) -> jax.Array:
+    """Fused sum-and-scale (engine hot path): out = sum(ranks) * scale in
+    one compiled program, result already in the input dtype.  The scale is
+    passed in the *accumulation* dtype of the input (f64 stays f64; every
+    other float accumulates in f32), so fusing never costs precision over
+    the assembly-time division it replaces."""
+    if hierarchical is None:
+        hierarchical = comm.n_dcn > 1
+    fn = (_hierarchical_fn(comm, False, scaled=True) if hierarchical
+          else _all_reduce_fn(comm, False, scaled=True))
+    acc_dtype = (jnp.float64 if stacked.dtype == jnp.float64
+                 else jnp.float32)
+    return fn(_as_stacked(comm, stacked), jnp.asarray(scale, acc_dtype))

@@ -142,6 +142,10 @@ class ChunkTask:
     # set by the engine for compressed tensors: the per-chunk compression
     # slot (reference BPSContext.compressor_list, common.h:177-205)
     compression: Any = None
+    # fused-scale path: when set, the collective applies this factor
+    # in-graph (sum * scale, before any downcast) and assembly is a pure
+    # reshape — no eager divide on the hot path
+    scale: Optional[float] = None
     # tracing (reference recorderTs, scheduled_queue.cc:105-123)
     step: int = 0
     t_enqueue: float = 0.0

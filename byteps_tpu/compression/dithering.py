@@ -145,12 +145,10 @@ class DitheringCompressor(Compressor):
     def wire_decode(self, data: bytes) -> Payload:
         """Inverse of :meth:`wire_encode`; returns a dense-layout payload
         (decompress handles it regardless of the compressor's device
-        layout)."""
+        layout).  ``expected_numel`` rejects a forged numel header before
+        any allocation (wire bytes are untrusted)."""
         from .elias import decode_wire
-        codes, norm = decode_wire(data)
-        if codes.shape[0] != self.numel:
-            raise ValueError(
-                f"wire payload numel {codes.shape[0]} != {self.numel}")
+        codes, norm = decode_wire(data, expected_numel=self.numel)
         payload: Payload = {"codes": jnp.asarray(codes),
                             "norm": jnp.float32(norm)}
         if self.sparse_k:

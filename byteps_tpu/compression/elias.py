@@ -137,15 +137,23 @@ def encode_wire(codes: np.ndarray, norm: float) -> bytes:
     return header.tobytes() + words.tobytes()
 
 
-def decode_wire(data: bytes) -> Tuple[np.ndarray, float]:
+def decode_wire(data: bytes,
+                expected_numel: Optional[int] = None
+                ) -> Tuple[np.ndarray, float]:
     """Inverse of :func:`encode_wire`: (dense int8 codes, norm).
     Validates the frame before the bitstream ever reaches the native
-    decoder — wire bytes are untrusted input."""
+    decoder — wire bytes are untrusted input.  Pass ``expected_numel``
+    whenever the caller knows the tensor size (compressors do): a forged
+    header otherwise dictates the output allocation (a 16-byte frame
+    claiming numel=2^32 would allocate 4 GiB before any later check)."""
     if len(data) < 12:
         raise ValueError("wire frame shorter than its header")
     header = np.frombuffer(data[:12], np.uint32)
     nbits, numel = int(header[0]), int(header[1])
     norm = float(header[2:3].view(np.float32)[0])
+    if expected_numel is not None and numel != expected_numel:
+        raise ValueError(
+            f"wire payload numel {numel} != expected {expected_numel}")
     nwords = (nbits + 31) // 32
     if len(data) < 12 + 4 * nwords:
         raise ValueError(

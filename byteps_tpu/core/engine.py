@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..comm.collectives import push_pull_array
+from ..comm.collectives import push_pull_array, push_pull_array_scaled
 from ..comm.compressed import compressed_all_reduce
 from ..comm.mesh import CommContext
 from ..compression import registry as compression_registry
@@ -167,12 +167,22 @@ class PushPullEngine:
         handle = self.handles.allocate(name)
         if denom is None:
             denom = self.comm.num_ranks if op == "average" else 1
+        self._ensure_compression(ctx, stacked.dtype)
+        # Fused-scale fast path (float, uncompressed): the collective
+        # applies 1/denom in-graph, so assembly needs no eager divide or
+        # dtype restore — for small tensors those eager ops cost more than
+        # the collective itself.  Ints and compressed chunks keep the
+        # assembly-time division (exact // semantics / post-merge denom).
+        scale = None
+        if (denom != 1 and ctx.compressor is None
+                and jnp.issubdtype(np.dtype(stacked.dtype), jnp.inexact)):
+            scale = 1.0 / denom
+            denom = 1
         pending = _PendingTensor(handle, ctx, out_shape, op, denom)
         with ctx.lock:
             ctx.version += 1
             version = ctx.version
 
-        self._ensure_compression(ctx, stacked.dtype)
         if self.tracer.enabled:
             step = self.tracer.on_push(name)
             t_enq = self.tracer.now()
@@ -190,6 +200,7 @@ class PushPullEngine:
                 data=chunk,
                 compression=(ctx.compressor[part_idx]
                              if ctx.compressor else None),
+                scale=scale,
                 step=step, t_enqueue=t_enq,
             )
             task.callback = self._make_chunk_callback(pending, part_idx)
@@ -291,6 +302,9 @@ class PushPullEngine:
                     rollback = (slot, slot.wstates, slot.sstate)
                     slot.wstates = new_wst
                     slot.sstate = new_sst
+                elif task.scale is not None:
+                    out = push_pull_array_scaled(self.comm, task.data,
+                                                 task.scale)
                 else:
                     out = push_pull_array(self.comm, task.data, op="sum",
                                           keep_acc=True)

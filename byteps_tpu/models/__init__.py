@@ -3,3 +3,11 @@ directory (PyTorch MNIST, synthetic ResNet-50, GluonNLP BERT-large —
 SURVEY.md §6 configs)."""
 
 from .mlp import MLP, mnist_mlp  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    VGG,
+    resnet18,
+    resnet50,
+    resnet_tiny,
+    vgg16,
+)

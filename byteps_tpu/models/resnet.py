@@ -1,0 +1,199 @@
+"""ResNet and VGG — the reference's benchmark vision family, TPU-first.
+
+The reference's published perf table is ResNet-50 and VGG-16 images/s
+(reference docs/performance.md:3-23, example/pytorch/
+train_imagenet_resnet50_byteps.py, keras_imagenet_resnet50.py).  These are
+re-designed for TPU rather than ported from torchvision:
+
+- **NHWC** layout throughout — the layout XLA:TPU convolutions natively
+  tile; NCHW would insert transposes at every conv.
+- **bf16 compute, f32 params**: convolutions/matmuls run in bfloat16 on
+  the MXU (``compute_dtype=jnp.bfloat16``); parameters, batch statistics
+  and the softmax stay f32.
+- **Cross-replica BatchNorm**: ``axis_name`` threads the mesh axes into
+  the batch-stat reduction, so statistics are computed over the *global*
+  batch under data parallelism (the sync-BN the reference delegates to
+  the frameworks).  Running stats then update identically on every
+  replica — no extra broadcast needed.
+- Pure-functional state: batch statistics live in a ``batch_stats``
+  collection threaded by ``parallel.make_dp_train_step_with_state``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    compute_dtype: Any = jnp.bfloat16
+    axis_name: Optional[Any] = None
+    act: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides, padding="SAME",
+                    use_bias=False, dtype=self.compute_dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32,
+                         axis_name=self.axis_name)(x.astype(jnp.float32))
+        x = x.astype(self.compute_dtype)
+        return nn.relu(x) if self.act else x
+
+
+class Bottleneck(nn.Module):
+    """ResNet-v1.5 bottleneck: 1x1 reduce, 3x3 (stride here, as v1.5),
+    1x1 expand, residual add."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    compute_dtype: Any = jnp.bfloat16
+    axis_name: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype,
+                     axis_name=self.axis_name)
+        residual = x
+        y = cb(self.features, (1, 1))(x, train)
+        y = cb(self.features, (3, 3), self.strides)(y, train)
+        y = cb(self.features * 4, (1, 1), act=False)(y, train)
+        if residual.shape != y.shape:
+            residual = cb(self.features * 4, (1, 1), self.strides,
+                          act=False)(residual, train)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    """ResNet-18/34 block: two 3x3 convs + residual."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    compute_dtype: Any = jnp.bfloat16
+    axis_name: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype,
+                     axis_name=self.axis_name)
+        residual = x
+        y = cb(self.features, (3, 3), self.strides)(x, train)
+        y = cb(self.features, (3, 3), act=False)(y, train)
+        if residual.shape != y.shape:
+            residual = cb(self.features, (1, 1), self.strides,
+                          act=False)(residual, train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet; ``stage_sizes``/``block`` select the depth."""
+
+    stage_sizes: Sequence[int]
+    block: Callable
+    num_classes: int = 1000
+    width: int = 64
+    compute_dtype: Any = jnp.bfloat16
+    axis_name: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype,
+                     axis_name=self.axis_name)
+        x = x.astype(self.compute_dtype)
+        x = cb(self.width, (7, 7), (2, 2))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(self.width * 2 ** i, strides,
+                               compute_dtype=self.compute_dtype,
+                               axis_name=self.axis_name)(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+def resnet50(num_classes: int = 1000, axis_name=None,
+             compute_dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=Bottleneck,
+                  num_classes=num_classes, axis_name=axis_name,
+                  compute_dtype=compute_dtype)
+
+
+def resnet18(num_classes: int = 1000, axis_name=None,
+             compute_dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock,
+                  num_classes=num_classes, axis_name=axis_name,
+                  compute_dtype=compute_dtype)
+
+
+def resnet_tiny(num_classes: int = 10, axis_name=None,
+                compute_dtype=jnp.float32) -> ResNet:
+    """CI-sized: one block per stage, width 8 (CPU-mesh tests)."""
+    return ResNet(stage_sizes=(1, 1), block=BasicBlock, width=8,
+                  num_classes=num_classes, axis_name=axis_name,
+                  compute_dtype=compute_dtype)
+
+
+class VGG(nn.Module):
+    """VGG-16 (configuration D), NHWC, bf16 compute.  The reference's
+    bandwidth-bound benchmark model (docs/performance.md:9 — VGG's 138M
+    dense parameters made it BytePS's best case)."""
+
+    cfg: Sequence = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                     512, 512, 512, "M", 512, 512, 512, "M")
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.compute_dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME",
+                            dtype=self.compute_dtype,
+                            param_dtype=jnp.float32)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for feats in (4096, 4096):
+            x = nn.Dense(feats, dtype=self.compute_dtype,
+                         param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+            if train:
+                x = nn.Dropout(0.5, deterministic=True)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def vgg16(num_classes: int = 1000,
+          compute_dtype=jnp.bfloat16) -> VGG:
+    return VGG(num_classes=num_classes, compute_dtype=compute_dtype)
+
+
+def softmax_cross_entropy(logits, labels) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None],
+                                         axis=1).squeeze(1))
+
+
+def synthetic_images(rng, batch: int, size: int = 224,
+                     num_classes: int = 1000):
+    """Synthetic NHWC image batch (the reference benchmarks on synthetic
+    data too, example/pytorch/benchmark_byteps.py)."""
+    krng, lrng = jax.random.split(rng)
+    return {
+        "images": jax.random.normal(krng, (batch, size, size, 3),
+                                    jnp.float32),
+        "labels": jax.random.randint(lrng, (batch,), 0, num_classes),
+    }

@@ -10,6 +10,7 @@ all-to-all) that the reference lacks but long-context TPU training needs.
 from .data_parallel import (  # noqa: F401
     dp_specs,
     make_dp_train_step,
+    make_dp_train_step_with_state,
     replicate,
     shard_batch,
 )

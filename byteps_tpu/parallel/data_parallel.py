@@ -79,3 +79,40 @@ def make_dp_train_step(comm: CommContext,
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+def make_dp_train_step_with_state(comm: CommContext,
+                                  loss_fn: Callable,
+                                  tx: optax.GradientTransformation,
+                                  donate: bool = True) -> Callable:
+    """DP train step for models with mutable collections (BatchNorm
+    running stats): ``(params, model_state, opt_state, batch) ->
+    (params, model_state, opt_state, loss)``.
+
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``
+    runs per shard; cross-replica BatchNorm (models/resnet.py
+    ``axis_name=comm.dp_axes``) already reduces batch statistics over the
+    mesh inside the model, so ``new_model_state`` is replica-identical
+    and stays spec-replicated without an extra collective.  The reference
+    has no equivalent — it delegates BN sync entirely to the frameworks
+    (its DistributedOptimizer only sees gradients); here global-batch BN
+    is native to the step.
+    """
+    axes = comm.dp_axes
+
+    def step(params, model_state, opt_state, batch):
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, model_state, batch)
+        grads = push_pull_tree(grads, axes, op="average")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = lax.pmean(loss, axes)
+        return params, new_state, opt_state, loss
+
+    mapped = jax.shard_map(
+        step, mesh=comm.mesh,
+        in_specs=(P(), P(), P(), P(axes)),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())

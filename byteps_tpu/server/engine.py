@@ -303,12 +303,23 @@ class ServerEngine:
         codec = self._codec(key)
         merged, version = self._pull_versioned(key, timeout=timeout)
         with codec.lock:
-            if codec.cached_version != version:
+            if codec.cached_version == version:
+                return codec.cached_wire
+            if version > codec.cached_version:
+                # newest round: advance the codec state exactly once
                 payload, codec.state = codec.comp.compress(
                     jnp.asarray(merged.reshape(-1)), codec.state)
                 codec.cached_wire = codec.comp.wire_encode(payload)
                 codec.cached_version = version
-            return codec.cached_wire
+                return codec.cached_wire
+            # A puller that slept through newer rounds: compress its
+            # round's data WITHOUT touching state or cache — advancing a
+            # stateful codec (EF) out of order would corrupt the error
+            # accumulator, and regressing cached_version would hand later
+            # pullers stale bytes.
+            payload, _ = codec.comp.compress(
+                jnp.asarray(merged.reshape(-1)), codec.state)
+            return codec.comp.wire_encode(payload)
 
     def version(self, key: str) -> int:
         return self._state(key).version

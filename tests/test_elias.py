@@ -190,3 +190,15 @@ def test_decorated_compressor_wire_matches_server_codec():
     # and it IS the tight elias frame, not the generic npz fallback
     assert not wire.startswith(b"PK")  # zip magic
     assert len(wire) < 2048 / 4
+
+
+def test_forged_numel_header_rejected_before_allocation():
+    """A 16-byte frame claiming numel=2^32-1 must be rejected by the
+    expected-numel check, not allocate 4 GiB."""
+    header = np.array([0, 0xFFFFFFFF, 0], np.uint32).tobytes()
+    with pytest.raises(ValueError, match="numel"):
+        elias.decode_wire(header + b"\x00" * 4, expected_numel=1000)
+    comp = create_compressor({"compressor": "dithering",
+                              "partition_num": "16"}, 1000)
+    with pytest.raises(ValueError, match="numel"):
+        comp.wire_decode(header + b"\x00" * 4)

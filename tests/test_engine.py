@@ -118,3 +118,22 @@ def test_pushpull_speed_moves(bps_session):
         bps.push_pull(x, "spd", op="sum")
     ts, mbps = bps.get_pushpull_speed()
     assert mbps > 0
+
+
+def test_f16_average_scales_before_downcast(bps_session):
+    """The fused-scale path must divide inside the f32 accumulation: an
+    8-rank sum of 10000.0 (80000 > f16 max 65504) would overflow if the
+    downcast happened before the division."""
+    x = jnp.full((8, 16), 10000.0, jnp.float16)
+    out = bps.push_pull(x, "f16avg", op="average")
+    assert out.dtype == jnp.float16
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32),
+                               10000.0, rtol=1e-3)
+
+
+def test_scaled_path_matches_unscaled_math(bps_session):
+    rng = np.random.RandomState(17)
+    x = rng.randn(8, 3000).astype(np.float32)
+    out = bps.push_pull(jnp.asarray(x), "sc1", op="average")
+    np.testing.assert_allclose(np.asarray(out), x.mean(0),
+                               rtol=1e-5, atol=1e-6)

@@ -20,6 +20,8 @@ def _run(path, *argv):
 @pytest.mark.parametrize("path,argv", [
     ("example/jax/train_mnist_mlp.py", ("--steps", "2", "--batch", "2")),
     ("example/jax/benchmark_bert.py", ("--steps", "1", "--batch", "1")),
+    ("example/jax/benchmark_resnet.py",
+     ("--model", "tiny", "--batch", "1", "--size", "16", "--steps", "1")),
     ("example/jax/train_long_context.py",
      ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
       "--batch", "4")),
