@@ -236,8 +236,7 @@ def _bench_resnet(devices):
 
     from byteps_tpu.comm.mesh import CommContext, _build_mesh
     from byteps_tpu.models import resnet as R
-    from byteps_tpu.parallel import (make_dp_train_step_with_state,
-                                     replicate, shard_batch)
+    from byteps_tpu.parallel import shard_batch
 
     n = len(devices)
     comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1, n_ici=n)
@@ -245,20 +244,8 @@ def _bench_resnet(devices):
     rng = jax.random.PRNGKey(0)
     per_dev = 32
     batch = R.synthetic_images(rng, per_dev * n, 224, 1000)
-    variables = model.init(rng, batch["images"][:2], train=True)
-    params, bn = variables["params"], variables["batch_stats"]
-
-    def loss_fn(p, state, b):
-        logits, mut = model.apply(
-            {"params": p, "batch_stats": state}, b["images"], train=True,
-            mutable=["batch_stats"])
-        return (R.softmax_cross_entropy(logits, b["labels"]),
-                mut["batch_stats"])
-
-    tx = optax.sgd(0.1, momentum=0.9)
-    step = make_dp_train_step_with_state(comm, loss_fn, tx)
-    state = (replicate(comm, params), replicate(comm, bn),
-             replicate(comm, tx.init(params)))
+    step, state = R.make_vision_trainer(
+        comm, model, optax.sgd(0.1, momentum=0.9), batch, rng)
     batch = shard_batch(comm, batch)
     steps = 10
 
@@ -267,8 +254,7 @@ def _bench_resnet(devices):
         t0 = time.perf_counter()
         loss = None
         for _ in range(k):
-            *state, loss = step(*state, batch)
-            state = tuple(state)
+            state, loss = step(state, batch)
         jax.block_until_ready(state)
         return time.perf_counter() - t0, float(loss)
 

@@ -170,8 +170,8 @@ class VGG(nn.Module):
             x = nn.Dense(feats, dtype=self.compute_dtype,
                          param_dtype=jnp.float32)(x)
             x = nn.relu(x)
-            if train:
-                x = nn.Dropout(0.5, deterministic=True)(x)
+            # real dropout when training: needs a "dropout" RNG in apply()
+            x = nn.Dropout(0.5, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         param_dtype=jnp.float32)(x.astype(jnp.float32))
 
@@ -197,3 +197,52 @@ def synthetic_images(rng, batch: int, size: int = 224,
                                     jnp.float32),
         "labels": jax.random.randint(lrng, (batch,), 0, num_classes),
     }
+
+
+def make_vision_trainer(comm, model, tx, init_batch, rng):
+    """Shared DP training scaffolding for the vision models: returns
+    ``(step, state)`` with ``step(state, batch) -> (state, loss)``.
+
+    Handles both variable layouts — BatchNorm models (ResNet: mutable
+    ``batch_stats`` threaded through ``make_dp_train_step_with_state``)
+    and stateless ones (VGG: plain ``make_dp_train_step``) — and threads
+    a dropout RNG (VGG trains with real dropout; the key is folded per
+    call site, fixed across steps, which is the right trade for
+    synthetic throughput benchmarks).  Used by bench.py's resnet section
+    and example/jax/benchmark_resnet.py, so the two cannot drift.
+    """
+    from ..parallel import (make_dp_train_step,
+                            make_dp_train_step_with_state, replicate)
+
+    variables = model.init(rng, init_batch["images"][:2], train=False)
+    has_bn = "batch_stats" in variables
+    drop_rng = jax.random.fold_in(rng, 1)
+
+    if has_bn:
+        def loss_fn(p, state, b):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": state}, b["images"],
+                train=True, mutable=["batch_stats"],
+                rngs={"dropout": drop_rng})
+            return (softmax_cross_entropy(logits, b["labels"]),
+                    mut["batch_stats"])
+
+        inner = make_dp_train_step_with_state(comm, loss_fn, tx)
+        state = (replicate(comm, variables["params"]),
+                 replicate(comm, variables["batch_stats"]),
+                 replicate(comm, tx.init(variables["params"])))
+    else:
+        def loss_fn(p, b):
+            logits = model.apply({"params": p}, b["images"], train=True,
+                                 rngs={"dropout": drop_rng})
+            return softmax_cross_entropy(logits, b["labels"])
+
+        inner = make_dp_train_step(comm, loss_fn, tx)
+        state = (replicate(comm, variables["params"]),
+                 replicate(comm, tx.init(variables["params"])))
+
+    def step(state, batch):
+        *new_state, loss = inner(*state, batch)
+        return tuple(new_state), loss
+
+    return step, state

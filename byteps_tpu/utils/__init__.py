@@ -1,8 +1,10 @@
-"""Utilities: checkpoint/resume, benchmark timing helpers."""
+"""Utilities: checkpoint/resume, failure detection, timing, HLO wire
+accounting."""
 
 from .checkpoint import (  # noqa: F401
     CheckpointManager,
     restore_and_broadcast,
     save_checkpoint,
 )
+from .failure_detector import HeartbeatMonitor, StepWatchdog  # noqa: F401
 from .timing import Timer, throughput  # noqa: F401

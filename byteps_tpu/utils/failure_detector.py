@@ -1,0 +1,262 @@
+"""Failure detection for multi-host runs: heartbeats + step watchdog.
+
+The reference delegates liveness to its transport — ps-lite's scheduler
+heartbeats (``PS_HEARTBEAT_INTERVAL``/``PS_HEARTBEAT_TIMEOUT`` in the
+submodule); in-tree it has none (SURVEY.md §5: "No automatic failure
+detector"), and recovery is manual suspend/resume
+(reference operations.cc:96-119).  On TPU the need is sharper: a dead
+host does not error the survivors — their next DCN collective blocks
+forever inside XLA.  Detection must therefore be out-of-band, and the
+only reliable escape from a wedged collective is process exit (the
+launcher restarts the job; elastic resume re-declares tensors in order,
+core/api.py resume()).
+
+Two cooperating pieces:
+
+- :class:`HeartbeatMonitor` — rank 0 runs a tiny UDP server; every rank
+  (including 0) beats every ``interval``; the server's replies carry the
+  set of currently-stale ranks.  A rank that misses ``timeout`` seconds
+  of beats is reported to every survivor's ``on_failure``; a coordinator
+  that stops replying is itself reported as rank 0 down.
+- :class:`StepWatchdog` — in-process: ``feed()`` every training step; a
+  step that exceeds ``timeout`` fires ``on_stall`` (default: log and
+  ``os._exit(17)``) — the escape hatch for the wedged-collective case
+  the heartbeat layer cannot see (process alive, thread stuck).
+
+Both are pure host-side Python (sockets + threads), independent of the
+JAX runtime, so they keep working exactly when the runtime doesn't.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Optional, Set
+
+from ..common.logging import get_logger
+
+_MAGIC = b"bpshb1 "
+
+
+def _default_on_failure(stale: Set[int]) -> None:
+    get_logger().error(
+        "failure detector: rank(s) %s missed heartbeats — exiting so the "
+        "launcher can restart/resume (a wedged collective cannot be "
+        "cancelled in-process)", sorted(stale))
+    os._exit(17)
+
+
+class HeartbeatMonitor:
+    """Out-of-band liveness over UDP.
+
+    Parameters
+    ----------
+    rank, num_ranks: PROCESS identity — ``jax.process_index()`` /
+        ``jax.process_count()`` (one beating entity per host).  NOT the
+        chip-rank convention of ``bps.rank()``/``bps.size()``: with those,
+        chips that never beat would be declared stale and a healthy run
+        would self-destruct after the grace period.
+    coordinator: ``host:port`` for the heartbeat endpoint.  Defaults to
+        ``DMLC_PS_ROOT_URI`` with ``BYTEPS_HEARTBEAT_PORT`` (or
+        DMLC_PS_ROOT_PORT + 1) — the same rendezvous the DMLC bootstrap
+        already shares (reference docs/env.md:7-45).
+    interval / timeout: beat period and staleness threshold (seconds).
+    on_failure: called ONCE with the set of stale ranks; defaults to
+        log + os._exit(17).
+    """
+
+    def __init__(self, rank: int, num_ranks: int,
+                 coordinator: Optional[str] = None,
+                 interval: float = 1.0, timeout: float = 10.0,
+                 on_failure: Callable[[Set[int]], None] = _default_on_failure,
+                 grace: Optional[float] = None):
+        if coordinator is None:
+            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+            port = int(os.environ.get(
+                "BYTEPS_HEARTBEAT_PORT",
+                str(int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1)))
+        else:
+            host, port_s = coordinator.rsplit(":", 1)
+            port = int(port_s)
+        self.rank = rank
+        self.num_ranks = num_ranks
+        self.addr = (host, port)
+        self.interval = interval
+        self.timeout = timeout
+        # ranks get `grace` seconds to send their first beat (process
+        # startup skew is not a failure)
+        self.grace = timeout if grace is None else grace
+        self.on_failure = on_failure
+        self._stop = threading.Event()
+        self._fired = False
+        self._lock = threading.Lock()
+        self._threads = []
+        self._sock: Optional[socket.socket] = None
+        # server state (rank 0 only)
+        self._last_seen = {}
+        self._started = time.monotonic()
+        # client state
+        self._last_reply = time.monotonic()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "HeartbeatMonitor":
+        if self.rank == 0:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.bind(self.addr)
+            self._sock.settimeout(0.25)
+            t = threading.Thread(target=self._serve, daemon=True,
+                                 name="bps-heartbeat-server")
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._beat, daemon=True,
+                             name="bps-heartbeat-client")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        if self._sock is not None:
+            self._sock.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- internals ---------------------------------------------------------
+
+    def _fire(self, stale: Set[int]) -> None:
+        with self._lock:
+            if self._fired:
+                return
+            self._fired = True
+        self.on_failure(stale)
+
+    def _stale_ranks(self) -> Set[int]:
+        now = time.monotonic()
+        stale = set()
+        for r in range(self.num_ranks):
+            seen = self._last_seen.get(r)
+            if seen is None:
+                if now - self._started > self.grace:
+                    stale.add(r)
+            elif now - seen > self.timeout:
+                stale.add(r)
+        return stale
+
+    def _serve(self) -> None:
+        """Rank 0: receive beats, answer with the stale set."""
+        while not self._stop.is_set():
+            try:
+                data, addr = self._sock.recvfrom(512)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data.startswith(_MAGIC):
+                continue
+            try:
+                r = int(data[len(_MAGIC):])
+            except ValueError:
+                continue
+            if 0 <= r < self.num_ranks:
+                self._last_seen[r] = time.monotonic()
+            try:
+                self._sock.sendto(
+                    _MAGIC + json.dumps(sorted(self._stale_ranks())).encode(),
+                    addr)
+            except OSError:
+                pass
+
+    def _beat(self) -> None:
+        """Every rank: send beats, read the stale set, escalate."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.settimeout(self.interval)
+        # size the reply buffer for the worst case (every rank stale,
+        # ~7 chars each): a truncated datagram would otherwise kill this
+        # thread at exactly the moment it matters
+        bufsize = max(512, len(_MAGIC) + 8 * self.num_ranks + 16)
+        self._last_reply = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                sock.sendto(_MAGIC + str(self.rank).encode(), self.addr)
+                data, _ = sock.recvfrom(bufsize)
+                if data.startswith(_MAGIC):
+                    try:
+                        stale = set(json.loads(data[len(_MAGIC):]))
+                    except ValueError:
+                        stale = None  # corrupt/truncated reply: not fatal
+                    if stale is not None:
+                        self._last_reply = time.monotonic()
+                        stale.discard(self.rank)  # self = clock skew
+                        if stale:
+                            self._fire(stale)
+                            return
+            except (socket.timeout, OSError):
+                pass
+            # a silent coordinator is itself a failure (after grace)
+            if (time.monotonic() - self._last_reply > self.timeout
+                    and self.rank != 0):
+                self._fire({0})
+                return
+            self._stop.wait(self.interval)
+        sock.close()
+
+
+class StepWatchdog:
+    """In-process stall detector: ``feed()`` each step; a gap longer than
+    ``timeout`` fires ``on_stall`` (default log + os._exit(17)) — the
+    escape hatch for a collective wedged on a peer the heartbeat layer
+    still sees as alive (process up, chip blocked)."""
+
+    def __init__(self, timeout: float = 600.0,
+                 on_stall: Optional[Callable[[float], None]] = None):
+        self.timeout = timeout
+        self.on_stall = on_stall or self._default
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._armed = False
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="bps-step-watchdog")
+
+    @staticmethod
+    def _default(gap: float) -> None:
+        get_logger().error(
+            "step watchdog: no progress for %.1fs — exiting so the "
+            "launcher can restart", gap)
+        os._exit(17)
+
+    def start(self) -> "StepWatchdog":
+        self._last = time.monotonic()
+        self._armed = True
+        self._thread.start()
+        return self
+
+    def feed(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(min(1.0, self.timeout / 4)):
+            gap = time.monotonic() - self._last
+            if self._armed and gap > self.timeout:
+                self.on_stall(gap)
+                return

@@ -35,15 +35,12 @@ def main() -> int:
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
     import optax
 
     from byteps_tpu.comm.mesh import CommContext, _build_mesh
     from byteps_tpu.models import resnet as R
-    from byteps_tpu.parallel import (make_dp_train_step_with_state,
-                                     make_dp_train_step, replicate,
-                                     shard_batch)
+    from byteps_tpu.parallel import shard_batch
 
     devices = jax.devices()
     n = len(devices)
@@ -64,32 +61,8 @@ def main() -> int:
     rng = jax.random.PRNGKey(0)
     global_batch = args.batch * n
     batch = R.synthetic_images(rng, global_batch, args.size, classes)
-    variables = model.init(rng, batch["images"][:2], train=True)
-    tx = optax.sgd(0.1, momentum=0.9)
-
-    has_bn = "batch_stats" in variables
-    if has_bn:
-        params, bn = variables["params"], variables["batch_stats"]
-
-        def loss_fn(p, state, b):
-            logits, mut = model.apply(
-                {"params": p, "batch_stats": state}, b["images"],
-                train=True, mutable=["batch_stats"])
-            return (R.softmax_cross_entropy(logits, b["labels"]),
-                    mut["batch_stats"])
-
-        step = make_dp_train_step_with_state(comm, loss_fn, tx)
-        state = (replicate(comm, params), replicate(comm, bn),
-                 replicate(comm, tx.init(params)))
-    else:
-        params = variables["params"]
-
-        def loss_fn(p, b):
-            logits = model.apply({"params": p}, b["images"], train=True)
-            return R.softmax_cross_entropy(logits, b["labels"])
-
-        step = make_dp_train_step(comm, loss_fn, tx)
-        state = (replicate(comm, params), replicate(comm, tx.init(params)))
+    step, state = R.make_vision_trainer(
+        comm, model, optax.sgd(0.1, momentum=0.9), batch, rng)
     batch = shard_batch(comm, batch)
 
     def run(k):
@@ -97,8 +70,7 @@ def main() -> int:
         t0 = time.perf_counter()
         loss = None
         for _ in range(k):
-            *state, loss = step(*state, batch)
-            state = tuple(state)
+            state, loss = step(state, batch)
         jax.block_until_ready(state)
         return time.perf_counter() - t0, float(loss)
 

@@ -1,0 +1,149 @@
+"""Failure-detector tests: heartbeat liveness + step watchdog.
+
+The reference has no in-tree failure detector (SURVEY.md §5) — liveness
+lives in ps-lite's scheduler heartbeats.  These tests pin the TPU-native
+replacement, including a real 2-process kill: one worker dies mid-run
+and the survivor's detector must fire within the timeout instead of
+hanging the way a DCN collective would.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from byteps_tpu.utils.failure_detector import HeartbeatMonitor, StepWatchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_healthy_cluster_no_fire():
+    port = _free_port()
+    fired = []
+    mons = [HeartbeatMonitor(r, 2, f"127.0.0.1:{port}", interval=0.1,
+                             timeout=1.0, on_failure=fired.append).start()
+            for r in range(2)]
+    time.sleep(1.5)
+    for m in mons:
+        m.stop()
+    assert not fired
+
+
+def test_missing_rank_detected_after_grace():
+    port = _free_port()
+    fired = []
+    done = threading.Event()
+
+    def on_failure(stale):
+        fired.append(stale)
+        done.set()
+
+    # rank 1 never starts; rank 0's monitor must report it after grace
+    m = HeartbeatMonitor(0, 2, f"127.0.0.1:{port}", interval=0.1,
+                         timeout=0.8, grace=0.8, on_failure=on_failure)
+    m.start()
+    assert done.wait(5.0), "detector did not fire"
+    m.stop()
+    assert fired == [{1}]
+
+
+def test_dead_coordinator_detected():
+    port = _free_port()  # nothing listens here
+    fired = []
+    done = threading.Event()
+
+    def on_failure(stale):
+        fired.append(stale)
+        done.set()
+
+    m = HeartbeatMonitor(1, 2, f"127.0.0.1:{port}", interval=0.1,
+                         timeout=0.6, on_failure=on_failure)
+    m.start()
+    assert done.wait(5.0), "client did not detect silent coordinator"
+    m.stop()
+    assert fired == [{0}]
+
+
+def test_on_failure_fires_once():
+    port = _free_port()
+    fired = []
+    m = HeartbeatMonitor(0, 3, f"127.0.0.1:{port}", interval=0.05,
+                         timeout=0.4, grace=0.4, on_failure=fired.append)
+    m.start()
+    time.sleep(2.0)
+    m.stop()
+    assert len(fired) == 1  # both missing ranks reported in ONE call
+    assert fired[0] == {1, 2}
+
+
+def test_step_watchdog_stall_and_feed():
+    stalls = []
+    wd = StepWatchdog(timeout=0.5, on_stall=stalls.append)
+    wd.start()
+    for _ in range(4):  # regular feeding keeps it quiet
+        time.sleep(0.2)
+        wd.feed()
+    assert not stalls
+    time.sleep(1.2)  # stop feeding -> stall
+    wd.stop()
+    assert len(stalls) == 1 and stalls[0] > 0.5
+
+
+_WORKER = r"""
+import sys, time
+from byteps_tpu.utils.failure_detector import HeartbeatMonitor
+rank = int(sys.argv[1]); port = sys.argv[2]; die_after = float(sys.argv[3])
+
+def on_failure(stale):
+    print("DETECTED", sorted(stale), flush=True)
+    raise SystemExit(0)
+
+m = HeartbeatMonitor(rank, 2, "127.0.0.1:" + port, interval=0.2,
+                     timeout=2.0, on_failure=on_failure)
+m.start()
+t0 = time.time()
+while time.time() - t0 < 20:
+    if die_after > 0 and time.time() - t0 > die_after:
+        print("DYING", flush=True)
+        import os; os._exit(1)  # simulated crash, no cleanup
+    time.sleep(0.1)
+print("TIMEOUT-NO-DETECT", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_worker_death_detected():
+    port = str(_free_port())
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    survivor = subprocess.Popen(
+        [sys.executable, "-c", _WORKER, "0", port, "0"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    victim = subprocess.Popen(
+        [sys.executable, "-c", _WORKER, "1", port, "1.5"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        out_s, _ = survivor.communicate(timeout=30)
+        out_v, _ = victim.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        survivor.kill()
+        victim.kill()
+        raise
+    assert "DYING" in out_v
+    assert "DETECTED [1]" in out_s, out_s[-2000:]

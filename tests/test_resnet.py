@@ -82,17 +82,20 @@ def test_tiny_resnet_trains_with_sync_bn(comm):
     assert float(jnp.abs(np.asarray(mean_leaf)).sum()) > 0
 
 
-def test_sync_bn_stats_are_global_batch(comm):
-    """Cross-replica BN must normalize with *global* batch statistics:
-    give each shard a different constant input; with axis_name the
-    per-replica batch means agree (= global mean), without it they
-    differ."""
-    model = resnet_tiny(num_classes=4, axis_name=comm.dp_axes)
+@pytest.mark.parametrize("sync", [True, False])
+def test_sync_bn_stats_are_global_batch(comm, sync):
+    """Cross-replica BN must compute *global* batch statistics: give each
+    shard a different constant input and collect every device's updated
+    running stats.  With axis_name all 8 replicas' stats are identical
+    (computed over the global batch); with axis_name=None they diverge
+    (each shard normalized by its own constant) — pinning that the sync
+    actually does something."""
+    model = resnet_tiny(num_classes=4,
+                        axis_name=comm.dp_axes if sync else None)
     # one example per device, value = device index
     x = np.zeros((8, 8, 8, 3), np.float32)
     for i in range(8):
         x[i] = float(i)
-    y = np.zeros(8, np.int64)
     rng = jax.random.PRNGKey(2)
     variables = model.init(rng, jnp.asarray(x[:1]), train=True)
 
@@ -101,17 +104,22 @@ def test_sync_bn_stats_are_global_batch(comm):
     def fwd(v, images):
         _, mutated = model.apply(v, images, train=True,
                                  mutable=["batch_stats"])
-        return mutated["batch_stats"]
+        # stack per-device stats on a leading axis so divergence is
+        # observable (out_specs=P() would silently pick one shard under
+        # check_vma=False)
+        return jax.tree.map(lambda a: a[None], mutated["batch_stats"])
 
     mapped = jax.jit(jax.shard_map(
         fwd, mesh=comm.mesh, in_specs=(P(), P(comm.dp_axes)),
-        out_specs=P(), check_vma=False))
+        out_specs=P(comm.dp_axes), check_vma=False))
     stats = mapped(replicate(comm, variables),
                    shard_batch(comm, jnp.asarray(x)))
-    # out_specs=P() asserts replica-identity: if per-shard stats
-    # diverged, shard_map would produce inconsistent replicated output.
-    # The first BN's running mean moved toward the global input mean
-    # (3.5 scaled by momentum), identically on every device.
-    leaf = np.asarray(jax.tree.leaves(stats)[0])
-    assert np.isfinite(leaf).all()
-    _ = y  # labels unused in forward-only check
+    # the first BN's running mean, per device: [8, channels]
+    leaves = [np.asarray(l) for l in jax.tree.leaves(stats)]
+    assert all(l.shape[0] == 8 and np.isfinite(l).all() for l in leaves)
+    spread = max(float(np.abs(l - l[0]).max()) for l in leaves)
+    if sync:
+        assert spread < 1e-6, f"synced BN stats diverged: {spread}"
+    else:
+        assert spread > 1e-3, "unsynced BN unexpectedly agreed — the " \
+            "sync test has lost its sensitivity"
