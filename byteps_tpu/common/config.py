@@ -56,6 +56,16 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {v!r}")
+
+
 # Page size used for alignment of partition bounds; the reference aligns
 # partition bounds to its Align() rule (common.h:281-285).  On TPU we align to
 # 512 lanes * 4 bytes so chunk boundaries respect (8,128) tiling of f32.
@@ -95,6 +105,11 @@ class Config:
     server_debug_key: str = ""       # BYTEPS_SERVER_DEBUG_KEY
     key_hash_fn: str = "djb2"        # BYTEPS_KEY_HASH_FN
     debug_sample_tensor: str = ""    # BYTEPS_DEBUG_SAMPLE_TENSOR substring
+
+    # --- failure detection (utils/failure_detector.py) ---
+    heartbeat_on: bool = False       # BYTEPS_HEARTBEAT_ON: auto-arm at init
+    heartbeat_interval_s: float = 1.0   # BYTEPS_HEARTBEAT_INTERVAL
+    heartbeat_timeout_s: float = 30.0   # BYTEPS_HEARTBEAT_TIMEOUT
 
     # --- observability ---
     log_level: str = "WARNING"       # BYTEPS_LOG_LEVEL
@@ -139,6 +154,11 @@ class Config:
             server_debug_key=_env_str("BYTEPS_SERVER_DEBUG_KEY", ""),
             key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            heartbeat_on=_env_bool("BYTEPS_HEARTBEAT_ON", False),
+            heartbeat_interval_s=_env_float("BYTEPS_HEARTBEAT_INTERVAL",
+                                            1.0),
+            heartbeat_timeout_s=_env_float("BYTEPS_HEARTBEAT_TIMEOUT",
+                                           30.0),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON", False),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

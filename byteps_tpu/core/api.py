@@ -23,6 +23,7 @@ from ..common.logging import get_logger
 from .engine import PushPullEngine
 
 _engine: Optional[PushPullEngine] = None
+_heartbeat = None  # auto-armed HeartbeatMonitor (BYTEPS_HEARTBEAT_ON)
 _lock = threading.Lock()
 # Tensors declared before/with init, re-declared in order on resume
 # (reference global.cc:431-436 re-declares in original order on re-init).
@@ -37,7 +38,7 @@ def init(config: Optional[Config] = None,
     stage loops; here it builds the (dcn, ici) mesh and starts the
     dispatcher/syncer pair.
     """
-    global _engine
+    global _engine, _heartbeat
     with _lock:
         if _engine is not None:
             return
@@ -45,7 +46,27 @@ def init(config: Optional[Config] = None,
             set_config(config)
         cfg = get_config()
         comm = mesh_mod.bootstrap(cfg, devices=devices)
-        _engine = PushPullEngine(comm, cfg)
+        engine = PushPullEngine(comm, cfg)
+        if cfg.heartbeat_on and jax.process_count() > 1:
+            # auto-armed liveness: one beat per process; a dead host makes
+            # every survivor exit (restartable) instead of wedging in the
+            # next DCN collective (utils/failure_detector.py).  Armed
+            # BEFORE _engine is published: if the UDP bind fails (port in
+            # use), init() raises cleanly and a retry re-runs everything
+            # — never a running engine that silently believes liveness
+            # is on.
+            from ..utils.failure_detector import HeartbeatMonitor
+            try:
+                _heartbeat = HeartbeatMonitor(
+                    rank=jax.process_index(),
+                    num_ranks=jax.process_count(),
+                    interval=cfg.heartbeat_interval_s,
+                    timeout=cfg.heartbeat_timeout_s).start()
+            except Exception:
+                engine.shutdown(wait=False)
+                mesh_mod.shutdown_comm()
+                raise
+        _engine = engine
         for name in _declared_order:
             _engine.registry.declare(name)
         get_logger().info("byteps_tpu initialized: %d ranks", comm.num_ranks)
@@ -57,10 +78,13 @@ def initialized() -> bool:
 
 def shutdown(wait: bool = True) -> None:
     """Tear down engine + mesh (reference byteps_shutdown)."""
-    global _engine
+    global _engine, _heartbeat
     with _lock:
         if _engine is None:
             return
+        if _heartbeat is not None:
+            _heartbeat.stop()
+            _heartbeat = None
         _engine.shutdown(wait=wait)
         _engine = None
         mesh_mod.shutdown_comm()

@@ -27,3 +27,10 @@ from .long_context import (  # noqa: F401
     shard_lm_batch,
     synthetic_lm_batch,
 )
+from .tensor_parallel import (  # noqa: F401
+    init_tp_opt_state,
+    make_dp_tp_train_step,
+    make_tp_mesh,
+    shard_gpt_params,
+    shard_tp_batch,
+)

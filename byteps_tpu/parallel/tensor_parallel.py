@@ -1,0 +1,131 @@
+"""Tensor parallelism for the GPT family over a (dp, tp) mesh.
+
+The reference implements data parallelism only (SURVEY.md §2.6); TP is a
+scale axis the TPU rebuild adds because hidden sizes outgrow one chip's
+HBM long before batch does.  Design is GSPMD-native rather than a
+hand-written collective pipeline: parameters carry Megatron-style
+shardings (column-parallel qkv/mlp-in, row-parallel attn-out/mlp-out,
+vocab-sharded embedding and lm head), inputs are batch-sharded over dp,
+and XLA's sharding propagation inserts the all-reduces where the math
+needs them — the "pick a mesh, annotate, let the compiler place
+collectives" recipe, in deliberate contrast to the explicit shard_map
+paths (data_parallel.py, long_context.py) which pin the collective
+schedule by hand where that control is the point.
+
+Axis layout: ``(dp, tp)``.  tp should map to the fastest ICI dimension —
+TP's all-reduces are per-layer and latency-bound; dp's gradient
+reduction is once per step.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gpt import GPT, GPTConfig, lm_loss
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+def make_tp_mesh(devices, n_tp: int) -> Mesh:
+    devs = np.asarray(devices)
+    if devs.size % n_tp:
+        raise ValueError(f"{devs.size} devices not divisible by tp={n_tp}")
+    return Mesh(devs.reshape(devs.size // n_tp, n_tp),
+                axis_names=(DP_AXIS, TP_AXIS))
+
+
+# Megatron-style rules, matched against the flax param path
+# ("h3/attn/qkv/kernel").  First match wins; unmatched -> replicated.
+_TP_RULES = [
+    # attention: shard heads (qkv column-parallel, out row-parallel)
+    (r"attn/qkv/kernel$", P(None, None, TP_AXIS, None)),
+    (r"attn/qkv/bias$", P(None, TP_AXIS, None)),
+    (r"attn/out/kernel$", P(TP_AXIS, None, None)),
+    # mlp: column-parallel in, row-parallel out
+    (r"mlp_in/kernel$", P(None, TP_AXIS)),
+    (r"mlp_in/bias$", P(TP_AXIS)),
+    (r"mlp_out/kernel$", P(TP_AXIS, None)),
+    # embeddings / unembedding: shard the vocab (wte) and hidden-free
+    # axis of the head; wpe stays replicated (tiny)
+    (r"wte/embedding$", P(TP_AXIS, None)),
+    (r"lm_head/kernel$", P(None, TP_AXIS)),
+    (r"lm_head/bias$", P(TP_AXIS)),
+]
+
+
+def tp_spec_for(path: str) -> P:
+    for pat, spec in _TP_RULES:
+        if re.search(pat, path):
+            return spec
+    return P()
+
+
+def _path_str(key_path) -> str:
+    """'h0/attn/qkv/kernel' from a tree_map_with_path key path; handles
+    every jax key kind (DictKey.key, SequenceKey.idx, GetAttrKey.name)."""
+    parts = []
+    for k in key_path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(k, attr):
+                parts.append(str(getattr(k, attr)))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def gpt_tp_shardings(mesh: Mesh, params):
+    """PartitionSpec tree for a GPT param pytree (rule-matched by path)."""
+    def spec(key_path, leaf):
+        return NamedSharding(mesh, tp_spec_for(_path_str(key_path)))
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_gpt_params(mesh: Mesh, params):
+    """Place params with their TP shardings (host or device input)."""
+    return jax.device_put(params, gpt_tp_shardings(mesh, params))
+
+
+def shard_tp_batch(mesh: Mesh, batch):
+    """Batch over dp, sequence replicated over tp."""
+    return jax.device_put(batch, NamedSharding(mesh, P(DP_AXIS, None)))
+
+
+def make_dp_tp_train_step(mesh: Mesh, cfg: GPTConfig,
+                          tx: optax.GradientTransformation,
+                          donate: bool = True) -> Callable:
+    """Jitted (params, opt_state, batch) -> (params, opt_state, loss).
+
+    Params must be placed by :func:`shard_gpt_params` and the batch by
+    :func:`shard_tp_batch`; opt_state from ``init_tp_opt_state`` (or any
+    tx.init over the sharded params — state leaves inherit the param
+    shardings).  Gradient dp-reduction and every TP collective are
+    inserted by XLA from the shardings; there is no hand-placed psum.
+    """
+    model = GPT(cfg)
+
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch["input_ids"])
+            return lm_loss(logits, batch["labels"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def init_tp_opt_state(tx: optax.GradientTransformation, sharded_params):
+    """tx.init under jit so moment buffers inherit the param shardings
+    instead of materializing replicated on one device."""
+    return jax.jit(tx.init)(sharded_params)

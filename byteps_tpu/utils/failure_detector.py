@@ -202,9 +202,13 @@ class HeartbeatMonitor:
                             return
             except (socket.timeout, OSError):
                 pass
-            # a silent coordinator is itself a failure (after grace)
-            if (time.monotonic() - self._last_reply > self.timeout
-                    and self.rank != 0):
+            # a silent coordinator is itself a failure — but only after
+            # the grace window, so a coordinator that starts later than
+            # this rank (the skew grace exists for) is not a false alarm
+            now = time.monotonic()
+            if (self.rank != 0
+                    and now - self._last_reply > self.timeout
+                    and now - self._started > self.grace):
                 self._fire({0})
                 return
             self._stop.wait(self.interval)

@@ -29,6 +29,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def free_port() -> int:
+    """An OS-assigned free TCP port (shared by the multi-process and
+    failure-detector tests)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 @pytest.fixture(autouse=True)
 def _fresh_config():
     """Each test gets a config rebuilt from the current environment."""

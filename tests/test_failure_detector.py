@@ -10,7 +10,6 @@ hanging the way a DCN collective would.
 from __future__ import annotations
 
 import os
-import socket
 import subprocess
 import sys
 import threading
@@ -20,15 +19,9 @@ import pytest
 
 from byteps_tpu.utils.failure_detector import HeartbeatMonitor, StepWatchdog
 
+from .conftest import free_port as _free_port
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def test_healthy_cluster_no_fire():

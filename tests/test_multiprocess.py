@@ -12,21 +12,14 @@ non-addressable — the configuration single-process tests cannot reach.
 from __future__ import annotations
 
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
 
+from .conftest import free_port as _free_port
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 @pytest.mark.slow
@@ -47,6 +40,10 @@ def test_two_process_push_pull_matches_single_process():
             # the scheduler/dispatch path runs multi-chunk across processes
             "BYTEPS_PARTITION_BYTES": "65536",
             "BYTEPS_LOG_LEVEL": "WARNING",
+            # exercise the auto-armed liveness path (healthy run: the
+            # monitors must arm at init, stay quiet, stop at shutdown)
+            "BYTEPS_HEARTBEAT_ON": "1",
+            "BYTEPS_HEARTBEAT_TIMEOUT": "60",
         })
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", "mp_worker.py")],
