@@ -27,6 +27,14 @@ from .long_context import (  # noqa: F401
     shard_lm_batch,
     synthetic_lm_batch,
 )
+from .pipeline import (  # noqa: F401
+    init_pipeline_params,
+    make_dp_pp_train_step,
+    make_pp_mesh,
+    pipeline_params_to_gpt,
+    shard_pipeline_params,
+    shard_pp_batch,
+)
 from .tensor_parallel import (  # noqa: F401
     init_tp_opt_state,
     make_dp_tp_train_step,

@@ -69,14 +69,24 @@ def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
         def loss_fn(p):
             logits = model.apply(p, ids, positions=pos)
             s, c = token_nll(logits, labels)
-            # global normalization: psum is differentiable, so gradients
-            # automatically carry the global 1/count scaling
-            return lax.psum(s, axes) / jnp.maximum(lax.psum(c, axes), 1.0)
+            # Global normalization with the psum OUTSIDE the gradient
+            # path: under check_vma=False shard_map transposes a live
+            # psum conservatively (cotangents re-psum'd), which would
+            # inflate every gradient by the mesh size.  The count carries
+            # no gradient anyway (labels), so stop_gradient makes the
+            # differentiated objective purely local — its grad is the
+            # exact local partial of the global loss, and push_pull
+            # below completes it.  (Pinned by the training parity test.)
+            denom = jnp.maximum(
+                lax.psum(lax.stop_gradient(c), axes), 1.0)
+            return s / denom
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        # loss is already global; grads are this device's partial sums —
-        # the framework's push_pull over both mesh axes completes them
+        loss_local, grads = jax.value_and_grad(loss_fn)(params)
+        # grads are this device's partial sums — the framework's
+        # push_pull over both mesh axes completes them
         grads = push_pull_tree(grads, axes, op="sum")
+        # reporting value: global sum of the locally-normalized losses
+        loss = lax.psum(loss_local, axes)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -1,0 +1,28 @@
+"""Shared mesh construction for the composite-parallelism modules.
+
+One rule, one place: a 2-D (outer, inner) mesh where the *inner* axis is
+laid out over the fastest-varying device dimension — on TPU that is the
+dimension with neighboring ICI links, which is where every inner axis
+wants to live (sp's K/V ring, tp's per-layer all-reduces, pp's
+stage-to-stage ppermute are all latency-bound; dp's once-per-step
+gradient reduction is not).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_2d_mesh(devices: Optional[Sequence], n_inner: int,
+                 axis_names: Tuple[str, str]) -> Mesh:
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if n_inner <= 0 or devs.size % n_inner:
+        raise ValueError(
+            f"{devs.size} devices not divisible by "
+            f"{axis_names[1]}={n_inner}")
+    return Mesh(devs.reshape(devs.size // n_inner, n_inner),
+                axis_names=axis_names)

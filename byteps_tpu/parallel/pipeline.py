@@ -1,0 +1,251 @@
+"""Pipeline parallelism: GPipe schedule over a (dp, pp) mesh.
+
+The reference is DP-only (SURVEY.md §2.6); pipeline parallelism is the
+axis that scales *depth* past one chip's HBM.  TPU-native shape: the
+whole schedule — microbatch ticks, stage compute, activation transfer —
+is ONE jitted ``shard_map`` program.  Activations move between adjacent
+stages with ``lax.ppermute`` (neighbor ICI hops, the cheapest collective
+there is), the tick loop is a ``lax.scan`` (static trip count
+``M + S - 1``), and jax autodiff through scan+ppermute yields the
+reverse schedule for free — no hand-written backward pipeline.
+
+Layer placement: the transformer stack's parameters are stacked on a
+leading layer axis and sharded over ``pp`` (stage s holds layers
+``[s*L/S, (s+1)*L/S)``); embedding and head are replicated (small next
+to the stack) with embedding consumed at stage 0 and the loss computed
+at the last stage, psum'd out.  Pipeline bubbles (fill/drain ticks) are
+masked out of the loss, never out of the schedule — static shapes
+everywhere, as XLA wants.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gpt import Block, GPT, GPTConfig, token_nll
+from .mesh_util import make_2d_mesh
+
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+
+
+def make_pp_mesh(devices, n_pp: int) -> Mesh:
+    return make_2d_mesh(devices, n_pp, (DP_AXIS, PP_AXIS))
+
+
+def init_pipeline_params(cfg: GPTConfig, rng, sample_ids):
+    """Initialize a GPT and restack it for the pipeline: the per-layer
+    block params become one pytree with a leading layer axis [L, ...];
+    embedding (wte+wpe) and head (ln_f+lm_head) stay as-is.  Restacking
+    (rather than a separate pipeline init) keeps bit-identical parameters
+    between the pipelined and the plain model — the parity tests depend
+    on it."""
+    variables = GPT(cfg).init(rng, sample_ids)
+    p = variables["params"]
+    layers = [p[f"h{i}"] for i in range(cfg.num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return {
+        "embed": {"wte": p["wte"], "wpe": p["wpe"]},
+        "blocks": stacked,
+        "head": {"ln_f": p["ln_f"], "lm_head": p["lm_head"]},
+    }
+
+
+def pipeline_params_to_gpt(cfg: GPTConfig, pp_params):
+    """Inverse of :func:`init_pipeline_params` (checkpoint interop)."""
+    p = {"wte": pp_params["embed"]["wte"], "wpe": pp_params["embed"]["wpe"],
+         "ln_f": pp_params["head"]["ln_f"],
+         "lm_head": pp_params["head"]["lm_head"]}
+    for i in range(cfg.num_layers):
+        p[f"h{i}"] = jax.tree.map(lambda x: x[i], pp_params["blocks"])
+    return {"params": p}
+
+
+def pp_shardings(mesh: Mesh, pp_params):
+    """blocks sharded on the layer axis over pp; embed/head replicated."""
+    def spec(path, leaf):
+        top = path[0].key if hasattr(path[0], "key") else str(path[0])
+        if top == "blocks":
+            return NamedSharding(mesh, P(PP_AXIS))
+        return NamedSharding(mesh, P())
+    return jax.tree_util.tree_map_with_path(spec, pp_params)
+
+
+def shard_pipeline_params(mesh: Mesh, pp_params):
+    return jax.device_put(pp_params, pp_shardings(mesh, pp_params))
+
+
+def shard_pp_batch(mesh: Mesh, batch):
+    return jax.device_put(batch, NamedSharding(mesh, P(DP_AXIS, None)))
+
+
+def make_dp_pp_train_step(mesh: Mesh, cfg: GPTConfig,
+                          tx: optax.GradientTransformation,
+                          num_microbatches: int,
+                          donate: bool = True) -> Callable:
+    """Jitted (params, opt_state, batch) -> (params, opt_state, loss)
+    over (dp, pp): batch over dp, layers over pp, GPipe microbatching.
+
+    ``batch["input_ids"]/["labels"]`` are [B, T] with the per-dp-shard
+    B divisible by ``num_microbatches``.
+    """
+    block = Block(cfg)
+    embed_mod = _EmbedIn(cfg)
+    head_mod = _Head(cfg)
+    n_pp = mesh.shape[PP_AXIS]
+    if cfg.num_layers % n_pp:
+        raise ValueError(
+            f"{cfg.num_layers} layers not divisible by pp={n_pp}")
+
+    def run_stage(stage_blocks, x):
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+        out, _ = lax.scan(body, x, stage_blocks)
+        return out
+
+    M = num_microbatches
+
+    def step(params, opt_state, batch):
+        ids, labels = batch["input_ids"], batch["labels"]
+
+        def loss_fn(p):
+            stage = lax.axis_index(PP_AXIS)
+            b, t = ids.shape
+            if b < M or b % M:
+                raise ValueError(
+                    f"per-dp-shard batch {b} must be a positive multiple "
+                    f"of num_microbatches={M}")
+            mb = b // M
+            # embed all microbatches (replicated compute; only stage 0's
+            # result enters the pipe — cheap next to the block stack)
+            x = embed_mod.apply({"params": p["embed"]}, ids)
+            h = cfg.hidden_size
+            mbs = x.reshape(M, mb, t, h)
+            lab = labels.reshape(M, mb, t)
+
+            zero = jnp.zeros((mb, t, h), x.dtype)
+            fwd = functools.partial(run_stage, p["blocks"])
+
+            def tick(buf, tk):
+                # stage 0 feeds microbatch tk (clamped; bubbles masked)
+                mb_idx = jnp.clip(tk, 0, M - 1)
+                feed = lax.dynamic_index_in_dim(mbs, mb_idx, axis=0,
+                                                keepdims=False)
+                x_in = jnp.where(stage == 0, feed, buf)
+                y = fwd(x_in)
+                # hand my activation to the next stage (ring permute; the
+                # last->first edge carries drain garbage that stage 0
+                # never reads — x_in selects `feed` there)
+                buf = lax.ppermute(
+                    y, PP_AXIS,
+                    [(i, (i + 1) % n_pp) for i in range(n_pp)])
+                return buf, y
+
+            # initial carry must already be marked device-varying (VMA):
+            # after one tick buf genuinely differs per device, and scan
+            # requires carry types to be invariant
+            init = lax.pcast(zero, (DP_AXIS, PP_AXIS), to="varying")
+            _, ys = lax.scan(tick, init, jnp.arange(M + n_pp - 1))
+            # The last stage's ticks S-1 .. S-1+M-1 hold microbatches
+            # 0..M-1 (a STATIC slice), so the vocab-sized head projection
+            # and loss run ONCE over the M valid slots after the loop —
+            # not inside every tick, where (S-1)/(M+S-1) of that compute
+            # (the dominant matmul for real vocabs) would be bubble waste.
+            valid_ys = ys[n_pp - 1:n_pp - 1 + M]        # [M, mb, t, h]
+            logits = head_mod.apply({"params": p["head"]}, valid_ys)
+            s, c = token_nll(logits, lab)
+            last = (stage == n_pp - 1)
+            s_sum = jnp.where(last, s, 0.0)
+            s_cnt = jnp.where(last, c, 0.0)
+            # only the last stage accumulated; psum broadcasts the loss
+            # and the dp axis folds in global normalization
+            total = lax.psum(s_sum, (DP_AXIS, PP_AXIS))
+            count = lax.psum(s_cnt, (DP_AXIS, PP_AXIS))
+            return total / jnp.maximum(count, 1.0)
+
+        # With VMA tracking, autodiff inserts the reductions itself while
+        # transposing into each parameter's variance type: embed/head
+        # (unvarying) cotangents arrive psum'd over (dp, pp), block
+        # cotangents (varying over pp) psum'd over dp only.  Manual psums
+        # here would double-count — verified by the parity tests.
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # Specs are derived from the ACTUAL pytrees on first call: optimizer
+    # states are optax-defined tuples wrapping params-like subtrees, so a
+    # static prefix-spec cannot describe them; _spec_like marks every
+    # leaf under a "blocks" path as stage-sharded and the rest replicated.
+    cache = {}
+
+    def wrapper(params, opt_state, batch):
+        key = (jax.tree.structure(params), jax.tree.structure(opt_state))
+        fn = cache.get(key)
+        if fn is None:
+            p_spec = _spec_like(params)
+            o_spec = _spec_like(opt_state)
+            # check_vma=True is load-bearing, not hygiene: the loss is
+            # psum-normalized INSIDE the differentiated region, and
+            # without varying-manual-axes tracking jax transposes psum
+            # conservatively (cotangent re-psum'd), inflating every
+            # gradient by the mesh size.  Forward would still be exact —
+            # only training drifts.  (Pinned by the step-for-step parity
+            # tests.)
+            mapped = jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(p_spec, o_spec, P(DP_AXIS, None)),
+                out_specs=(p_spec, o_spec, P()),
+            )
+            fn = cache[key] = jax.jit(
+                mapped, donate_argnums=(0, 1) if donate else ())
+        return fn(params, opt_state, batch)
+
+    return wrapper
+
+
+def _spec_like(tree):
+    """PartitionSpec tree: leaves under a 'blocks' dict key are sharded
+    on their leading (layer) axis over pp; everything else replicated."""
+    def spec(path, leaf):
+        in_blocks = any(getattr(p, "key", None) == "blocks" for p in path)
+        return P(PP_AXIS) if in_blocks else P()
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+# -- the embedding/head halves of GPT as standalone modules ----------------
+
+import flax.linen as nn  # noqa: E402  (kept near its use)
+
+
+class _EmbedIn(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        t = input_ids.shape[1]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="wte")(input_ids)
+        return x + nn.Embed(cfg.max_position, cfg.hidden_size,
+                            dtype=cfg.dtype,
+                            name="wpe")(jnp.arange(t)[None])
+
+
+class _Head(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

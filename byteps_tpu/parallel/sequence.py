@@ -167,12 +167,12 @@ def make_sp_mesh(devices: Optional[Sequence] = None,
     The sp axis is laid out over the fastest-varying device dimension so
     the K/V rotation rides neighboring ICI links.
     """
-    devs = np.asarray(devices if devices is not None else jax.devices())
-    n_sp = n_sp or devs.size
-    if devs.size % n_sp:
-        raise ValueError(f"{devs.size} devices not divisible by sp={n_sp}")
-    return Mesh(devs.reshape(devs.size // n_sp, n_sp),
-                axis_names=(DP_AXIS, SP_AXIS))
+    from .mesh_util import make_2d_mesh
+    if n_sp is None:
+        import numpy as _np
+        n_sp = _np.asarray(devices if devices is not None
+                           else jax.devices()).size
+    return make_2d_mesh(devices, n_sp, (DP_AXIS, SP_AXIS))
 
 
 def sp_mesh_from_comm(comm, n_sp: Optional[int] = None) -> Mesh:

@@ -20,26 +20,21 @@ reduction is once per step.
 from __future__ import annotations
 
 import re
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gpt import GPT, GPTConfig, lm_loss
+from .mesh_util import make_2d_mesh
 
 DP_AXIS = "dp"
 TP_AXIS = "tp"
 
 
 def make_tp_mesh(devices, n_tp: int) -> Mesh:
-    devs = np.asarray(devices)
-    if devs.size % n_tp:
-        raise ValueError(f"{devs.size} devices not divisible by tp={n_tp}")
-    return Mesh(devs.reshape(devs.size // n_tp, n_tp),
-                axis_names=(DP_AXIS, TP_AXIS))
+    return make_2d_mesh(devices, n_tp, (DP_AXIS, TP_AXIS))
 
 
 # Megatron-style rules, matched against the flax param path
@@ -122,7 +117,24 @@ def make_dp_tp_train_step(mesh: Mesh, cfg: GPTConfig,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    def wrapper(params, opt_state, batch):
+        # The computation is governed by the INPUT shardings (GSPMD);
+        # the mesh argument's job is to catch the silent-mismatch trap:
+        # params placed on a different mesh would otherwise just run
+        # with whatever layout they carry.
+        leaf = jax.tree.leaves(params)[0]
+        lmesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+        if lmesh is not None and getattr(lmesh, "devices", None) is not None \
+                and lmesh != mesh:
+            raise ValueError(
+                "params are placed on a different mesh than the one this "
+                "train step was built for — re-shard with "
+                "shard_gpt_params(mesh, params)")
+        return jitted(params, opt_state, batch)
+
+    return wrapper
 
 
 def init_tp_opt_state(tx: optax.GradientTransformation, sharded_params):

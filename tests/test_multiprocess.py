@@ -25,6 +25,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.mark.slow
 def test_two_process_push_pull_matches_single_process():
     port = _free_port()
+    # a separately-reserved UDP endpoint for the auto-armed heartbeat:
+    # the default (rendezvous port + 1) is never reserved and can collide
+    hb_port = _free_port()
     procs = []
     for pid in range(2):
         env = dict(os.environ)
@@ -44,6 +47,7 @@ def test_two_process_push_pull_matches_single_process():
             # monitors must arm at init, stay quiet, stop at shutdown)
             "BYTEPS_HEARTBEAT_ON": "1",
             "BYTEPS_HEARTBEAT_TIMEOUT": "60",
+            "BYTEPS_HEARTBEAT_PORT": str(hb_port),
         })
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", "mp_worker.py")],
