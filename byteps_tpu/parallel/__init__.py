@@ -27,6 +27,14 @@ from .long_context import (  # noqa: F401
     shard_lm_batch,
     synthetic_lm_batch,
 )
+from .expert import (  # noqa: F401
+    init_moe_params,
+    make_dp_ep_train_step,
+    make_ep_mesh,
+    moe_mlp,
+    moe_mlp_reference,
+    shard_moe_params,
+)
 from .pipeline import (  # noqa: F401
     init_pipeline_params,
     make_dp_pp_train_step,

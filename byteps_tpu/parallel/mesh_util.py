@@ -10,11 +10,11 @@ gradient reduction is not).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def make_2d_mesh(devices: Optional[Sequence], n_inner: int,
@@ -26,3 +26,39 @@ def make_2d_mesh(devices: Optional[Sequence], n_inner: int,
             f"{axis_names[1]}={n_inner}")
     return Mesh(devs.reshape(devs.size // n_inner, n_inner),
                 axis_names=axis_names)
+
+
+def jit_mapped_step(mesh: Mesh, step: Callable, spec_of: Callable,
+                    batch_spec, donate: bool = True) -> Callable:
+    """Wrap a ``step(params, opt_state, batch)`` body in shard_map + jit
+    with specs derived from the ACTUAL pytrees on first call (optimizer
+    states are optax-defined wrappers a static prefix-spec cannot
+    describe).  ``spec_of(tree)`` returns the PartitionSpec tree for any
+    params-like pytree; the loss output is replicated.
+
+    check_vma=True is load-bearing, not hygiene: these steps normalize
+    their loss with collectives INSIDE the differentiated region, and
+    without varying-manual-axes tracking jax transposes psum
+    conservatively (cotangents re-psum'd), inflating every gradient by
+    the mesh size.  Forward stays exact — only training drifts.  (Pinned
+    by the step-for-step parity tests of pipeline/expert parallelism.)
+    """
+    cache = {}
+
+    def wrapper(params, opt_state, batch):
+        key = (jax.tree.structure(params), jax.tree.structure(opt_state))
+        fn = cache.get(key)
+        if fn is None:
+            p_spec = spec_of(params)
+            o_spec = spec_of(opt_state)
+            mapped = jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(p_spec, o_spec, batch_spec),
+                out_specs=(p_spec, o_spec, P()),
+                check_vma=True,
+            )
+            fn = cache[key] = jax.jit(
+                mapped, donate_argnums=(0, 1) if donate else ())
+        return fn(params, opt_state, batch)
+
+    return wrapper

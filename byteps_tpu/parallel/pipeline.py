@@ -30,7 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gpt import Block, GPT, GPTConfig, token_nll
-from .mesh_util import make_2d_mesh
+from .mesh_util import jit_mapped_step, make_2d_mesh
 
 DP_AXIS = "dp"
 PP_AXIS = "pp"
@@ -180,35 +180,12 @@ def make_dp_pp_train_step(mesh: Mesh, cfg: GPTConfig,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    # Specs are derived from the ACTUAL pytrees on first call: optimizer
-    # states are optax-defined tuples wrapping params-like subtrees, so a
-    # static prefix-spec cannot describe them; _spec_like marks every
-    # leaf under a "blocks" path as stage-sharded and the rest replicated.
-    cache = {}
-
-    def wrapper(params, opt_state, batch):
-        key = (jax.tree.structure(params), jax.tree.structure(opt_state))
-        fn = cache.get(key)
-        if fn is None:
-            p_spec = _spec_like(params)
-            o_spec = _spec_like(opt_state)
-            # check_vma=True is load-bearing, not hygiene: the loss is
-            # psum-normalized INSIDE the differentiated region, and
-            # without varying-manual-axes tracking jax transposes psum
-            # conservatively (cotangent re-psum'd), inflating every
-            # gradient by the mesh size.  Forward would still be exact —
-            # only training drifts.  (Pinned by the step-for-step parity
-            # tests.)
-            mapped = jax.shard_map(
-                step, mesh=mesh,
-                in_specs=(p_spec, o_spec, P(DP_AXIS, None)),
-                out_specs=(p_spec, o_spec, P()),
-            )
-            fn = cache[key] = jax.jit(
-                mapped, donate_argnums=(0, 1) if donate else ())
-        return fn(params, opt_state, batch)
-
-    return wrapper
+    # _spec_like marks every leaf under a "blocks" path as stage-sharded
+    # and the rest replicated; jit_mapped_step (mesh_util) derives specs
+    # from the actual pytrees and runs with VMA tracking ON (see its
+    # docstring for why that is load-bearing for gradients here).
+    return jit_mapped_step(mesh, step, _spec_like, P(DP_AXIS, None),
+                           donate=donate)
 
 
 def _spec_like(tree):

@@ -169,9 +169,8 @@ def make_sp_mesh(devices: Optional[Sequence] = None,
     """
     from .mesh_util import make_2d_mesh
     if n_sp is None:
-        import numpy as _np
-        n_sp = _np.asarray(devices if devices is not None
-                           else jax.devices()).size
+        n_sp = np.asarray(devices if devices is not None
+                          else jax.devices()).size
     return make_2d_mesh(devices, n_sp, (DP_AXIS, SP_AXIS))
 
 

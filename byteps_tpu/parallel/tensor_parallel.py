@@ -121,13 +121,20 @@ def make_dp_tp_train_step(mesh: Mesh, cfg: GPTConfig,
 
     def wrapper(params, opt_state, batch):
         # The computation is governed by the INPUT shardings (GSPMD);
-        # the mesh argument's job is to catch the silent-mismatch trap:
-        # params placed on a different mesh would otherwise just run
-        # with whatever layout they carry.
+        # the mesh argument's job is to catch the silent-mismatch traps:
+        # params on a different mesh, or never sharded at all (fresh
+        # model.init output / host arrays), would otherwise just run
+        # with whatever layout they carry — replicated on one device in
+        # the common case.
         leaf = jax.tree.leaves(params)[0]
         lmesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
-        if lmesh is not None and getattr(lmesh, "devices", None) is not None \
-                and lmesh != mesh:
+        if lmesh is None or getattr(lmesh, "devices", None) is None:
+            if mesh.size > 1:
+                raise ValueError(
+                    "params are not mesh-sharded (fresh init output or "
+                    "host arrays) — place them with "
+                    "shard_gpt_params(mesh, params) first")
+        elif lmesh != mesh:
             raise ValueError(
                 "params are placed on a different mesh than the one this "
                 "train step was built for — re-shard with "

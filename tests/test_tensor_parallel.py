@@ -114,3 +114,17 @@ def test_tp_matches_single_device_math():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5,
                                    err_msg=str(ka))
+
+
+def test_unsharded_params_rejected():
+    """Fresh init output (never mesh-sharded) must raise, not silently
+    run single-device replicated."""
+    cfg = _cfg()
+    mesh = make_tp_mesh(jax.devices()[:8], n_tp=4)
+    model = GPT(cfg)
+    batch = synthetic_lm_batch(jax.random.PRNGKey(5), cfg, 4, 16)
+    params = model.init(jax.random.PRNGKey(6), batch["input_ids"][:1])
+    tx = optax.sgd(0.1)
+    step = make_dp_tp_train_step(mesh, cfg, tx)
+    with pytest.raises(ValueError, match="not mesh-sharded"):
+        step(params, tx.init(params), shard_tp_batch(mesh, batch))
