@@ -41,8 +41,7 @@ def make_ep_mesh(devices, n_ep: int) -> Mesh:
 
 # ------------------------------------------------------------------ routing
 
-def switch_dispatch(x, router_w, num_experts: int, capacity: int,
-                    rng_unused=None):
+def switch_dispatch(x, router_w, num_experts: int, capacity: int):
     """Top-1 (switch) routing of a token shard.
 
     x: [N, h] tokens.  Returns (dispatch [N, E, C] one-hot combine
@@ -153,16 +152,23 @@ def init_moe_params(rng, hidden: int, ffn: int, num_experts: int,
     }
 
 
+def moe_pspec(path, leaf) -> P:
+    """THE placement rule for MoE params (and any optax state wrapping
+    them): router and scalar bookkeeping replicated, expert stacks
+    (leading expert axis) sharded over ep.  Single source of truth for
+    both device placement and shard_map specs."""
+    if any(getattr(q, "key", None) == "router" for q in path):
+        return P()
+    if getattr(leaf, "ndim", 1) == 0:
+        return P()
+    return P(EP_AXIS)
+
+
 def shard_moe_params(mesh: Mesh, params):
-    """Expert stacks sharded over ep (leading expert axis); router
-    replicated."""
-    def spec(path, leaf):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name == "router":
-            return NamedSharding(mesh, P())
-        return NamedSharding(mesh, P(EP_AXIS))
-    return jax.device_put(params,
-                          jax.tree_util.tree_map_with_path(spec, params))
+    """Place MoE params per :func:`moe_pspec`."""
+    return jax.device_put(params, jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, moe_pspec(path, leaf)),
+        params))
 
 
 def make_dp_ep_train_step(mesh: Mesh, num_experts: int,
@@ -205,13 +211,7 @@ def make_dp_ep_train_step(mesh: Mesh, num_experts: int,
         return params, opt_state, loss
 
     def spec_of(tree):
-        # one rule serves params and any optax state wrapping them:
-        # router (and scalar bookkeeping like adam's count) replicated,
-        # expert stacks (leading expert axis) sharded over ep
-        return jax.tree_util.tree_map_with_path(
-            lambda path, leaf: P() if (any(
-                getattr(q, "key", None) == "router" for q in path)
-                or leaf.ndim == 0) else P(EP_AXIS), tree)
+        return jax.tree_util.tree_map_with_path(moe_pspec, tree)
 
     return jit_mapped_step(mesh, step, spec_of, P((DP_AXIS, EP_AXIS)),
                            donate=donate)

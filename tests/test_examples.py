@@ -22,6 +22,13 @@ def _run(path, *argv):
     ("example/jax/benchmark_bert.py", ("--steps", "1", "--batch", "1")),
     ("example/jax/benchmark_resnet.py",
      ("--model", "tiny", "--batch", "1", "--size", "16", "--steps", "1")),
+    ("example/jax/train_parallel_axes.py",
+     ("--mode", "tp", "--steps", "2", "--batch", "8", "--seq", "16")),
+    ("example/jax/train_parallel_axes.py",
+     ("--mode", "pp", "--steps", "2", "--batch", "8", "--seq", "16",
+      "--microbatches", "2")),
+    ("example/jax/train_parallel_axes.py",
+     ("--mode", "ep", "--steps", "2", "--batch", "4", "--experts", "8")),
     ("example/jax/train_long_context.py",
      ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
       "--batch", "4")),
