@@ -35,6 +35,18 @@ class GPTConfig:
     max_position: int = 32768        # long-context by default
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # Mixture-of-experts (switch) MLPs: 0 = dense everywhere; >0 turns
+    # every ``moe_every``-th block's MLP into a switch layer with that
+    # many experts (parallel/expert.py moe_mlp; ep-shardable)
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity: float = 1.25
+
+    def __post_init__(self):
+        if self.moe_experts > 0 and self.moe_every < 1:
+            raise ValueError(
+                "moe_every must be >= 1 when moe_experts > 0 (a value "
+                "of 0 would silently produce a fully dense model)")
 
 
 def gpt_small() -> GPTConfig:
@@ -69,9 +81,58 @@ class CausalSelfAttention(nn.Module):
                                dtype=cfg.dtype, name="out")(ctx)
 
 
+class MoEMLP(nn.Module):
+    """Switch-MoE MLP block: parameters are the FULL expert stacks at
+    init; under an ep mesh each device's slice flows through apply (flax
+    only checks shapes at init, the same trick pipeline.py uses for
+    stage-local layer slices).  The aux load-balance loss is sown into
+    the ``moe_aux`` collection — train steps apply with
+    ``mutable=["moe_aux"]`` and fold the sown values into the loss."""
+
+    cfg: GPTConfig
+    ep_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        from jax import lax as _lax
+        from ..parallel.expert import moe_mlp
+        cfg = self.cfg
+        h, f, e = cfg.hidden_size, cfg.intermediate_size, cfg.moe_experts
+        # declared expert-stack size: the LOCAL shard when running under
+        # an ep axis (flax validates self.param shapes at apply; sharded
+        # leaves carry e/ep experts), the full stack otherwise (init and
+        # single-device reference both use ep_axis=None)
+        e_decl = e if self.ep_axis is None \
+            else e // _lax.axis_size(self.ep_axis)
+        params = {
+            "router": self.param("router", nn.initializers.lecun_normal(),
+                                 (h, e), jnp.float32),
+            "w1": self.param("w1", nn.initializers.lecun_normal(),
+                             (e_decl, h, f), jnp.float32),
+            "b1": self.param("b1", nn.initializers.zeros, (e_decl, f),
+                             jnp.float32),
+            "w2": self.param("w2", nn.initializers.lecun_normal(),
+                             (e_decl, f, h), jnp.float32),
+            "b2": self.param("b2", nn.initializers.zeros, (e_decl, h),
+                             jnp.float32),
+        }
+        # compute in cfg.dtype like the dense MLP path (params stay f32;
+        # moe_mlp casts expert inputs to the weight dtype, so casting the
+        # stacks here puts both big einsums on the bf16 MXU path)
+        params = {k: (v if k == "router" else v.astype(cfg.dtype))
+                  for k, v in params.items()}
+        b, t, _ = x.shape
+        out, aux = moe_mlp(x.reshape(b * t, h), params, e,
+                           cfg.moe_capacity, axis_name=self.ep_axis)
+        self.sow("moe_aux", "aux", aux)
+        return out.reshape(b, t, h)
+
+
 class Block(nn.Module):
     cfg: GPTConfig
     attn_fn: Optional[AttnFn] = None
+    moe: bool = False
+    ep_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
@@ -79,6 +140,8 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
         x = x + CausalSelfAttention(cfg, self.attn_fn, name="attn")(h)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        if self.moe:
+            return x + MoEMLP(cfg, self.ep_axis, name="moe")(h)
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(h)
         h = jax.nn.gelu(h)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(h)
@@ -91,6 +154,8 @@ class GPT(nn.Module):
 
     cfg: GPTConfig
     attn_fn: Optional[AttnFn] = None
+
+    ep_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
@@ -106,7 +171,10 @@ class GPT(nn.Module):
         if cfg.remat:
             block = nn.remat(Block)
         for i in range(cfg.num_layers):
-            x = block(cfg, self.attn_fn, name=f"h{i}")(x)
+            moe = (cfg.moe_experts > 0
+                   and i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, self.attn_fn, moe=moe, ep_axis=self.ep_axis,
+                      name=f"h{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -35,6 +35,11 @@ from .expert import (  # noqa: F401
     moe_mlp_reference,
     shard_moe_params,
 )
+from .moe_lm import (  # noqa: F401
+    make_moe_lm_train_step,
+    shard_moe_lm_batch,
+    shard_moe_lm_params,
+)
 from .pipeline import (  # noqa: F401
     init_pipeline_params,
     make_dp_pp_train_step,

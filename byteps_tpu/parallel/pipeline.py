@@ -47,6 +47,11 @@ def init_pipeline_params(cfg: GPTConfig, rng, sample_ids):
     (rather than a separate pipeline init) keeps bit-identical parameters
     between the pipelined and the plain model — the parity tests depend
     on it."""
+    if cfg.moe_experts:
+        raise ValueError(
+            "pipeline restacking needs homogeneous blocks; MoE configs "
+            "(moe_experts > 0) interleave dense and switch MLPs — use the "
+            "(dp, ep) path (parallel/moe_lm.py) for MoE models")
     variables = GPT(cfg).init(rng, sample_ids)
     p = variables["params"]
     layers = [p[f"h{i}"] for i in range(cfg.num_layers)]

@@ -27,7 +27,8 @@ def main() -> int:
     ap.add_argument("--mode", choices=["tp", "pp", "ep"], default="tp")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--inner", type=int, default=0,
-                    help="size of the tp/pp/ep axis (0 = half the devices)")
+                    help="size of the tp/pp/ep axis (0 = largest of "
+                         "4/2/1 that divides the device count)")
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--experts", type=int, default=8)
     ap.add_argument("--batch", type=int, default=16)
