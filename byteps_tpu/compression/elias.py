@@ -128,13 +128,15 @@ def elias_decode(words: np.ndarray, nbits: int, n: int) -> np.ndarray:
 # --------------------------------------------------------- framed wire
 
 def encode_wire(codes: np.ndarray, norm: float) -> bytes:
-    """Frame a dithering payload (dense signed codes + norm) as wire bytes."""
+    """Frame a dithering payload (dense signed codes + norm) as wire
+    bytes.  Explicit little-endian throughout: a wire format must not
+    depend on the producer's native byte order."""
     words, nbits = elias_encode(codes)
     header = np.empty(3, np.uint32)
     header[0] = np.uint32(nbits)
     header[1] = np.uint32(len(codes))
     header[2] = np.float32(norm).view(np.uint32)
-    return header.tobytes() + words.tobytes()
+    return header.astype("<u4").tobytes() + words.astype("<u4").tobytes()
 
 
 def decode_wire(data: bytes,
@@ -148,9 +150,9 @@ def decode_wire(data: bytes,
     claiming numel=2^32 would allocate 4 GiB before any later check)."""
     if len(data) < 12:
         raise ValueError("wire frame shorter than its header")
-    header = np.frombuffer(data[:12], np.uint32)
+    header = np.frombuffer(data[:12], "<u4")
     nbits, numel = int(header[0]), int(header[1])
-    norm = float(header[2:3].view(np.float32)[0])
+    norm = float(header[2:3].astype(np.uint32).view(np.float32)[0])
     if expected_numel is not None and numel != expected_numel:
         raise ValueError(
             f"wire payload numel {numel} != expected {expected_numel}")
@@ -159,7 +161,8 @@ def decode_wire(data: bytes,
         raise ValueError(
             f"wire frame truncated: header claims {nbits} bits "
             f"({nwords} words) but carries {len(data) - 12} bytes")
-    words = np.frombuffer(data[12:12 + 4 * nwords], np.uint32)
+    words = np.frombuffer(data[12:12 + 4 * nwords],
+                          "<u4").astype(np.uint32)
     return elias_decode(words, nbits, numel), norm
 
 

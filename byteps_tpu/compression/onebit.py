@@ -83,5 +83,38 @@ class OnebitCompressor(Compressor):
     def payload_nbytes(self) -> int:
         return self._lanes * 4 + 4
 
+    # -- tight host wire frame (the generic npz frame's zip headers cost
+    # more than the payload for small tensors): nwords u32 | scale f32 |
+    # raw packed words.
+    def wire_encode(self, payload: Payload) -> bytes:
+        import numpy as np
+        # explicit little-endian: a wire format must not depend on the
+        # producer's native byte order
+        words = np.asarray(payload["words"]).astype("<u4")
+        header = (np.uint32(len(words)).astype("<u4").tobytes()
+                  + np.float32(payload["scale"]).astype("<f4").tobytes())
+        return header + words.tobytes()
+
+    def wire_decode(self, data: bytes) -> Payload:
+        import numpy as np
+        if len(data) < 8:
+            raise ValueError("onebit wire frame shorter than its header")
+        nwords = int(np.frombuffer(data[:4], "<u4")[0])
+        if nwords != self._lanes:
+            # untrusted input: a forged count must not dictate shapes
+            raise ValueError(
+                f"onebit wire frame carries {nwords} words, "
+                f"expected {self._lanes}")
+        if len(data) < 8 + 4 * nwords:
+            raise ValueError("onebit wire frame truncated")
+        scale = float(np.frombuffer(data[4:8], "<f4")[0])
+        words = np.frombuffer(data[8:8 + 4 * nwords], "<u4")
+        import jax.numpy as jnp
+        return {"words": jnp.asarray(words.astype(np.uint32)),
+                "scale": jnp.float32(scale)}
+
+    def wire_nbytes(self, payload: Payload) -> int:
+        return 8 + 4 * self._lanes
+
     def cache_key(self) -> tuple:
         return super().cache_key() + (self.scaling,)

@@ -27,11 +27,19 @@ class AsyncDistributedOptimizer:
 
     def __init__(self, tx: optax.GradientTransformation,
                  store: Optional[KVStore] = None,
-                 name_prefix: str = "async"):
+                 name_prefix: str = "async",
+                 compression: Optional[dict] = None):
+        """``compression``: the engine's kwargs dict (compressor/ef/...)
+        — weight deltas then cross the worker->store boundary as
+        wire-encoded compressed payloads (the reference's async +
+        compressed combination), with per-leaf worker-side compressor
+        state (error feedback) held here."""
         self._tx = tx
         self._store = store if store is not None else KVStore()
         self._prefix = name_prefix
         self._names = None
+        self._compression = dict(compression) if compression else None
+        self._codecs = {}       # name -> (worker_comp, state)
 
     @property
     def store(self) -> KVStore:
@@ -48,7 +56,16 @@ class AsyncDistributedOptimizer:
         self._names = self._leaf_names(params)
         for name, leaf in zip(self._names,
                               jax.tree_util.tree_leaves(params)):
-            self._store.init_key(name, np.asarray(leaf))
+            arr = np.asarray(leaf)
+            self._store.init_key(name, arr)
+            if self._compression is not None:
+                from ..compression import registry as reg
+                wc = reg.create(self._compression, arr.size, arr.dtype)
+                self._codecs[name] = (wc, wc.init_state())
+                # the STORE owns the key's decode codec (one source of
+                # truth; diverging worker kwargs fail loudly there)
+                self._store.register_compression(
+                    name, self._compression, arr.size, arr.dtype)
         return self._tx.init(params)
 
     def update_and_sync(self, grads, state, params) -> Tuple:
@@ -70,6 +87,17 @@ class AsyncDistributedOptimizer:
         treedef = jax.tree_util.tree_structure(params)
         fresh = []
         for name, old, new in zip(self._names, leaves_old, leaves_new):
-            self._store.push_delta(name, np.asarray(new) - np.asarray(old))
+            delta = np.asarray(new) - np.asarray(old)
+            if self._compression is not None:
+                # compressed wire push (reference async + compressed):
+                # worker-side chain (EF state threaded here) encodes the
+                # delta; the store decodes with the momentum-free chain
+                wc, st = self._codecs[name]
+                payload, st = wc.compress(
+                    jnp.asarray(delta.reshape(-1)), st)
+                self._codecs[name] = (wc, st)
+                self._store.push_delta_wire(name, wc.wire_encode(payload))
+            else:
+                self._store.push_delta(name, delta)
             fresh.append(jnp.asarray(self._store.pull(name)))
         return jax.tree_util.tree_unflatten(treedef, fresh), state

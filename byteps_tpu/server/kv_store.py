@@ -31,6 +31,8 @@ class KVStore:
         self._lock = threading.Lock()
         self._store: Dict[str, np.ndarray] = {}
         self._versions: Dict[str, int] = {}
+        self._codecs: Dict[str, object] = {}
+        self.wire_bytes = 0  # total compressed bytes pushed (accounting)
         # force the one-time native build/load here, NOT under self._lock in
         # push_delta (the first load may g++-compile core.cc for seconds)
         _native_load()
@@ -43,17 +45,56 @@ class KVStore:
                 self._store[key] = np.array(value, copy=True)
                 self._versions[key] = 0
 
+    def _push_delta_locked(self, key: str, delta: np.ndarray) -> int:
+        if key not in self._store:
+            raise KeyError(f"key {key!r} not initialized")
+        # native multithreaded sum when available (reference server
+        # engine threads sum with the C++ CpuReducer, server.cc:77-198)
+        inplace_add(self._store[key], delta.reshape(
+            self._store[key].shape))
+        self._versions[key] += 1
+        return self._versions[key]
+
     def push_delta(self, key: str, delta) -> int:
         """Sum a delta into the store (async SUM_RECV path); returns the
         new version."""
         with self._lock:
-            if key not in self._store:
-                raise KeyError(f"key {key!r} not initialized")
-            # native multithreaded sum when available (reference server
-            # engine threads sum with the C++ CpuReducer, server.cc:77-198)
-            inplace_add(self._store[key], np.asarray(delta))
-            self._versions[key] += 1
-            return self._versions[key]
+            return self._push_delta_locked(key, np.asarray(delta))
+
+    def register_compression(self, key: str, kwargs: dict, numel: int,
+                             dtype=np.float32) -> None:
+        """Declare a key's wire codec ON the store (one source of truth
+        for the key's format, mirroring ServerEngine.register_compression
+        — two workers with diverging kwargs must fail loudly, not sum
+        mismatched decodes)."""
+        from ..compression import registry as reg
+        with self._lock:
+            existing = self._codecs.get(key)
+            if existing is not None:
+                if existing[0] != dict(kwargs):
+                    raise ValueError(
+                        f"key {key!r} already registered with different "
+                        f"compression kwargs {existing[0]}")
+                return
+            comp = reg.create(dict(kwargs), numel, dtype, for_server=True)
+            self._codecs[key] = (dict(kwargs), comp)
+
+    def push_delta_wire(self, key: str, data: bytes) -> int:
+        """Sum a wire-encoded compressed delta (the reference's async +
+        compressed combination: compressed pushes, decompress-and-sum on
+        the server, server.cc:87-113 + 310-314).  The key's codec must
+        be registered via :meth:`register_compression`; the bytes are
+        what a real worker->server network hop would carry, accumulated
+        in :attr:`wire_bytes` only for pushes that land."""
+        with self._lock:
+            codec = self._codecs.get(key)
+            if codec is None:
+                raise KeyError(f"key {key!r} has no registered compression")
+            delta = np.asarray(codec[1].decompress(
+                codec[1].wire_decode(data)))
+            version = self._push_delta_locked(key, delta)
+            self.wire_bytes += len(data)
+            return version
 
     def pull(self, key: str) -> np.ndarray:
         """Return the current value (no barrier — async pull,
@@ -73,3 +114,5 @@ class KVStore:
         with self._lock:
             self._store.clear()
             self._versions.clear()
+            self._codecs.clear()
+            self.wire_bytes = 0
