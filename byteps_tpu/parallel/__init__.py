@@ -48,6 +48,13 @@ from .pipeline import (  # noqa: F401
     shard_pipeline_params,
     shard_pp_batch,
 )
+from .zero import (  # noqa: F401
+    ZeroState,
+    init_zero_state,
+    make_fsdp_train_step,
+    make_zero_train_step,
+    zero_params,
+)
 from .tensor_parallel import (  # noqa: F401
     init_tp_opt_state,
     make_dp_tp_train_step,

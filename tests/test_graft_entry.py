@@ -34,7 +34,7 @@ def test_bench_smoke_cpu():
         "runpy.run_path('/root/repo/bench.py', run_name='__main__')"
     )
     out = subprocess.run([sys.executable, "-c", code], env=env,
-                         capture_output=True, text=True, timeout=600,
+                         capture_output=True, text=True, timeout=900,
                          cwd="/root/repo")
     lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
     assert lines, out.stdout + out.stderr
