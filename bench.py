@@ -282,7 +282,10 @@ def _bench_dcn_compare():
 
     devs = np.array(jax.devices()[:8]).reshape(2, 4)
     mesh = Mesh(devs, ("dcn", "ici"))
-    n = 4 << 20  # 16 MB of f32 per rank
+    # 4 MB of f32 per rank: the wire-bytes ratio (the point of this
+    # section) comes from the HLO and is size-independent; small keeps the
+    # CPU-mesh run inside the smoke-test budget on a loaded host.
+    n = 1 << 20
 
     def build(compressed):
         c, d = make_onebit_pair() if compressed else (None, None)
@@ -300,7 +303,7 @@ def _bench_dcn_compare():
     for tag, compressed in (("plain", False), ("onebit_dcn", True)):
         f, x, hlo = build(compressed)
         f(x).block_until_ready()
-        reps = 5
+        reps = 3
         t0 = time.perf_counter()
         for _ in range(reps):
             r = f(x)
@@ -366,6 +369,77 @@ def _bench_pallas(devices):
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
+def _bench_flash(devices):
+    """On real TPU: flash-attention Pallas kernels vs XLA exact attention
+    at long context (the regime the kernels exist for), forward and
+    forward+backward, timed as scan-chained calls so the tunneled chip's
+    host round-trip amortizes away."""
+    import jax
+    import jax.numpy as jnp
+
+    from byteps_tpu.ops.flash_attention import flash_attention
+    from byteps_tpu.parallel import full_attention
+
+    try:
+        b, t, h, d = 4, 4096, 16, 128
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, t, h, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, t, h, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, t, h, d), jnp.bfloat16)
+        reps = 10
+
+        def fwd_chain(attn):
+            def f(q, k, v):
+                def body(c, _):
+                    return attn(c, k, v), None
+                out, _ = jax.lax.scan(body, q, None, length=reps)
+                return jnp.sum(out.astype(jnp.float32))
+            return jax.jit(f)
+
+        def bwd_chain(attn):
+            # grad w.r.t. all of (q, k, v): differentiating q alone would
+            # let XLA dead-code the exact path's dK/dV branches while the
+            # flash custom_vjp always computes all three — unequal work.
+            def f(q, k, v):
+                def body(c, _):
+                    gq, gk, gv = jax.grad(
+                        lambda qq, kk, vv: jnp.sum(
+                            attn(qq, kk, vv).astype(jnp.float32)),
+                        argnums=(0, 1, 2))(c, k, v)
+                    nxt = (gq + gk + gv).astype(c.dtype)
+                    return nxt, None
+                out, _ = jax.lax.scan(body, q, None, length=reps)
+                return jnp.sum(out.astype(jnp.float32))
+            return jax.jit(f)
+
+        def timeit(f):
+            float(f(q, k, v))  # warm + forces completion through the host
+            t0 = time.perf_counter()
+            float(f(q, k, v))
+            return (time.perf_counter() - t0) / reps * 1e3
+
+        flash = lambda q, k, v: flash_attention(q, k, v, causal=True)  # noqa: E731
+        exact = lambda q, k, v: full_attention(q, k, v, causal=True)  # noqa: E731
+        diff = float(jnp.max(jnp.abs(
+            flash(q[:1, :512], k[:1, :512], v[:1, :512]).astype(jnp.float32)
+            - exact(q[:1, :512], k[:1, :512],
+                    v[:1, :512]).astype(jnp.float32))))
+        out = {
+            "shape": f"b{b} t{t} h{h} d{d} bf16 causal",
+            "fwd_ms": round(timeit(fwd_chain(flash)), 2),
+            "fwd_exact_ms": round(timeit(fwd_chain(exact)), 2),
+            "fwd_bwd_ms": round(timeit(bwd_chain(flash)), 2),
+            "fwd_bwd_exact_ms": round(timeit(bwd_chain(exact)), 2),
+            "max_diff_vs_exact": round(diff, 4),
+        }
+        out["fwd_speedup"] = round(out["fwd_exact_ms"] / out["fwd_ms"], 2)
+        out["fwd_bwd_speedup"] = round(
+            out["fwd_bwd_exact_ms"] / out["fwd_bwd_ms"], 2)
+        return out
+    except Exception as e:  # noqa: BLE001 - secondary metric only
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def inner_main() -> int:
     """Full bench; assumes the backend choice was made by the environment."""
     import jax
@@ -388,6 +462,7 @@ def inner_main() -> int:
     train = _bench_train_step(devices)
     push_pull = _bench_push_pull(devices, on_tpu)
     pallas = _bench_pallas(devices) if on_tpu else {"skipped": "cpu run"}
+    flash = _bench_flash(devices) if on_tpu else {"skipped": "cpu run"}
     resnet = None
     if on_tpu:
         try:
@@ -434,6 +509,7 @@ def inner_main() -> int:
         "n_devices": train["n_devices"],
         "push_pull_gbps": push_pull,
         "onebit_pallas": pallas,
+        "flash_attention": flash,
     }
     if resnet is not None:
         result["resnet50"] = resnet

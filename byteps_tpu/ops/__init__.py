@@ -15,3 +15,4 @@ from .collective_ops import (  # noqa: F401
     hierarchical_push_pull,
     make_onebit_pair,
 )
+from .flash_attention import flash_attention  # noqa: F401

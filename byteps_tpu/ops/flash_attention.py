@@ -1,0 +1,357 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The long-context path (parallel/sequence.py ring attention) and the model
+families run attention through XLA's exact softmax — fine at BERT's seq
+128, quadratic-memory-bound at long context.  This module implements the
+standard flash decomposition (online softmax over key blocks, recompute
+backward) as Pallas kernels so the hot op stays in VMEM:
+
+- forward: one pass over K/V blocks per Q block; running (m, l, acc) in
+  VMEM scratch; emits the output and the log-sum-exp residual.
+- backward: the Dao (2022) two-kernel scheme — dK/dV accumulate over Q
+  blocks, dQ accumulates over K blocks, both recomputing P from (Q, K,
+  lse) instead of storing the [T, T] probability matrix.
+
+Layout notes (Mosaic): all kernel operands are [BH, T, D] with D padded
+to a lane multiple (128) and T padded to the block size; the per-row
+residuals (lse, delta) are carried as [BH, T, 128] lane-broadcast arrays
+so every block spec keeps a full (8, 128)-or-larger tile — this image's
+Mosaic rejects narrower output tiles (see ops/pallas_kernels.py).
+
+The reference has no attention kernels at all (it is a gradient-
+communication library, SURVEY.md §2); this is TPU-first capability the
+rebuild adds, with `interpret=` giving the exact same code path on CPU
+for tests (tests/test_flash_attention.py pins forward and gradients
+against parallel/sequence.py full_attention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_kernels import on_tpu
+
+_NEG = -1e30
+_LANES = 128
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, kv_len, q_off, nk):
+    ik = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    iq = pl.program_id(1)
+    # Causal: skip key blocks entirely in the future of this q block.
+    last_row = q_off + iq * bq + (bq - 1)
+    live = (ik * bk <= last_row) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        # dots stay in the input dtype (bf16 rides the MXU's native path;
+        # upcasting first would force slow f32 passes); accumulate f32.
+        q = q_ref[0]                                       # (bq, D)
+        k = k_ref[0]                                       # (bk, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _mask_block(s, causal, kv_len, q_off, iq, ik, bq, bk)
+
+        m_prev = m_scr[:, :1]                              # (bq, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                             # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)                    # (bq, 1)
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, :, :] = m_scr[...] + jnp.log(
+            jnp.maximum(l_scr[...], 1e-30))
+
+
+def _mask_block(s, causal, kv_len, q_off, iq, ik, bq, bk):
+    """Apply the kv-tail and causal masks to one (bq, bk) score block.
+
+    Unconditional: a lax.cond around the mask (tried) lowers to a select
+    on this Mosaic — both branches execute, the duplicated code only
+    inflates compile size and rejects large-block configs."""
+    col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = col < kv_len
+    if causal:
+        row = q_off + iq * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        valid = valid & (row >= col)
+    return jnp.where(valid, s, _NEG)
+
+
+def _fwd(q, k, v, scale, causal, q_off, kv_len, bq, bk, interpret):
+    """[BH, Tq, D] x [BH, Tk, D] (padded) -> (out, lse[BH, Tq, 128])."""
+    from jax.experimental.pallas import tpu as pltpu
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // bq, tk // bk
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             kv_len=kv_len, q_off=q_off, nk=nk)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, lse_ref, scale, causal, kv_len, q_off,
+                 iq, ik, bq, bk):
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = _mask_block(s, causal, kv_len, q_off, iq, ik, bq, bk)
+    p = jnp.exp(s - lse_ref[0, :, :1])                     # (bq, bk)
+    return p, q, k
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, kv_len, q_off, nq):
+    iq = pl.program_id(2)
+    ik = pl.program_id(1)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    last_row = q_off + iq * bq + (bq - 1)
+    live = (ik * bk <= last_row) if causal else True
+
+    @pl.when(live)
+    def _accum():
+        p, q, _ = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
+                               kv_len, q_off, iq, ik, bq, bk)
+        do = do_ref[0]                                     # (bq, D)
+        v = v_ref[0]                                       # (bk, D)
+        # dV += P^T dO
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dS = P * (dO V^T - delta); dK += dS^T Q
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, :, :1]) * scale)
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, :, :] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, :, :] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale, causal, kv_len, q_off, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    last_row = q_off + iq * bq + (bq - 1)
+    live = (ik * bk <= last_row) if causal else True
+
+    @pl.when(live)
+    def _accum():
+        p, _, k = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
+                               kv_len, q_off, iq, ik, bq, bk)
+        do = do_ref[0]
+        v = v_ref[0]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, :1]) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, :, :] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd(res, g, scale, causal, q_off, kv_len, bq, bk, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+    q, k, v, out, lse = res
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // bq, tk // bk
+    do = g
+    # delta = rowsum(dO * O): cheap elementwise, lane-broadcast for tiling
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (bh, tq, _LANES))
+
+    dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                                 kv_len=kv_len, q_off=q_off, nq=nq)
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # do
+            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                kv_len=kv_len, q_off=q_off, nk=nk)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# custom-vjp core on padded [BH, T, D] arrays
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, q_off, kv_len, blocks, interpret):
+    out, _ = _fwd(q, k, v, scale, causal, q_off, kv_len,
+                  blocks[0], blocks[1], interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, q_off, kv_len, blocks, interpret):
+    out, lse = _fwd(q, k, v, scale, causal, q_off, kv_len,
+                    blocks[0], blocks[1], interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, q_off, kv_len, blocks, interpret, res, g):
+    return _bwd(res, g, scale, causal, q_off, kv_len,
+                blocks[0], blocks[1], interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# --------------------------------------------------------------------------
+# public entry
+# --------------------------------------------------------------------------
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 1024,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Flash attention.  [B, Tq, H, D] x [B, Tk, H, D] -> [B, Tq, H, D].
+
+    Same contract as parallel/sequence.py full_attention (including the
+    decode-style alignment: with causal=True and Tq < Tk the q rows cover
+    the LAST Tq key positions).  Differentiable via the flash backward
+    kernels.  ``interpret=None`` engages the Mosaic path on a real TPU
+    backend and the interpreter elsewhere (CPU tests).
+    """
+    if interpret is None:
+        interpret = not on_tpu()
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    q_off = tk - tq  # decode alignment (0 when square)
+
+    bq = min(block_q, _ceil_to(tq, 8))
+    bk = min(block_k, _ceil_to(tk, 8))
+    tq_p, tk_p, d_p = _ceil_to(tq, bq), _ceil_to(tk, bk), _ceil_to(d, _LANES)
+
+    def to3(x, t_p):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+        return jnp.pad(x, ((0, 0), (0, t_p - x.shape[1]), (0, d_p - d)))
+
+    q3, k3, v3 = to3(q, tq_p), to3(k, tk_p), to3(v, tk_p)
+    out = _flash(q3, k3, v3, scale, causal, q_off, tk, (bq, bk),
+                 bool(interpret))
+    out = out[:, :tq, :d].reshape(b, h, tq, d)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

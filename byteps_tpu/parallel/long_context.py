@@ -55,6 +55,16 @@ def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
         attn = functools.partial(ring_attention, axis_name=SP_AXIS)
     elif attention == "ulysses":
         attn = functools.partial(ulysses_attention, axis_name=SP_AXIS)
+    elif attention == "flash":
+        # Pallas flash kernels as the local attention: valid only when the
+        # sequence axis is unsharded (sp=1, long context via dp + remat) —
+        # a sharded sequence needs the ring/Ulysses collectives.
+        if mesh.shape[SP_AXIS] != 1:
+            raise ValueError(
+                f"attention='flash' runs local attention and needs sp=1; "
+                f"this mesh has sp={mesh.shape[SP_AXIS]} — use 'ring' or "
+                f"'ulysses' for a sharded sequence axis")
+        from ..ops.flash_attention import flash_attention as attn
     else:
         raise ValueError(f"unknown attention kind: {attention!r}")
     model = GPT(cfg, attn_fn=attn)

@@ -37,6 +37,12 @@ The master copy is always float32: with a bf16 ``compute_dtype`` this is
 simultaneously the `_HalfPrecisionDistributedOptimizer` of the reference
 (reference misc/imagenet18/__init__.py:39 keeps f32 master weights next to
 fp16 model weights) — sharded, instead of replicated.
+
+Optimizer contract: ``tx.update`` runs on the 1/R gradient shard inside
+shard_map.  Elementwise transforms (sgd, adam/adamw, weight decay, lr
+schedules) are exact; transforms that compute a whole-tree statistic must
+be sharding-aware — use :func:`clip_by_global_norm` from this module in
+place of ``optax.clip_by_global_norm``.
 """
 
 from __future__ import annotations
@@ -55,11 +61,45 @@ from ..comm.mesh import CommContext
 
 __all__ = [
     "ZeroState",
+    "clip_by_global_norm",
     "init_zero_state",
     "make_zero_train_step",
     "make_fsdp_train_step",
     "zero_params",
 ]
+
+
+def clip_by_global_norm(max_norm: float,
+                        comm: CommContext) -> optax.GradientTransformation:
+    """Sharding-aware replacement for ``optax.clip_by_global_norm``.
+
+    The ZeRO steps call ``tx.update`` on the 1/R gradient SHARD inside
+    shard_map, so any transform that computes a whole-tree statistic sees
+    only its shard — ``optax.clip_by_global_norm`` would clip each shard
+    by a different, wrong norm.  This variant psums the squared norm over
+    the DP axes first (a scalar — free next to the gradient collectives),
+    so the clip matches the replicated-DP trajectory exactly.  Outside
+    shard_map (no axes bound) it degrades to the plain global norm and is
+    interchangeable with the optax original.
+    """
+    axes = comm.dp_axes
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(updates))
+        try:
+            sq = lax.psum(sq, axes)
+        except NameError:  # axes not bound: replicated (non-ZeRO) use
+            pass
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(
+            jnp.sqrt(sq), 1e-16))
+        return jax.tree.map(lambda g: g * scale, updates), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 class ZeroState(NamedTuple):
@@ -163,10 +203,11 @@ def make_zero_train_step(comm: CommContext, loss_fn: Callable,
         return params, master, opt_state, lax.pmean(loss, axes)
 
     def wrapper(params, zstate, batch):
-        key = (jax.tree.structure(params), jax.tree.structure(zstate))
+        padded = zstate.master.shape[0]
+        key = (jax.tree.structure(params), jax.tree.structure(zstate),
+               padded)
         fn = cache.get(key)
         if fn is None:
-            padded = zstate.master.shape[0]
             o_spec = _spec_of_opt(zstate.opt_state, padded, axes)
             mapped = jax.shard_map(
                 step, mesh=comm.mesh,
@@ -213,10 +254,10 @@ def make_fsdp_train_step(comm: CommContext, loss_fn: Callable,
         return master, opt_state, lax.pmean(loss, axes)
 
     def wrapper(zstate, batch):
-        key = jax.tree.structure(zstate)
+        padded = zstate.master.shape[0]
+        key = (jax.tree.structure(zstate), padded)
         fn = cache.get(key)
         if fn is None:
-            padded = zstate.master.shape[0]
             o_spec = _spec_of_opt(zstate.opt_state, padded, axes)
             mapped = jax.shard_map(
                 step, mesh=comm.mesh,

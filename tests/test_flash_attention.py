@@ -1,0 +1,150 @@
+"""Flash-attention Pallas kernels vs exact attention (ops/flash_attention.py).
+
+Forward and gradients are pinned against parallel/sequence.py
+full_attention — the same oracle the ring/Ulysses sequence-parallel tests
+use — in interpret mode (the identical kernel code runs compiled by
+Mosaic on a real TPU backend; bench.py re-validates there).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.ops.flash_attention import flash_attention
+from byteps_tpu.parallel import full_attention
+
+
+def _rand(shape, dtype, seed):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32
+                             ).astype(dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t", [128, 256])
+def test_forward_matches_exact(causal, t):
+    b, h, d = 2, 4, 64
+    q = _rand((b, t, h, d), jnp.float32, 0)
+    k = _rand((b, t, h, d), jnp.float32, 1)
+    v = _rand((b, t, h, d), jnp.float32, 2)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_forward_ragged_shapes():
+    """T not a block multiple, D not a lane multiple: padding is masked."""
+    b, t, h, d = 2, 100, 3, 48
+    q = _rand((b, t, h, d), jnp.float32, 3)
+    k = _rand((b, t, h, d), jnp.float32, 4)
+    v = _rand((b, t, h, d), jnp.float32, 5)
+    for causal in (False, True):
+        got = flash_attention(q, k, v, causal=causal, interpret=True)
+        want = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_decode_alignment():
+    """Tq < Tk with causal: q rows cover the LAST Tq key positions."""
+    b, h, d = 1, 2, 64
+    q = _rand((b, 64, h, d), jnp.float32, 6)
+    k = _rand((b, 256, h, d), jnp.float32, 7)
+    v = _rand((b, 256, h, d), jnp.float32, 8)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_exact(causal):
+    b, t, h, d = 2, 128, 2, 64
+    q = _rand((b, t, h, d), jnp.float32, 9)
+    k = _rand((b, t, h, d), jnp.float32, 10)
+    v = _rand((b, t, h, d), jnp.float32, 11)
+    # nontrivial downstream cotangent
+    w = _rand((b, t, h, d), jnp.float32, 12)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       interpret=True) * w)
+
+    def loss_exact(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) * w)
+
+    g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_gradients_ragged():
+    b, t, h, d = 1, 72, 2, 32
+    q = _rand((b, t, h, d), jnp.float32, 13)
+    k = _rand((b, t, h, d), jnp.float32, 14)
+    v = _rand((b, t, h, d), jnp.float32, 15)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(
+            f(q, k, v) * (1.0 + jnp.arange(d, dtype=jnp.float32)))
+
+    g_got = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss(lambda q, k, v: full_attention(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_bf16_forward():
+    b, t, h, d = 2, 128, 2, 64
+    q = _rand((b, t, h, d), jnp.bfloat16, 16)
+    k = _rand((b, t, h, d), jnp.bfloat16, 17)
+    v = _rand((b, t, h, d), jnp.bfloat16, 18)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_long_context_flash_mode():
+    """attention='flash' trains the GPT long-context step on an sp=1 mesh
+    and matches the exact-attention trajectory; sp>1 is rejected."""
+    import optax
+    from byteps_tpu.models.gpt import GPT, gpt_tiny
+    from byteps_tpu.parallel import (make_dp_sp_train_step, make_sp_mesh,
+                                     shard_lm_batch, synthetic_lm_batch)
+    from byteps_tpu.parallel.long_context import replicate
+
+    cfg = gpt_tiny()
+    mesh = make_sp_mesh(jax.devices()[:8], n_sp=1)
+    batch = synthetic_lm_batch(jax.random.PRNGKey(0), cfg, batch=8,
+                               seq_len=32)
+    params = GPT(cfg).init(jax.random.PRNGKey(1), batch["input_ids"][:1])
+    tx = optax.sgd(0.1)
+
+    losses = {}
+    for kind in ("flash", "ring"):
+        step = make_dp_sp_train_step(mesh, cfg, tx, attention=kind,
+                                     donate=False)
+        p = replicate(mesh, params)
+        o = replicate(mesh, tx.init(params))
+        ls = []
+        for _ in range(3):
+            p, o, loss = step(p, o, shard_lm_batch(mesh, batch))
+            ls.append(float(loss))
+        losses[kind] = ls
+    # gpt_tiny computes in bf16: the two softmax decompositions agree to
+    # bf16 resolution, not f32
+    np.testing.assert_allclose(losses["flash"], losses["ring"],
+                               rtol=5e-3, atol=5e-3)
+
+    mesh2 = make_sp_mesh(jax.devices()[:8], n_sp=2)
+    with pytest.raises(ValueError, match="needs sp=1"):
+        make_dp_sp_train_step(mesh2, cfg, tx, attention="flash")

@@ -156,3 +156,30 @@ def test_zero1_bf16_params(comm):
         assert np.isfinite(float(loss))
     assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(p))
     assert zstate.master.dtype == jnp.float32
+
+
+def test_sharded_clip_by_global_norm(comm):
+    """zero.clip_by_global_norm psums the norm over the shards, matching
+    the replicated-DP trajectory with optax.clip_by_global_norm; the
+    plain optax transform inside ZeRO would clip each shard by its own
+    norm (documented restriction)."""
+    from byteps_tpu.parallel.zero import clip_by_global_norm
+
+    model, params, loss_fn, batch = _setup(comm)
+    max_norm = 0.05  # far below the initial grad norm so the clip bites
+
+    ref_tx = optax.chain(optax.clip_by_global_norm(max_norm),
+                         optax.adam(1e-2))
+    _, ref_losses = _run_dp_reference(comm, params, loss_fn, batch,
+                                      ref_tx, steps=4)
+
+    ztx = optax.chain(clip_by_global_norm(max_norm, comm),
+                      optax.adam(1e-2))
+    zstep = make_zero_train_step(comm, loss_fn, ztx, donate=False)
+    zstate = init_zero_state(comm, ztx, params)
+    p = replicate(comm, params)
+    losses = []
+    for _ in range(4):
+        p, zstate, loss = zstep(p, zstate, batch)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
