@@ -49,8 +49,9 @@ def _ceil_to(x: int, m: int) -> int:
 # forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, kv_len, q_off, nk):
+def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, kv_len, nk):
+    q_off = qoff_ref[0]
     ik = pl.program_id(2)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
@@ -117,12 +118,14 @@ def _fwd(q, k, v, scale, causal, q_off, kv_len, bq, bk, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     nq, nk = tq // bq, tk // bk
+    qoff = jnp.asarray(q_off, jnp.int32).reshape(1)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             kv_len=kv_len, q_off=q_off, nk=nk)
+                             kv_len=kv_len, nk=nk)
     return pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
@@ -141,7 +144,7 @@ def _fwd(q, k, v, scale, causal, q_off, kv_len, bq, bk, interpret):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(qoff, q, k, v)
 
 
 # --------------------------------------------------------------------------
@@ -159,9 +162,10 @@ def _recompute_p(q_ref, k_ref, lse_ref, scale, causal, kv_len, q_off,
     return p, q, k
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, kv_len, q_off, nq):
+def _bwd_dkv_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, kv_len, nq):
+    q_off = qoff_ref[0]
     iq = pl.program_id(2)
     ik = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -199,9 +203,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, :, :] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr,
-                   *, scale, causal, kv_len, q_off, nk):
+def _bwd_dq_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scr,
+                   *, scale, causal, kv_len, nk):
+    q_off = qoff_ref[0]
     ik = pl.program_id(2)
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -233,23 +238,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(res, g, scale, causal, q_off, kv_len, bq, bk, interpret):
-    from jax.experimental.pallas import tpu as pltpu
     q, k, v, out, lse = res
+    delta = _delta(g, out)
+    return _bwd_impl(q, k, v, g, lse, delta, scale, causal, q_off,
+                     kv_len, bq, bk, interpret)
+
+
+def _delta(do, out):
+    """rowsum(dO * O), lane-broadcast for tiling."""
+    bh, tq, _ = do.shape
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    return jnp.broadcast_to(delta, (bh, tq, _LANES))
+
+
+def _bwd_impl(q, k, v, do, lse, delta, scale, causal, q_off, kv_len,
+              bq, bk, interpret):
+    from jax.experimental.pallas import tpu as pltpu
     bh, tq, d = q.shape
     tk = k.shape[1]
     nq, nk = tq // bq, tk // bk
-    do = g
-    # delta = rowsum(dO * O): cheap elementwise, lane-broadcast for tiling
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)
-    delta = jnp.broadcast_to(delta, (bh, tq, _LANES))
+    qoff = jnp.asarray(q_off, jnp.int32).reshape(1)
 
     dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                                 kv_len=kv_len, q_off=q_off, nq=nq)
+                                 kv_len=kv_len, nq=nq)
     dk, dv = pl.pallas_call(
         dkv_kern,
         grid=(bh, nk, nq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),       # q
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # k
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),       # v
@@ -270,14 +287,15 @@ def _bwd(res, g, scale, causal, q_off, kv_len, bq, bk, interpret):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(qoff, q, k, v, do, lse, delta)
 
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                                kv_len=kv_len, q_off=q_off, nk=nk)
+                                kv_len=kv_len, nk=nk)
     dq = pl.pallas_call(
         dq_kern,
         grid=(bh, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
@@ -289,7 +307,7 @@ def _bwd(res, g, scale, causal, q_off, kv_len, bq, bk, interpret):
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(qoff, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 

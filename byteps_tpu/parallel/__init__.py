@@ -22,6 +22,7 @@ from .sequence import (  # noqa: F401
     sp_mesh_from_comm,
     ulysses_attention,
 )
+from .ring_flash import ring_flash_attention  # noqa: F401
 from .long_context import (  # noqa: F401
     make_dp_sp_train_step,
     shard_lm_batch,

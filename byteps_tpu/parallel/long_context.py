@@ -49,10 +49,15 @@ def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
 
     ``batch`` holds ``input_ids`` and ``labels`` (both [B, T], labels
     already shifted, -1 = ignore), sharded via :func:`shard_lm_batch`.
-    ``attention`` is "ring" or "ulysses".
+    ``attention`` is "ring", "ring_flash" (ring rotation with Pallas
+    flash block kernels), "ulysses", or "flash" (local flash kernels,
+    sp=1 only).
     """
     if attention == "ring":
         attn = functools.partial(ring_attention, axis_name=SP_AXIS)
+    elif attention == "ring_flash":
+        from .ring_flash import ring_flash_attention
+        attn = functools.partial(ring_flash_attention, axis_name=SP_AXIS)
     elif attention == "ulysses":
         attn = functools.partial(ulysses_attention, axis_name=SP_AXIS)
     elif attention == "flash":

@@ -196,10 +196,15 @@ def make_sp_attention(mesh: Mesh, kind: str = "ring", *,
 
     Returns ``attn(q, k, v)`` taking [B, T, H, D] arrays (batch sharded
     over dp, sequence over sp) and returning the same.  ``kind`` is
-    "ring" or "ulysses".
+    "ring", "ring_flash" (flash block kernels riding the ring,
+    parallel/ring_flash.py), or "ulysses".
     """
     if kind == "ring":
         inner = functools.partial(ring_attention, axis_name=SP_AXIS,
+                                  causal=causal, sm_scale=sm_scale)
+    elif kind == "ring_flash":
+        from .ring_flash import ring_flash_attention
+        inner = functools.partial(ring_flash_attention, axis_name=SP_AXIS,
                                   causal=causal, sm_scale=sm_scale)
     elif kind == "ulysses":
         inner = functools.partial(ulysses_attention, axis_name=SP_AXIS,
