@@ -63,3 +63,10 @@ from .tensor_parallel import (  # noqa: F401
     shard_gpt_params,
     shard_tp_batch,
 )
+from .three_d import (  # noqa: F401
+    init_3d_opt_state,
+    make_3d_mesh,
+    make_dp_pp_tp_train_step,
+    shard_3d_batch,
+    shard_3d_params,
+)

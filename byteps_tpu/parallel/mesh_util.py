@@ -29,12 +29,19 @@ def make_2d_mesh(devices: Optional[Sequence], n_inner: int,
 
 
 def jit_mapped_step(mesh: Mesh, step: Callable, spec_of: Callable,
-                    batch_spec, donate: bool = True) -> Callable:
+                    batch_spec, donate: bool = True,
+                    axis_names=None) -> Callable:
     """Wrap a ``step(params, opt_state, batch)`` body in shard_map + jit
     with specs derived from the ACTUAL pytrees on first call (optimizer
     states are optax-defined wrappers a static prefix-spec cannot
     describe).  ``spec_of(tree)`` returns the PartitionSpec tree for any
     params-like pytree; the loss output is replicated.
+
+    ``axis_names`` optionally restricts which mesh axes the shard_map
+    treats as manual; the rest stay auto — GSPMD propagates their
+    shardings through the body and places their collectives (the hybrid
+    the (dp, pp, tp) composite uses: schedule pinned by hand over dp/pp,
+    tensor parallelism left to the compiler over tp).
 
     check_vma=True is load-bearing, not hygiene: these steps normalize
     their loss with collectives INSIDE the differentiated region, and
@@ -44,6 +51,7 @@ def jit_mapped_step(mesh: Mesh, step: Callable, spec_of: Callable,
     by the step-for-step parity tests of pipeline/expert parallelism.)
     """
     cache = {}
+    extra = {} if axis_names is None else {"axis_names": axis_names}
 
     def wrapper(params, opt_state, batch):
         key = (jax.tree.structure(params), jax.tree.structure(opt_state))
@@ -56,6 +64,7 @@ def jit_mapped_step(mesh: Mesh, step: Callable, spec_of: Callable,
                 in_specs=(p_spec, o_spec, batch_spec),
                 out_specs=(p_spec, o_spec, P()),
                 check_vma=True,
+                **extra,
             )
             fn = cache[key] = jax.jit(
                 mapped, donate_argnums=(0, 1) if donate else ())

@@ -101,10 +101,24 @@ def make_dp_pp_train_step(mesh: Mesh, cfg: GPTConfig,
     ``batch["input_ids"]/["labels"]`` are [B, T] with the per-dp-shard
     B divisible by ``num_microbatches``.
     """
+    step = make_step_body(cfg, tx, num_microbatches,
+                          n_pp=mesh.shape[PP_AXIS])
+    # _spec_like marks every leaf under a "blocks" path as stage-sharded
+    # and the rest replicated; jit_mapped_step (mesh_util) derives specs
+    # from the actual pytrees and runs with VMA tracking ON (see its
+    # docstring for why that is load-bearing for gradients here).
+    return jit_mapped_step(mesh, step, _spec_like, P(DP_AXIS, None),
+                           donate=donate)
+
+
+def make_step_body(cfg: GPTConfig, tx: optax.GradientTransformation,
+                   num_microbatches: int, n_pp: int) -> Callable:
+    """The GPipe step body, shard_map-agnostic: used verbatim by the
+    (dp, pp) step above and the (dp, pp, tp) composite (three_d.py),
+    which differ only in which mesh axes are manual."""
     block = Block(cfg)
     embed_mod = _EmbedIn(cfg)
     head_mod = _Head(cfg)
-    n_pp = mesh.shape[PP_AXIS]
     if cfg.num_layers % n_pp:
         raise ValueError(
             f"{cfg.num_layers} layers not divisible by pp={n_pp}")
@@ -185,12 +199,7 @@ def make_dp_pp_train_step(mesh: Mesh, cfg: GPTConfig,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    # _spec_like marks every leaf under a "blocks" path as stage-sharded
-    # and the rest replicated; jit_mapped_step (mesh_util) derives specs
-    # from the actual pytrees and runs with VMA tracking ON (see its
-    # docstring for why that is load-bearing for gradients here).
-    return jit_mapped_step(mesh, step, _spec_like, P(DP_AXIS, None),
-                           donate=donate)
+    return step
 
 
 def _spec_like(tree):

@@ -1,0 +1,127 @@
+"""3D composite parallelism: (dp, pp, tp) — data x pipeline x tensor.
+
+The two mechanisms the 2-axis modules already pin are composed on one
+mesh, each in its own idiom (the contrast docs/architecture.md draws,
+now in a single program):
+
+- **dp + pp are manual**: the GPipe schedule (microbatch ticks as
+  ``lax.scan``, activation hops as ``lax.ppermute``, bubbles masked from
+  the loss) is hand-pinned inside ``shard_map`` exactly as in
+  `pipeline.py` — the schedule IS the feature, so the program states it.
+- **tp stays auto**: block/embedding/head weights carry Megatron-style
+  shardings on their inner dims (`tensor_parallel.py`'s rules, shifted
+  one axis right under the stacked layer dim), and ``shard_map``'s
+  ``axis_names={'dp', 'pp'}`` leaves the tp axis to GSPMD — XLA
+  propagates the shardings through the stage compute and places the
+  per-layer tp collectives itself.
+
+The reference is DP-only (SURVEY.md §2.6); this is the full 3D layout a
+TPU pod actually trains large models with.  Parity contract: training
+from restacked+sharded parameters matches plain single-device GPT
+training step for step (tests/test_three_d.py), the same oracle the pp
+and tp tests use individually.
+
+Known issue (CPU simulation only): this image's XLA **CPU** backend
+aborts with a compiler CHECK ("Invalid binary instruction opcode copy")
+compiling the composite for **bf16** models — use f32 configs on the
+virtual CPU mesh (tests and the multichip dry-run do).  The CHECK is in
+the CPU emitter; the TPU compile path is separate, but validate bf16 on
+the first real pod run (docs/troubleshooting.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gpt import GPTConfig
+from .mesh_util import jit_mapped_step
+from .pipeline import (PP_AXIS, _spec_like, init_pipeline_params,  # noqa: F401
+                       make_step_body, pipeline_params_to_gpt)
+from .tensor_parallel import TP_AXIS, _path_str, tp_spec_for
+
+DP_AXIS = "dp"
+
+__all__ = [
+    "make_3d_mesh",
+    "shard_3d_params",
+    "shard_3d_batch",
+    "init_3d_opt_state",
+    "make_dp_pp_tp_train_step",
+]
+
+
+def make_3d_mesh(devices, n_pp: int, n_tp: int) -> Mesh:
+    """(dp, pp, tp) mesh; tp on the fastest-varying device dimension
+    (its per-layer all-reduces are the most latency-sensitive), pp next
+    (neighbor ppermute hops), dp outermost (once-per-step gradient
+    reduction tolerates the long way around)."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if n_pp * n_tp <= 0 or devs.size % (n_pp * n_tp):
+        raise ValueError(
+            f"{devs.size} devices not divisible by pp*tp = {n_pp}*{n_tp}")
+    return Mesh(devs.reshape(devs.size // (n_pp * n_tp), n_pp, n_tp),
+                (DP_AXIS, PP_AXIS, TP_AXIS))
+
+
+def three_d_shardings(mesh: Mesh, pp_params):
+    """Combined shardings for a pipeline-restacked GPT tree: blocks carry
+    pp on the stacked layer axis AND the Megatron tp rule on their inner
+    dims; embed/head carry the tp rule alone (replicated over pp)."""
+    def spec(path, leaf):
+        ps = _path_str(path)
+        tp = tp_spec_for(ps)
+        if ps.startswith("blocks/"):
+            return NamedSharding(mesh, P(PP_AXIS, *tp))
+        return NamedSharding(mesh, tp)
+    return jax.tree_util.tree_map_with_path(spec, pp_params)
+
+
+def shard_3d_params(mesh: Mesh, pp_params):
+    return jax.device_put(pp_params, three_d_shardings(mesh, pp_params))
+
+
+def shard_3d_batch(mesh: Mesh, batch):
+    return jax.device_put(batch, NamedSharding(mesh, P(DP_AXIS, None)))
+
+
+def init_3d_opt_state(tx: optax.GradientTransformation, sharded_params):
+    """tx.init with moment buffers re-placed onto their parameter's
+    sharding.  A bare ``jit(tx.init)`` leaves zeros_like outputs
+    replicated (no data dependence on the input, so GSPMD propagation
+    has nothing to follow — the same trap parallel/zero.py pins down);
+    matching by shape restores the 1/pp x 1/tp layout.  Shape collisions
+    between differently-sharded params would only cost a reshard, never
+    correctness."""
+    by_shape = {}
+    for leaf in jax.tree.leaves(sharded_params):
+        by_shape.setdefault(leaf.shape, leaf.sharding)
+    opt_state = jax.jit(tx.init)(sharded_params)
+
+    def fix(leaf):
+        sh = by_shape.get(getattr(leaf, "shape", None))
+        return jax.device_put(leaf, sh) if sh is not None else leaf
+    return jax.tree.map(fix, opt_state)
+
+
+def make_dp_pp_tp_train_step(mesh: Mesh, cfg: GPTConfig,
+                             tx: optax.GradientTransformation,
+                             num_microbatches: int,
+                             donate: bool = True) -> Callable:
+    """Jitted (params, opt_state, batch) -> (params, opt_state, loss)
+    over (dp, pp, tp).
+
+    Params from :func:`init_pipeline_params` placed by
+    :func:`shard_3d_params`; batch by :func:`shard_3d_batch` ([B, T] with
+    the per-dp-shard B divisible by ``num_microbatches``); opt state by
+    :func:`init_3d_opt_state`.  The step body is pipeline.py's GPipe
+    schedule verbatim — only the shard_map's manual-axis set differs.
+    """
+    step = make_step_body(cfg, tx, num_microbatches,
+                          n_pp=mesh.shape[PP_AXIS])
+    return jit_mapped_step(mesh, step, _spec_like, P(DP_AXIS, None),
+                           donate=donate, axis_names={DP_AXIS, PP_AXIS})

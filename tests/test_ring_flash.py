@@ -37,9 +37,12 @@ def test_matches_full_attention(causal, n_sp):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_matches_ring_attention_trajectory():
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_ring_attention_trajectory(causal):
     """Gradients through the manual vjp == gradients through plain ring
-    attention (autodiff through the ppermutes)."""
+    attention (autodiff through the ppermutes), both mask modes —
+    causal=False exercises the unconditional accumulation path of the
+    hand-written backward."""
     b, t, h, d = 2, 64, 2, 32
     q, k, v = _qkv(b, t, h, d, seed=3)
     w = jax.random.normal(jax.random.PRNGKey(9), (b, t, h, d))
@@ -49,7 +52,7 @@ def test_matches_ring_attention_trajectory():
 
     grads = {}
     for kind in ("ring_flash", "ring"):
-        attn = make_sp_attention(mesh, kind=kind, causal=True)
+        attn = make_sp_attention(mesh, kind=kind, causal=causal)
         f = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(attn(q, k, v) * ws), argnums=(0, 1, 2)))
         grads[kind] = f(qs, ks_, vs)
