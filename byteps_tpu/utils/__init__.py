@@ -7,4 +7,5 @@ from .checkpoint import (  # noqa: F401
     save_checkpoint,
 )
 from .failure_detector import HeartbeatMonitor, StepWatchdog  # noqa: F401
+from .prefetch import ShardedBatchLoader, prefetch_to_device  # noqa: F401
 from .timing import Timer, throughput  # noqa: F401

@@ -31,7 +31,8 @@ def main():
     ap.add_argument("--seq", type=int, default=8192)
     ap.add_argument("--sp", type=int, default=None,
                     help="sequence-parallel degree (default: all devices)")
-    ap.add_argument("--attention", choices=("ring", "ulysses"),
+    ap.add_argument("--attention",
+                choices=("ring", "ring_flash", "ulysses"),
                     default="ring")
     ap.add_argument("--tiny", action="store_true")
     args = ap.parse_args()

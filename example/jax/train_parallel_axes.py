@@ -1,13 +1,17 @@
 """Train a small GPT (or MoE layer) under each composite parallelism axis.
 
 The byteps_tpu counterpart of "which axis do I reach for": the same tiny
-model runs under (dp,tp) GSPMD, (dp,pp) GPipe, or a (dp,ep) switch-MoE
-regression — all on whatever devices are visible (8 virtual CPU devices
-in tests; a real slice in production).
+model runs under (dp,tp) GSPMD, (dp,pp) GPipe, a (dp,ep) switch-MoE
+regression, ZeRO-1/FSDP sharded-optimizer DP, or the full 3D
+(dp,pp,tp) composite — all on whatever devices are visible (8 virtual
+CPU devices in tests; a real slice in production).
 
     python example/jax/train_parallel_axes.py --mode tp --steps 10
     python example/jax/train_parallel_axes.py --mode pp --microbatches 4
     python example/jax/train_parallel_axes.py --mode ep --experts 8
+    python example/jax/train_parallel_axes.py --mode zero
+    python example/jax/train_parallel_axes.py --mode fsdp
+    python example/jax/train_parallel_axes.py --mode 3d --microbatches 2
 """
 
 from __future__ import annotations
@@ -24,7 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["tp", "pp", "ep"], default="tp")
+    ap.add_argument("--mode", choices=["tp", "pp", "ep", "zero", "fsdp",
+                                       "3d"], default="tp")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--inner", type=int, default=0,
                     help="size of the tp/pp/ep axis (0 = largest of "
@@ -75,6 +80,57 @@ def main() -> int:
         step = par.make_dp_pp_train_step(
             mesh, cfg, tx, num_microbatches=args.microbatches)
         b = par.shard_pp_batch(mesh, b)
+    elif args.mode in ("zero", "fsdp"):
+        # sharded-optimizer DP: master vector + moments live 1/R across
+        # the whole mesh; fsdp additionally stores params only sharded
+        from byteps_tpu.comm.mesh import CommContext, _build_mesh
+        comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1, n_ici=n)
+        b = par.synthetic_lm_batch(rng, cfg, args.batch, args.seq)
+        model = GPT(cfg)
+        params = model.init(rng, b["input_ids"][:1])
+
+        def loss_fn(p, bb):
+            from byteps_tpu.models.gpt import lm_loss
+            return lm_loss(model.apply(p, bb["input_ids"]), bb["labels"])
+
+        zstate = par.init_zero_state(comm, tx, params)
+        b = par.shard_batch(comm, b)
+        if args.mode == "zero":
+            zstep = par.make_zero_train_step(comm, loss_fn, tx)
+            zp = par.replicate(comm, params)
+
+            def step(p, o, bb):
+                nonlocal zp
+                zp, z, loss = zstep(zp, o, bb)
+                return p, z, loss
+        else:
+            fstep = par.make_fsdp_train_step(comm, loss_fn, tx,
+                                             params_template=params)
+
+            def step(p, o, bb):
+                z, loss = fstep(o, bb)
+                return p, z, loss
+        p, o = None, zstate
+        mesh = comm.mesh
+    elif args.mode == "3d":
+        # honor --inner as the tp size when it fits (pp fixed at 2 when
+        # the device count allows); degrade to trivial axes on small or
+        # odd device counts rather than crashing
+        if args.inner and n % (2 * args.inner) == 0 \
+                and cfg.num_heads % args.inner == 0:
+            n_tp = args.inner
+        else:
+            n_tp = max((d for d in (2, 1) if n % (2 * d) == 0), default=1)
+        n_pp = 2 if n % (2 * n_tp) == 0 else 1
+        inner = n_tp  # reported layout matches what actually ran
+        mesh = par.make_3d_mesh(devices, n_pp=n_pp, n_tp=n_tp)
+        b = par.synthetic_lm_batch(rng, cfg, args.batch, args.seq)
+        p = par.shard_3d_params(
+            mesh, par.init_pipeline_params(cfg, rng, b["input_ids"][:1]))
+        o = par.init_3d_opt_state(tx, p)
+        step = par.make_dp_pp_tp_train_step(
+            mesh, cfg, tx, num_microbatches=args.microbatches)
+        b = par.shard_3d_batch(mesh, b)
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = par.make_ep_mesh(devices, n_ep=inner)
@@ -95,8 +151,10 @@ def main() -> int:
         p, o, loss = step(p, o, b)
         losses.append(float(loss))
     assert np.isfinite(losses[-1])
+    layout = {"3d": lambda: f"pp{mesh.shape['pp']}xtp{mesh.shape['tp']}"}
     print(json.dumps({
-        "mode": args.mode, "n_devices": n, "inner_axis": inner,
+        "mode": args.mode, "n_devices": n,
+        "inner_axis": layout.get(args.mode, lambda: inner)(),
         "first_loss": round(losses[0], 4), "last_loss": round(losses[-1], 4),
         "wall_s": round(time.perf_counter() - t0, 2),
     }))

@@ -29,9 +29,19 @@ def _run(path, *argv):
       "--microbatches", "2")),
     ("example/jax/train_parallel_axes.py",
      ("--mode", "ep", "--steps", "2", "--batch", "4", "--experts", "8")),
+    ("example/jax/train_parallel_axes.py",
+     ("--mode", "zero", "--steps", "2", "--batch", "8", "--seq", "16")),
+    ("example/jax/train_parallel_axes.py",
+     ("--mode", "fsdp", "--steps", "2", "--batch", "8", "--seq", "16")),
+    ("example/jax/train_parallel_axes.py",
+     ("--mode", "3d", "--steps", "2", "--batch", "8", "--seq", "16",
+      "--microbatches", "2")),
     ("example/jax/train_long_context.py",
      ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
       "--batch", "4")),
+    ("example/jax/train_long_context.py",
+     ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
+      "--batch", "4", "--attention", "ring_flash")),
     ("example/pytorch/train_mnist_byteps.py", ("--steps", "2")),
     ("example/pytorch/benchmark_byteps.py",
      ("--num-iters", "1", "--num-tensors", "2", "--tensor-mb", "0.1")),
