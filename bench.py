@@ -547,7 +547,10 @@ def _probe(timeout: float):
     return None, (tail[-1] if tail else f"probe rc={p.returncode}")
 
 
-def _run_inner(extra_env=None, timeout=1500.0):
+_INNER_TIMEOUT = 2400.0  # full TPU bench incl. flash section, loaded host
+
+
+def _run_inner(extra_env=None, timeout=_INNER_TIMEOUT):
     env = dict(os.environ)
     env.update(extra_env or {})
     try:
@@ -600,11 +603,11 @@ def main() -> int:
     for attempt, probe_timeout in enumerate((240.0, 60.0)):
         info, err = _probe(probe_timeout)
         if info is not None:
-            line, err = _run_inner(timeout=1500.0)
+            line, err = _run_inner()
             if line is None:
                 errors.append(f"bench on {info['platform']} failed: {err}")
                 # one retry of the full bench for transient failures
-                line, err = _run_inner(timeout=1500.0)
+                line, err = _run_inner()
             if line is not None:
                 print(_merge_dcn_compare(line))
                 return 0

@@ -50,8 +50,8 @@ def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
     ``batch`` holds ``input_ids`` and ``labels`` (both [B, T], labels
     already shifted, -1 = ignore), sharded via :func:`shard_lm_batch`.
     ``attention`` is "ring", "ring_flash" (ring rotation with Pallas
-    flash block kernels), "ulysses", or "flash" (local flash kernels,
-    sp=1 only).
+    flash block kernels), "ulysses", "ulysses_flash", or "flash" (local
+    flash kernels, sp=1 only).
     """
     if attention == "ring":
         attn = functools.partial(ring_attention, axis_name=SP_AXIS)
@@ -60,6 +60,10 @@ def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
         attn = functools.partial(ring_flash_attention, axis_name=SP_AXIS)
     elif attention == "ulysses":
         attn = functools.partial(ulysses_attention, axis_name=SP_AXIS)
+    elif attention == "ulysses_flash":
+        from ..ops.flash_attention import flash_attention
+        attn = functools.partial(ulysses_attention, axis_name=SP_AXIS,
+                                 local_attn=flash_attention)
     elif attention == "flash":
         # Pallas flash kernels as the local attention: valid only when the
         # sequence axis is unsharded (sp=1, long context via dp + remat) —

@@ -130,13 +130,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = SP_AXIS, *,
                       causal: bool = False,
-                      sm_scale: Optional[float] = None) -> jax.Array:
+                      sm_scale: Optional[float] = None,
+                      local_attn: Optional[Callable] = None) -> jax.Array:
     """Ulysses sequence parallelism: all-to-all reshard, exact local attention.
 
     Input shards are [B, T/sp, H, D]; the first all_to_all makes them
-    [B, T, H/sp, D] (full sequence, a slice of heads), attention is exact,
-    and the second all_to_all restores the sequence sharding.  Requires
-    H % sp == 0.  Call inside shard_map.
+    [B, T, H/sp, D] (full sequence, a slice of heads), attention runs
+    locally (exact by default; pass ``local_attn`` — e.g. the Pallas
+    flash kernels — to swap the local math), and the second all_to_all
+    restores the sequence sharding.  Requires H % sp == 0.  Call inside
+    shard_map.
     """
     n = lax.axis_size(axis_name)
     if q.shape[2] % n:
@@ -151,8 +154,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    attn = local_attn if local_attn is not None else full_attention
     qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
-    out = full_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    out = attn(qg, kg, vg, causal=causal, sm_scale=sm_scale)
     return head_to_seq(out)
 
 
@@ -197,7 +201,8 @@ def make_sp_attention(mesh: Mesh, kind: str = "ring", *,
     Returns ``attn(q, k, v)`` taking [B, T, H, D] arrays (batch sharded
     over dp, sequence over sp) and returning the same.  ``kind`` is
     "ring", "ring_flash" (flash block kernels riding the ring,
-    parallel/ring_flash.py), or "ulysses".
+    parallel/ring_flash.py), "ulysses", or "ulysses_flash" (flash as
+    the local attention after the head reshard).
     """
     if kind == "ring":
         inner = functools.partial(ring_attention, axis_name=SP_AXIS,
@@ -209,6 +214,11 @@ def make_sp_attention(mesh: Mesh, kind: str = "ring", *,
     elif kind == "ulysses":
         inner = functools.partial(ulysses_attention, axis_name=SP_AXIS,
                                   causal=causal, sm_scale=sm_scale)
+    elif kind == "ulysses_flash":
+        from ..ops.flash_attention import flash_attention
+        inner = functools.partial(ulysses_attention, axis_name=SP_AXIS,
+                                  causal=causal, sm_scale=sm_scale,
+                                  local_attn=flash_attention)
     else:
         raise ValueError(f"unknown sequence-parallel kind: {kind!r}")
 

@@ -3,6 +3,7 @@ accounting."""
 
 from .checkpoint import (  # noqa: F401
     CheckpointManager,
+    PendingSave,
     restore_and_broadcast,
     save_checkpoint,
 )

@@ -39,9 +39,19 @@ def _abstract_tree(template: Any):
 
 def _broadcast_from_root(state: Any, root_rank: int) -> Any:
     """Per-leaf broadcast from ``root_rank`` (zero-non-root + sum is how
-    the collective implements it, the reference's broadcast identity)."""
+    the collective implements it, the reference's broadcast identity).
+
+    Without an initialized engine (users driving only the ``parallel``
+    train steps) and a single process, every replica restores the same
+    file — the broadcast is an identity and is skipped."""
+    from ..comm.mesh import comm_initialized, get_comm
+    if not comm_initialized():
+        if jax.process_count() == 1:
+            return state
+        raise RuntimeError(
+            "restore under multi-host needs the comm context for the "
+            "root broadcast — call bps.init() first")
     from ..comm.collectives import broadcast_host
-    from ..comm.mesh import get_comm
     comm = get_comm()
     return jax.tree.map(
         lambda leaf: broadcast_host(comm, leaf, root=root_rank), state)
@@ -49,7 +59,12 @@ def _broadcast_from_root(state: Any, root_rank: int) -> Any:
 
 def _is_root(root_rank: int) -> bool:
     # one numbering scheme only: the engine's global rank (an AND across
-    # different numberings would let two hosts both believe they're root)
+    # different numberings would let two hosts both believe they're root).
+    # Engine not initialized (parallel-module-only users): fall back to
+    # the process index, the only numbering that exists then.
+    from ..comm.mesh import comm_initialized
+    if not comm_initialized():
+        return jax.process_index() == root_rank
     return _api.rank() == root_rank
 
 
@@ -61,22 +76,58 @@ def _save_collectively() -> bool:
     return jax.process_count() > 1
 
 
+class PendingSave:
+    """Handle for an asynchronous checkpoint write.  ``wait()`` blocks
+    until the bytes are durably on disk; saves that were skipped on this
+    process (non-root, single-process mode) report ``owned = False`` and
+    wait() is a no-op."""
+
+    def __init__(self, ckptr=None, owned: bool = False):
+        self._ckptr = ckptr
+        self.owned = owned
+
+    def __bool__(self) -> bool:
+        # preserve the sync API's idiom: truthy == this process owns the
+        # write (a bare object would be truthy on every rank)
+        return self.owned
+
+    def wait(self) -> bool:
+        if self._ckptr is not None:
+            # close() waits for the background write AND releases the
+            # checkpointer's worker resources — a bare
+            # wait_until_finished() would leave one thread pool per save
+            # alive until GC in a save-every-N-steps loop
+            self._ckptr.close()
+            self._ckptr = None
+        return self.owned
+
+
 def save_checkpoint(path: str, state: Any, *, force: bool = True,
-                    root_rank: int = 0) -> bool:
+                    root_rank: int = 0,
+                    asynchronous: bool = False):
     """Write ``state`` (any pytree) to ``path``.
 
-    Single process: root rank writes, others return False immediately (the
+    Single process: root rank writes, others return immediately (the
     reference likewise saves on rank 0 and broadcasts on load).  Multi-host:
     every process calls into orbax (its save is a collective with an
-    internal barrier); orbax writes from the primary host only.  Returns
-    True on the process that owns the write.
+    internal barrier); orbax writes from the primary host only.
+
+    Synchronous (default): returns True on the process that owns the
+    write.  ``asynchronous=True``: device arrays are snapshotted and the
+    serialization/IO runs in orbax's background thread — training
+    continues immediately; returns a :class:`PendingSave` whose
+    ``wait()`` must be called (or a later save issued) before relying on
+    the file.
     """
-    if not _save_collectively() and not _is_root(root_rank):
-        return False
+    owned = _save_collectively() or _is_root(root_rank)
+    if not owned:
+        return PendingSave() if asynchronous else False
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.abspath(path), state, force=force)
-    ckptr.wait_until_finished()
+    if asynchronous:
+        return PendingSave(ckptr, owned=jax.process_index() == 0)
+    ckptr.close()  # waits, then releases the worker pool (see PendingSave)
     return jax.process_index() == 0
 
 
@@ -100,10 +151,11 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 root_rank: int = 0):
+                 root_rank: int = 0, async_save: bool = False):
         import orbax.checkpoint as ocp
         self.directory = os.path.abspath(directory)
         self.root_rank = root_rank
+        self.async_save = async_save
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
@@ -117,15 +169,31 @@ class CheckpointManager:
             return False
         import orbax.checkpoint as ocp
         ok = self._mgr.save(step, args=ocp.args.StandardSave(state))
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
+        # async mode: orbax snapshots the arrays before returning, so the
+        # training loop may donate/overwrite them immediately; IO runs in
+        # the manager's background thread and the next save (or
+        # wait_until_finished / close / restore_latest) joins it
         return bool(ok) and jax.process_index() == 0
 
+    def wait_until_finished(self) -> None:
+        """Block until all in-flight async saves are durable."""
+        self._mgr.wait_until_finished()
+
     def latest_step(self) -> Optional[int]:
+        if self.async_save:
+            # a just-issued async save's step directory is not finalized
+            # until the background write lands — join it first so resume
+            # logic never reads stale metadata
+            self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
     def restore_latest(self, template: Any) -> Tuple[Optional[int], Any]:
         """(step, state-broadcast-from-root); (None, template) when no
         checkpoint exists yet."""
+        if self.async_save:
+            self._mgr.wait_until_finished()  # join in-flight writes
         step = self._mgr.latest_step()
         if step is None:
             return None, template

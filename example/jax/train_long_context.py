@@ -32,7 +32,8 @@ def main():
     ap.add_argument("--sp", type=int, default=None,
                     help="sequence-parallel degree (default: all devices)")
     ap.add_argument("--attention",
-                choices=("ring", "ring_flash", "ulysses"),
+                choices=("ring", "ring_flash", "ulysses",
+                         "ulysses_flash"),
                     default="ring")
     ap.add_argument("--tiny", action="store_true")
     args = ap.parse_args()

@@ -42,6 +42,9 @@ def _run(path, *argv):
     ("example/jax/train_long_context.py",
      ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
       "--batch", "4", "--attention", "ring_flash")),
+    ("example/jax/train_long_context.py",
+     ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
+      "--batch", "4", "--attention", "ulysses_flash")),
     ("example/pytorch/train_mnist_byteps.py", ("--steps", "2")),
     ("example/pytorch/benchmark_byteps.py",
      ("--num-iters", "1", "--num-tensors", "2", "--tensor-mb", "0.1")),

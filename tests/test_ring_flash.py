@@ -104,3 +104,32 @@ def test_long_context_ring_flash_training():
     # gpt_tiny computes in bf16; the softmax decompositions agree to bf16
     np.testing.assert_allclose(losses["ring_flash"], losses["ring"],
                                rtol=5e-3, atol=5e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_exact(causal):
+    """kind='ulysses_flash': flash kernels as the local attention after
+    the all-to-all head reshard; forward and grads match exact."""
+    b, t, h, d = 2, 128, 4, 32  # heads divisible by sp
+    q, k, v = _qkv(b, t, h, d, seed=7)
+    w = jax.random.normal(jax.random.PRNGKey(8), (b, t, h, d))
+    mesh = make_sp_mesh(jax.devices()[:8], n_sp=4)
+    sh = NamedSharding(mesh, P(DP_AXIS, SP_AXIS))
+    qs, ks_, vs, ws = jax.device_put((q, k, v, w), sh)
+
+    attn = make_sp_attention(mesh, kind="ulysses_flash", causal=causal)
+    got = attn(qs, ks_, vs)
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(attn(q, k, v) * ws),
+        argnums=(0, 1, 2)))(qs, ks_, vs)
+    ref_attn = make_sp_attention(mesh, kind="ulysses", causal=causal)
+    ge = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(ref_attn(q, k, v) * ws),
+        argnums=(0, 1, 2)))(qs, ks_, vs)
+    for a, b_ in zip(g, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
