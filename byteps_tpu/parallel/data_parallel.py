@@ -45,7 +45,8 @@ def make_dp_train_step(comm: CommContext,
                        loss_fn: Callable,
                        tx: optax.GradientTransformation,
                        donate: bool = True,
-                       compress_dcn=None) -> Callable:
+                       compress_dcn=None,
+                       accum_steps: int = 1) -> Callable:
     """Build jitted (params, opt_state, batch) -> (params, opt_state, loss).
 
     ``loss_fn(params, batch) -> scalar`` is the per-shard loss (mean over
@@ -53,11 +54,44 @@ def make_dp_train_step(comm: CommContext,
     framework's push_pull; ``compress_dcn`` optionally applies a compressor
     pair to the inter-slice hop via hierarchical_push_pull (SURVEY.md §7
     two-level scheme).
+
+    ``accum_steps > 1`` is the fused-path gradient accumulation (the
+    reference's ``backward_passes_per_step``, torch/__init__.py:176-210,
+    and DDP ``no_sync``): the per-shard batch splits into ``accum_steps``
+    microbatches scanned locally — activation memory drops by the same
+    factor — and ONE push_pull + optimizer update runs on the averaged
+    gradient, exactly as the reference defers communication until the
+    last backward pass.
     """
     axes = comm.dp_axes
 
+    def local_grads(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+        for leaf in jax.tree.leaves(batch):
+            if leaf.shape[0] % accum_steps:
+                raise ValueError(
+                    f"per-shard batch {leaf.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps} (global batch must be a "
+                    f"multiple of ranks * accum_steps)")
+        split = jax.tree.map(
+            lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps)
+                                + x.shape[1:]), batch)
+
+        def micro(carry, mb):
+            loss_acc, grad_acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            return (loss_acc + loss,
+                    jax.tree.map(jnp.add, grad_acc, grads)), None
+
+        zero = jax.tree.map(jnp.zeros_like, params)
+        (loss_sum, grad_sum), _ = lax.scan(micro, (0.0, zero), split)
+        scale = 1.0 / accum_steps
+        return loss_sum * scale, jax.tree.map(
+            lambda g: g * scale, grad_sum)
+
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = local_grads(params, batch)
         if compress_dcn is not None:
             from ..ops import hierarchical_push_pull
             comp, decomp = compress_dcn

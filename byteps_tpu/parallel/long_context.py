@@ -15,7 +15,6 @@ skew the objective.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
@@ -26,8 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gpt import GPT, GPTConfig, token_nll
 from ..ops import push_pull_tree
-from .sequence import (DP_AXIS, SP_AXIS, ring_attention,
-                       ulysses_attention)
+from .sequence import DP_AXIS, SP_AXIS
 
 
 def shard_lm_batch(mesh: Mesh, batch):
@@ -53,29 +51,8 @@ def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
     flash block kernels), "ulysses", "ulysses_flash", or "flash" (local
     flash kernels, sp=1 only).
     """
-    if attention == "ring":
-        attn = functools.partial(ring_attention, axis_name=SP_AXIS)
-    elif attention == "ring_flash":
-        from .ring_flash import ring_flash_attention
-        attn = functools.partial(ring_flash_attention, axis_name=SP_AXIS)
-    elif attention == "ulysses":
-        attn = functools.partial(ulysses_attention, axis_name=SP_AXIS)
-    elif attention == "ulysses_flash":
-        from ..ops.flash_attention import flash_attention
-        attn = functools.partial(ulysses_attention, axis_name=SP_AXIS,
-                                 local_attn=flash_attention)
-    elif attention == "flash":
-        # Pallas flash kernels as the local attention: valid only when the
-        # sequence axis is unsharded (sp=1, long context via dp + remat) —
-        # a sharded sequence needs the ring/Ulysses collectives.
-        if mesh.shape[SP_AXIS] != 1:
-            raise ValueError(
-                f"attention='flash' runs local attention and needs sp=1; "
-                f"this mesh has sp={mesh.shape[SP_AXIS]} — use 'ring' or "
-                f"'ulysses' for a sharded sequence axis")
-        from ..ops.flash_attention import flash_attention as attn
-    else:
-        raise ValueError(f"unknown attention kind: {attention!r}")
+    from .sequence import resolve_sp_attention
+    attn = resolve_sp_attention(attention, mesh=mesh)
     model = GPT(cfg, attn_fn=attn)
     axes = (DP_AXIS, SP_AXIS)
 

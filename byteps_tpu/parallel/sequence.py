@@ -193,6 +193,36 @@ def sp_mesh_from_comm(comm, n_sp: Optional[int] = None) -> Mesh:
     return make_sp_mesh(comm.mesh.devices.reshape(-1), n_sp)
 
 
+def resolve_sp_attention(kind: str, *, mesh: Optional[Mesh] = None,
+                         axis_name: str = SP_AXIS, **bound) -> Callable:
+    """The one attention-kind switch, shared by make_sp_attention and the
+    (dp, sp) train step: "ring", "ring_flash", "ulysses",
+    "ulysses_flash", or "flash" (local kernels; needs sp=1, checked when
+    ``mesh`` is given).  ``bound`` kwargs (causal, sm_scale) are bound
+    onto the callable; unbound ones are forwarded by the caller."""
+    if kind == "ring":
+        fn = ring_attention
+    elif kind == "ring_flash":
+        from .ring_flash import ring_flash_attention as fn
+    elif kind == "ulysses":
+        fn = ulysses_attention
+    elif kind == "ulysses_flash":
+        from ..ops.flash_attention import flash_attention
+        return functools.partial(ulysses_attention, axis_name=axis_name,
+                                 local_attn=flash_attention, **bound)
+    elif kind == "flash":
+        if mesh is not None and mesh.shape[axis_name] != 1:
+            raise ValueError(
+                f"attention='flash' runs local attention and needs sp=1; "
+                f"this mesh has sp={mesh.shape[axis_name]} — use 'ring' "
+                f"or 'ulysses' for a sharded sequence axis")
+        from ..ops.flash_attention import flash_attention
+        return functools.partial(flash_attention, **bound)
+    else:
+        raise ValueError(f"unknown sequence-parallel kind: {kind!r}")
+    return functools.partial(fn, axis_name=axis_name, **bound)
+
+
 def make_sp_attention(mesh: Mesh, kind: str = "ring", *,
                       causal: bool = False,
                       sm_scale: Optional[float] = None) -> Callable:
@@ -204,23 +234,8 @@ def make_sp_attention(mesh: Mesh, kind: str = "ring", *,
     parallel/ring_flash.py), "ulysses", or "ulysses_flash" (flash as
     the local attention after the head reshard).
     """
-    if kind == "ring":
-        inner = functools.partial(ring_attention, axis_name=SP_AXIS,
-                                  causal=causal, sm_scale=sm_scale)
-    elif kind == "ring_flash":
-        from .ring_flash import ring_flash_attention
-        inner = functools.partial(ring_flash_attention, axis_name=SP_AXIS,
-                                  causal=causal, sm_scale=sm_scale)
-    elif kind == "ulysses":
-        inner = functools.partial(ulysses_attention, axis_name=SP_AXIS,
-                                  causal=causal, sm_scale=sm_scale)
-    elif kind == "ulysses_flash":
-        from ..ops.flash_attention import flash_attention
-        inner = functools.partial(ulysses_attention, axis_name=SP_AXIS,
-                                  causal=causal, sm_scale=sm_scale,
-                                  local_attn=flash_attention)
-    else:
-        raise ValueError(f"unknown sequence-parallel kind: {kind!r}")
+    inner = resolve_sp_attention(kind, mesh=mesh, causal=causal,
+                                 sm_scale=sm_scale)
 
     spec = P(DP_AXIS, SP_AXIS, None, None)
     return jax.shard_map(

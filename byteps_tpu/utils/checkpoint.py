@@ -77,10 +77,15 @@ def _save_collectively() -> bool:
 
 
 class PendingSave:
-    """Handle for an asynchronous checkpoint write.  ``wait()`` blocks
-    until the bytes are durably on disk; saves that were skipped on this
-    process (non-root, single-process mode) report ``owned = False`` and
-    wait() is a no-op."""
+    """Handle for an asynchronous checkpoint write.
+
+    **Every process that received one must call ``wait()``** — it joins
+    the background write and releases the checkpointer's worker pool
+    (under multi-host, non-primary processes participate in the
+    collective save and hold live resources even though they own no
+    file).  Use the return value of ``wait()`` — or truthiness /
+    ``.owned`` — for root-gated logic like "upload the checkpoint I
+    wrote"; do NOT use truthiness to decide whether to call wait()."""
 
     def __init__(self, ckptr=None, owned: bool = False):
         self._ckptr = ckptr
@@ -116,8 +121,8 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True,
     write.  ``asynchronous=True``: device arrays are snapshotted and the
     serialization/IO runs in orbax's background thread — training
     continues immediately; returns a :class:`PendingSave` whose
-    ``wait()`` must be called (or a later save issued) before relying on
-    the file.
+    ``wait()`` must be called on EVERY process (it both joins the write
+    and releases the worker pool) before relying on the file.
     """
     owned = _save_collectively() or _is_root(root_rank)
     if not owned:
@@ -192,9 +197,7 @@ class CheckpointManager:
     def restore_latest(self, template: Any) -> Tuple[Optional[int], Any]:
         """(step, state-broadcast-from-root); (None, template) when no
         checkpoint exists yet."""
-        if self.async_save:
-            self._mgr.wait_until_finished()  # join in-flight writes
-        step = self._mgr.latest_step()
+        step = self.latest_step()  # joins in-flight async writes
         if step is None:
             return None, template
         import orbax.checkpoint as ocp

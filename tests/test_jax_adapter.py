@@ -189,3 +189,41 @@ def test_push_pull_tree_roundtrip(session):
     np.testing.assert_allclose(np.asarray(out["a"]), 8.0)
     np.testing.assert_allclose(np.asarray(out["b"]["c"]), 16.0)
     assert out["a"].shape == (3,)
+
+
+def test_fused_step_accum_matches_full_batch(session):
+    """make_dp_train_step(accum_steps=k): scanning k microbatches locally
+    with one push_pull at the end == the one-shot full-batch step (the
+    reference's backward_passes_per_step, in the fused path)."""
+    from byteps_tpu.comm.mesh import get_comm
+    from byteps_tpu.parallel import make_dp_train_step, replicate, shard_batch
+
+    comm = get_comm()
+    model, params = _init_model()
+    loss = _loss_fn(model)
+    x, y = _data()
+    tx = optax.adam(1e-2)
+
+    def loss_fn(p, b):
+        return loss(p, b["x"], b["y"])
+
+    results = {}
+    for k in (1, 2, 4):
+        step = make_dp_train_step(comm, loss_fn, tx, donate=False,
+                                  accum_steps=k)
+        p = replicate(comm, params)
+        o = replicate(comm, tx.init(params))
+        b = shard_batch(comm, {"x": x, "y": y})
+        losses = []
+        for _ in range(3):
+            p, o, l_ = step(p, o, b)
+            losses.append(float(l_))
+        results[k] = (losses, p)
+
+    for k in (2, 4):
+        np.testing.assert_allclose(results[k][0], results[1][0],
+                                   rtol=1e-5, atol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            results[k][1], results[1][1])
