@@ -42,7 +42,8 @@ Optimizer contract: ``tx.update`` runs on the 1/R gradient shard inside
 shard_map.  Elementwise transforms (sgd, adam/adamw, weight decay, lr
 schedules) are exact; transforms that compute a whole-tree statistic must
 be sharding-aware — use :func:`clip_by_global_norm` from this module in
-place of ``optax.clip_by_global_norm``.
+place of ``optax.clip_by_global_norm``, passing the same ``shard_axes``
+as the train step.
 """
 
 from __future__ import annotations
@@ -69,20 +70,26 @@ __all__ = [
 ]
 
 
-def clip_by_global_norm(max_norm: float,
-                        comm: CommContext) -> optax.GradientTransformation:
+def clip_by_global_norm(max_norm: float, comm: CommContext,
+                        shard_axes: str = "all"
+                        ) -> optax.GradientTransformation:
     """Sharding-aware replacement for ``optax.clip_by_global_norm``.
 
     The ZeRO steps call ``tx.update`` on the 1/R gradient SHARD inside
     shard_map, so any transform that computes a whole-tree statistic sees
     only its shard — ``optax.clip_by_global_norm`` would clip each shard
     by a different, wrong norm.  This variant psums the squared norm over
-    the DP axes first (a scalar — free next to the gradient collectives),
-    so the clip matches the replicated-DP trajectory exactly.  Outside
-    shard_map (no axes bound) it degrades to the plain global norm and is
+    the SHARD axes first (a scalar — free next to the gradient
+    collectives), so the clip matches the replicated-DP trajectory
+    exactly.  ``shard_axes`` must match the train step's: under HSDP
+    ("ici") each shard is replicated across dcn, and psumming over both
+    DP axes would count every shard n_dcn times — norm inflated by
+    sqrt(n_dcn), gradients silently over-clipped (invisible with adam,
+    which is scale-invariant; visible with sgd).  Outside shard_map (no
+    axes bound) it degrades to the plain global norm and is
     interchangeable with the optax original.
     """
-    axes = comm.dp_axes
+    axes, _, _ = _resolve_axes(comm, shard_axes)
 
     def init_fn(params):
         del params
@@ -119,14 +126,35 @@ def _padded_size(n: int, ranks: int) -> int:
     return (n + quantum - 1) // quantum * quantum
 
 
+def _resolve_axes(comm: CommContext, shard_axes: str):
+    """(scatter/gather axes, remaining-sum axes, shard count).
+
+    "all": shard over every DP axis — minimum memory (1/R).
+    "ici": HSDP / hybrid sharding — shard within a slice, replicate
+    across slices: the per-step all_gather/psum_scatter ride ICI only,
+    and DCN carries just a psum of the 1/n_ici gradient shard (the
+    layout multi-slice pods want when DCN bandwidth, not HBM, is the
+    constraint).
+    """
+    from ..comm.mesh import DCN_AXIS, ICI_AXIS
+    if shard_axes == "all":
+        return comm.dp_axes, (), comm.num_ranks
+    if shard_axes == "ici":
+        return (ICI_AXIS,), (DCN_AXIS,), comm.n_ici
+    raise ValueError(
+        f"shard_axes must be 'all' or 'ici', got {shard_axes!r}")
+
+
 def init_zero_state(comm: CommContext, tx: optax.GradientTransformation,
-                    params) -> ZeroState:
+                    params, shard_axes: str = "all") -> ZeroState:
     """Build the sharded master vector + optimizer state from a params
-    pytree (replicated or host-resident)."""
+    pytree (replicated or host-resident).  ``shard_axes`` must match the
+    train step's (see :func:`_resolve_axes`)."""
+    axes, _, nsh = _resolve_axes(comm, shard_axes)
     vec, _ = ravel_pytree(params)
-    padded = _padded_size(vec.size, comm.num_ranks)
+    padded = _padded_size(vec.size, nsh)
     master = jnp.pad(vec.astype(jnp.float32), (0, padded - vec.size))
-    sh = NamedSharding(comm.mesh, P(comm.dp_axes))
+    sh = NamedSharding(comm.mesh, P(axes))
     master = jax.device_put(master, sh)
     # Pin the optimizer-state shardings: zeros_like outputs carry no data
     # dependence on the input, so XLA propagation would replicate them.
@@ -168,7 +196,8 @@ def _cast_like_template(tree, compute_dtype):
 
 def make_zero_train_step(comm: CommContext, loss_fn: Callable,
                          tx: optax.GradientTransformation,
-                         donate: bool = True) -> Callable:
+                         donate: bool = True,
+                         shard_axes: str = "all") -> Callable:
     """ZeRO-1: ``(params, zstate, batch) -> (params, zstate, loss)``.
 
     ``params`` stay replicated in their own (compute) dtype and are
@@ -176,20 +205,25 @@ def make_zero_train_step(comm: CommContext, loss_fn: Callable,
     mixed-precision master-weight training for free.  ``loss_fn(params,
     batch) -> scalar`` is the per-shard loss, as in
     :func:`~byteps_tpu.parallel.make_dp_train_step`.
+    ``shard_axes="ici"`` is HSDP: master/optimizer shards stay within a
+    slice (gather rides ICI; DCN carries only a shard-sized psum).
     """
-    axes = comm.dp_axes
+    axes, extra, nsh = _resolve_axes(comm, shard_axes)
     ranks = comm.num_ranks
     cache: dict = {}
 
     def step(params, master, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         gvec, _ = ravel_pytree(grads)
-        global_len = master.shape[0] * ranks  # master is the 1/R shard here
+        global_len = master.shape[0] * nsh  # master is the 1/nsh shard
         gvec = jnp.pad(gvec.astype(jnp.float32), (0, global_len - gvec.size))
-        # reduce_scatter: each rank receives only its summed shard — half
-        # of the plain all-reduce, the other half is the gather below.
+        # reduce_scatter over the shard axes; any remaining DP axes
+        # (HSDP: dcn) complete the sum with a psum of just the shard
         gshard = lax.psum_scatter(gvec, axes, scatter_dimension=0,
-                                  tiled=True) / ranks
+                                  tiled=True)
+        if extra:
+            gshard = lax.psum(gshard, extra)
+        gshard = gshard / ranks
         updates, opt_state = tx.update(gshard, opt_state, master)
         master = optax.apply_updates(master, updates)
         pvec = lax.all_gather(master, axes, axis=0, tiled=True)
@@ -200,7 +234,7 @@ def make_zero_train_step(comm: CommContext, loss_fn: Callable,
         # cast explicitly: compute params keep their own (e.g. bf16) dtype
         params = jax.tree.map(lambda old, new: new.astype(old.dtype),
                               params, unravel(pvec[:nelems]))
-        return params, master, opt_state, lax.pmean(loss, axes)
+        return params, master, opt_state, lax.pmean(loss, comm.dp_axes)
 
     def wrapper(params, zstate, batch):
         padded = zstate.master.shape[0]
@@ -211,7 +245,7 @@ def make_zero_train_step(comm: CommContext, loss_fn: Callable,
             o_spec = _spec_of_opt(zstate.opt_state, padded, axes)
             mapped = jax.shard_map(
                 step, mesh=comm.mesh,
-                in_specs=(P(), P(axes), o_spec, P(axes)),
+                in_specs=(P(), P(axes), o_spec, P(comm.dp_axes)),
                 out_specs=(P(), P(axes), o_spec, P()),
                 check_vma=False)
             fn = cache[key] = jax.jit(
@@ -227,15 +261,18 @@ def make_fsdp_train_step(comm: CommContext, loss_fn: Callable,
                          tx: optax.GradientTransformation,
                          params_template,
                          compute_dtype: Optional[Any] = None,
-                         donate: bool = True) -> Callable:
+                         donate: bool = True,
+                         shard_axes: str = "all") -> Callable:
     """FSDP / ZeRO-3: ``(zstate, batch) -> (zstate, loss)``.
 
     ``params_template`` is a shape/dtype pytree (e.g. the initial params —
     only structure is read) describing what the gathered vector unravels
     to; ``compute_dtype`` optionally casts floating leaves (bf16 forward
     against the f32 sharded master).  Persistent params memory is 1/R.
+    ``shard_axes="ici"`` is HSDP: shards stay within a slice, so the
+    per-step parameter gather never crosses DCN.
     """
-    axes = comm.dp_axes
+    axes, extra, nsh = _resolve_axes(comm, shard_axes)
     ranks = comm.num_ranks
     nelems, unravel = _unraveler(params_template)
     cache: dict = {}
@@ -246,12 +283,15 @@ def make_fsdp_train_step(comm: CommContext, loss_fn: Callable,
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         gvec, _ = ravel_pytree(grads)
         gvec = jnp.pad(gvec.astype(jnp.float32),
-                       (0, master.shape[0] * ranks - gvec.size))
+                       (0, master.shape[0] * nsh - gvec.size))
         gshard = lax.psum_scatter(gvec, axes, scatter_dimension=0,
-                                  tiled=True) / ranks
+                                  tiled=True)
+        if extra:
+            gshard = lax.psum(gshard, extra)
+        gshard = gshard / ranks
         updates, opt_state = tx.update(gshard, opt_state, master)
         master = optax.apply_updates(master, updates)
-        return master, opt_state, lax.pmean(loss, axes)
+        return master, opt_state, lax.pmean(loss, comm.dp_axes)
 
     def wrapper(zstate, batch):
         padded = zstate.master.shape[0]
@@ -261,7 +301,7 @@ def make_fsdp_train_step(comm: CommContext, loss_fn: Callable,
             o_spec = _spec_of_opt(zstate.opt_state, padded, axes)
             mapped = jax.shard_map(
                 step, mesh=comm.mesh,
-                in_specs=(P(axes), o_spec, P(axes)),
+                in_specs=(P(axes), o_spec, P(comm.dp_axes)),
                 out_specs=(P(axes), o_spec, P()),
                 check_vma=False)
             fn = cache[key] = jax.jit(
@@ -273,25 +313,27 @@ def make_fsdp_train_step(comm: CommContext, loss_fn: Callable,
 
 
 def zero_params(comm: CommContext, zstate: ZeroState, params_template,
-                compute_dtype: Optional[Any] = None):
+                compute_dtype: Optional[Any] = None,
+                shard_axes: str = "all"):
     """Materialize the replicated params pytree from a sharded master
     (checkpoint export, evaluation) — the FSDP analog of the reference's
     broadcast-after-restore consistency step (torch/__init__.py
     broadcast_parameters).  Compiled once per (structure, length) and
     cached on the CommContext, since eval/checkpoint loops call this
     repeatedly."""
+    axes, _, _ = _resolve_axes(comm, shard_axes)
     key = ("zero_params", jax.tree.structure(params_template),
-           zstate.master.shape[0])
+           zstate.master.shape[0], axes)
     fn = comm.jit_cache.get(key)
     if fn is None:
         nelems, unravel = _unraveler(params_template)
 
         def gather(master):
-            vec = lax.all_gather(master, comm.dp_axes, axis=0, tiled=True)
+            vec = lax.all_gather(master, axes, axis=0, tiled=True)
             return unravel(vec[:nelems])
 
         fn = comm.jit_cache[key] = jax.jit(jax.shard_map(
-            gather, mesh=comm.mesh, in_specs=P(comm.dp_axes), out_specs=P(),
+            gather, mesh=comm.mesh, in_specs=P(axes), out_specs=P(),
             check_vma=False))
     out = jax.tree.map(lambda t, new: new.astype(jnp.result_type(t)),
                        params_template, fn(zstate.master))

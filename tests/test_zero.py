@@ -183,3 +183,68 @@ def test_sharded_clip_by_global_norm(comm):
         p, zstate, loss = zstep(p, zstate, batch)
         losses.append(float(loss))
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+
+def test_hsdp_ici_sharding_matches_fused_dp(comm):
+    """shard_axes='ici' (HSDP): master sharded within a slice, replicated
+    across dcn — trajectory still matches replicated DP; layout shows
+    n_ici-way shards replicated across the dcn axis."""
+    model, params, loss_fn, batch = _setup(comm)
+    tx = optax.adam(1e-2)
+    _, ref_losses = _run_dp_reference(comm, params, loss_fn, batch, tx,
+                                      steps=4)
+
+    zstep = make_zero_train_step(comm, loss_fn, tx, donate=False,
+                                 shard_axes="ici")
+    zstate = init_zero_state(comm, tx, params, shard_axes="ici")
+    padded = zstate.master.shape[0]
+    assert padded % (4 * 128) == 0              # n_ici = 4
+    # 8 addressable shards, but only 4 DISTINCT ones (dcn replicas)
+    assert len(zstate.master.addressable_shards) == 8
+    assert zstate.master.addressable_shards[0].data.shape == (padded // 4,)
+    p = replicate(comm, params)
+    losses = []
+    for _ in range(4):
+        p, zstate, loss = zstep(p, zstate, batch)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+    fstep = make_fsdp_train_step(comm, loss_fn, tx, params_template=params,
+                                 donate=False, shard_axes="ici")
+    fstate = init_zero_state(comm, tx, params, shard_axes="ici")
+    flosses = []
+    for _ in range(4):
+        fstate, loss = fstep(fstate, batch)
+        flosses.append(float(loss))
+    np.testing.assert_allclose(flosses, ref_losses, rtol=1e-5)
+    out = zero_params(comm, fstate, params, shard_axes="ici")
+    assert np.isfinite(np.asarray(jax.tree.leaves(out)[0])).all()
+
+
+def test_hsdp_clip_by_global_norm_sgd(comm):
+    """HSDP + sharded clip with SGD (adam is scale-invariant and would
+    mask a wrong norm): shard_axes='ici' clip must psum over ici only —
+    counting the dcn replicas would inflate the norm by sqrt(n_dcn) and
+    silently over-clip."""
+    from byteps_tpu.parallel.zero import clip_by_global_norm
+
+    model, params, loss_fn, batch = _setup(comm)
+    max_norm = 0.05
+
+    ref_tx = optax.chain(optax.clip_by_global_norm(max_norm),
+                         optax.sgd(5e-2))
+    _, ref_losses = _run_dp_reference(comm, params, loss_fn, batch,
+                                      ref_tx, steps=6)
+
+    ztx = optax.chain(clip_by_global_norm(max_norm, comm,
+                                          shard_axes="ici"),
+                      optax.sgd(5e-2))
+    zstep = make_zero_train_step(comm, loss_fn, ztx, donate=False,
+                                 shard_axes="ici")
+    zstate = init_zero_state(comm, ztx, params, shard_axes="ici")
+    p = replicate(comm, params)
+    losses = []
+    for _ in range(6):
+        p, zstate, loss = zstep(p, zstate, batch)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
