@@ -117,6 +117,7 @@ class Config:
     trace_start_step: int = 10       # BYTEPS_TRACE_START_STEP
     trace_end_step: int = 20         # BYTEPS_TRACE_END_STEP
     trace_dir: str = "."             # BYTEPS_TRACE_DIR
+    trace_jax: bool = False          # BYTEPS_TRACE_JAX (device profiler)
     telemetry_on: bool = True        # BYTEPS_TELEMETRY_ON
 
     def __post_init__(self):
@@ -164,6 +165,7 @@ class Config:
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "."),
+            trace_jax=_env_bool("BYTEPS_TRACE_JAX", False),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
         )
 

@@ -44,6 +44,17 @@ class Tracer:
         self._lock = threading.Lock()
         self._step: Dict[str, int] = {}   # tensor name -> seen pushes
         self._written_count = 0           # events already on disk
+        # BYTEPS_TRACE_JAX: run jax.profiler over the same step window, so
+        # the device-side timeline (XLA ops, transfers) lands next to the
+        # host-side comm trace — the reference's timeline shows only the
+        # communication stages; on TPU the device view is the other half.
+        self.jax_trace = cfg.trace_jax
+        self._jax_state = "idle"          # idle -> running -> done
+        # profiler calls happen under their own lock WITH the state
+        # transition: transitioning outside the call would let a stop on
+        # the syncer thread interleave with a start on the user thread
+        # and leave an un-stoppable trace
+        self._jax_lock = threading.Lock()
 
     # -- step bookkeeping ---------------------------------------------------
     def on_push(self, name: str) -> int:
@@ -51,7 +62,43 @@ class Tracer:
         (the reference keys its window on per-tensor step counts too)."""
         with self._lock:
             self._step[name] = self._step.get(name, 0) + 1
-            return self._step[name]
+            step = self._step[name]
+        if (self.enabled and self.jax_trace and step >= self.start_step):
+            if step > self.end_step:
+                self._jax_stop()
+            else:
+                self._jax_start()
+        return step
+
+    # -- device profiler window --------------------------------------------
+    def _jax_start(self) -> None:
+        with self._jax_lock:
+            if self._jax_state != "idle":
+                return
+            try:
+                import jax
+                path = os.path.join(self.out_dir, "jax_profile")
+                os.makedirs(path, exist_ok=True)
+                jax.profiler.start_trace(path)
+                self._jax_state = "running"
+                get_logger().info("jax profiler started -> %s", path)
+            except Exception:  # noqa: BLE001 - must never kill a run
+                get_logger().warning("jax profiler failed to start",
+                                     exc_info=True)
+                self._jax_state = "done"
+
+    def _jax_stop(self) -> None:
+        with self._jax_lock:
+            if self._jax_state != "running":
+                return
+            try:
+                import jax
+                jax.profiler.stop_trace()
+                get_logger().info("jax profiler stopped")
+            except Exception:  # noqa: BLE001
+                get_logger().warning("jax profiler failed to stop",
+                                     exc_info=True)
+            self._jax_state = "done"
 
     def _in_window(self, step: int) -> bool:
         return self.start_step <= step <= self.end_step
@@ -85,6 +132,8 @@ class Tracer:
 
     # -- emission -----------------------------------------------------------
     def flush(self, path: Optional[str] = None) -> Optional[str]:
+        if self.jax_trace:
+            self._jax_stop()  # idempotent; engine shutdown ends the window
         with self._lock:
             if not self.enabled:
                 return None

@@ -58,7 +58,7 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..comm.mesh import CommContext
+from ..comm.mesh import CommContext, DCN_AXIS, ICI_AXIS
 
 __all__ = [
     "ZeroState",
@@ -136,7 +136,6 @@ def _resolve_axes(comm: CommContext, shard_axes: str):
     layout multi-slice pods want when DCN bandwidth, not HBM, is the
     constraint).
     """
-    from ..comm.mesh import DCN_AXIS, ICI_AXIS
     if shard_axes == "all":
         return comm.dp_axes, (), comm.num_ranks
     if shard_axes == "ici":

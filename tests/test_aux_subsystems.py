@@ -258,3 +258,35 @@ def test_kv_store_codec_registration_conflicts_and_accounting():
     assert s.wire_bytes == before
     s.clear()
     assert s.wire_bytes == 0
+
+
+def test_jax_profiler_window(tmp_path, monkeypatch):
+    """BYTEPS_TRACE_JAX=1: the device profiler runs over the trace step
+    window and its artifacts land under trace_dir/jax_profile."""
+    import glob
+    import os as _os
+    import jax.numpy as jnp
+    import numpy as np
+
+    monkeypatch.setenv("BYTEPS_TRACE_ON", "1")
+    monkeypatch.setenv("BYTEPS_TRACE_JAX", "1")
+    monkeypatch.setenv("BYTEPS_TRACE_START_STEP", "1")
+    monkeypatch.setenv("BYTEPS_TRACE_END_STEP", "2")
+    monkeypatch.setenv("BYTEPS_TRACE_DIR", str(tmp_path))
+    from byteps_tpu.common.config import reset_config
+    reset_config()
+
+    import byteps_tpu as bps
+    bps.init()
+    try:
+        x = jnp.asarray(np.ones((bps.size(), 256), np.float32))
+        for _ in range(4):  # steps 1..4: window opens at 1, closes past 2
+            bps.push_pull(x, "prof.t")
+    finally:
+        bps.shutdown()
+    host_traces = glob.glob(str(tmp_path / "bps_trace_rank*.json"))
+    assert host_traces, "host comm trace missing"
+    prof_files = [p for p in glob.glob(str(tmp_path / "jax_profile" / "**"),
+                                       recursive=True)
+                  if _os.path.isfile(p)]
+    assert prof_files, "jax profiler artifacts missing"
