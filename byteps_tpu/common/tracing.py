@@ -49,6 +49,13 @@ class Tracer:
         # host-side comm trace — the reference's timeline shows only the
         # communication stages; on TPU the device view is the other half.
         self.jax_trace = cfg.trace_jax
+        if self.jax_trace and not self.enabled:
+            # the profiler window rides the comm-trace step counter, so
+            # without BYTEPS_TRACE_ON it would never open — say so once
+            # instead of silently producing nothing
+            get_logger().warning(
+                "BYTEPS_TRACE_JAX=1 has no effect without BYTEPS_TRACE_ON=1"
+                " (the profiler window follows the trace step window)")
         self._jax_state = "idle"          # idle -> running -> done
         # profiler calls happen under their own lock WITH the state
         # transition: transitioning outside the call would let a stop on
