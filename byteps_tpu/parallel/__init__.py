@@ -1,10 +1,16 @@
 """Parallel training strategies.
 
 The reference implements data parallelism only (SURVEY.md §2.6); this
-package holds its TPU-native equivalent (data_parallel.py: fused DP training
-steps over the (dcn, ici) mesh) plus first-class sequence/context
-parallelism (sequence.py: ring attention over ppermute, Ulysses
-all-to-all) that the reference lacks but long-context TPU training needs.
+package holds its TPU-native equivalent (data_parallel.py: fused DP
+training steps over the (dcn, ici) mesh, with in-step gradient
+accumulation) plus the scale axes the reference lacks but TPU training
+needs: sequence/context parallelism (sequence.py ring/Ulysses;
+ring_flash.py runs the Pallas flash kernels inside the ring), tensor
+(tensor_parallel.py, GSPMD), pipeline (pipeline.py, GPipe in one
+shard_map), expert (expert.py/moe_lm.py, switch-MoE all_to_all),
+ZeRO-1/FSDP/HSDP sharded-optimizer DP (zero.py), and the 3D (dp, pp,
+tp) composite (three_d.py).  Every axis is pinned step-for-step against
+single-device math by its test file.
 """
 
 from .data_parallel import (  # noqa: F401
