@@ -88,6 +88,9 @@ class Config:
     partition_bytes: int = 4096000   # BYTEPS_PARTITION_BYTES (default as reference)
     scheduling_credit: int = 0       # BYTEPS_SCHEDULING_CREDIT; 0 = unlimited window
     enable_priority: bool = True     # priority ordering of chunk dispatch
+    group_size: int = 4              # BYTEPS_GROUP_SIZE: chunks per device
+    #                                  program (reference BYTEPS_NCCL_GROUP_SIZE
+    #                                  batching, nccl_manager.cc:130-134)
 
     # --- compression ---
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES
@@ -145,6 +148,8 @@ class Config:
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
             enable_priority=_env_bool("BYTEPS_ENABLE_PRIORITY", True),
+            group_size=_env_int("BYTEPS_GROUP_SIZE",
+                                _env_int("BYTEPS_NCCL_GROUP_SIZE", 4)),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             use_native=_env_bool("BYTEPS_NATIVE", True),
             use_pallas=_env_bool("BYTEPS_PALLAS", True),

@@ -146,6 +146,10 @@ class ChunkTask:
     # in-graph (sum * scale, before any downcast) and assembly is a pure
     # reshape — no eager divide on the hot path
     scale: Optional[float] = None
+    # the _PendingTensor this chunk belongs to; shared identity lets the
+    # dispatcher group contiguous chunks of one tensor into a single device
+    # program (reference NCCL group batching, nccl_manager.cc:130-134)
+    pending: Any = None
     # tracing (reference recorderTs, scheduled_queue.cc:105-123)
     step: int = 0
     t_enqueue: float = 0.0
@@ -175,6 +179,11 @@ class TensorContext:
     # {"compressor": "onebit", "ef": "vanilla", ...})
     compression_kwargs: Dict[str, str] = dataclasses.field(default_factory=dict)
     compressor: Any = None
+    # scatter-accumulator layout for the buffer-mode engine path:
+    # ([(col_off, col_ln), ...], C) in column units of the [n_ici, C]
+    # view (comm.collectives.scatter_layout), or the string "ineligible"
+    # when the chunk bounds don't admit the column layout
+    scatter_layout: Any = None
     # profiling
     version: int = 0
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)

@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..comm.collectives import push_pull_array, push_pull_array_scaled
+from ..comm.collectives import (_as_stacked, assemble_scatter, pad_stacked,
+                                push_pull_array, push_pull_array_scaled,
+                                push_pull_chunk_scatter, scatter_layout)
 from ..comm.compressed import compressed_all_reduce
 from ..comm.mesh import CommContext
 from ..compression import registry as compression_registry
@@ -63,10 +65,24 @@ class _CompressionSlot:
 
 
 class _PendingTensor:
-    """Accumulates finished chunks of one push_pull until all arrive."""
+    """Accumulates finished chunks of one push_pull until all arrive.
+
+    Two assembly modes:
+
+    - **parts** (single-chunk, compressed, or debug-sample tensors): each
+      finished chunk is kept and concatenated at the end — the round-2
+      design.
+    - **buffer** (uncompressed multi-chunk, the hot path): each chunk's
+      compiled program reduce-scatters its slice into a sharded accumulator
+      in place (donated between dispatches); one assemble program
+      all-gathers, re-orders, scales and reshapes.  ``buf`` is only ever
+      touched by the single dispatcher thread until the final callback
+      fires, after which it is immutable.
+    """
 
     def __init__(self, handle: Handle, ctx: TensorContext, out_shape, op: str,
-                 denom: int):
+                 denom: int, use_buffer: bool = False, comm=None,
+                 scale=None):
         self.handle = handle
         self.ctx = ctx
         self.out_shape = out_shape
@@ -74,14 +90,27 @@ class _PendingTensor:
         self.denom = denom  # divisor applied at assembly (1 = plain sum)
         self.parts: Dict[int, Any] = {}
         self.total = len(ctx.chunk_bounds)
+        self.use_buffer = use_buffer
+        self.buf = None          # dispatcher-owned until completion
+        self.comm = comm
+        self.scale = scale       # fused scale, applied by assemble
+        self._done = 0
         self.lock = threading.Lock()
 
     def complete_part(self, part_idx: int, data) -> bool:
         with self.lock:
+            if self.use_buffer:
+                self._done += 1
+                return self._done == self.total
             self.parts[part_idx] = data
             return len(self.parts) == self.total
 
     def assemble(self):
+        if self.use_buffer:
+            _, C = self.ctx.scatter_layout
+            return assemble_scatter(
+                self.comm, self.buf, self.ctx.num_elems, C, self.out_shape,
+                self.ctx.dtype_name, scale=self.scale, denom=self.denom)
         if self.total == 1:
             flat = self.parts[0]
         else:
@@ -114,6 +143,8 @@ class PushPullEngine:
         self.speed = SpeedMonitor()
         self.tracer = Tracer()
         self._sync_q: "queue.Queue" = queue.Queue()
+        self._group_size = (1 if jax.process_count() > 1
+                            else max(1, cfg.group_size))
         self._running = True
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="bps-dispatch", daemon=True)
@@ -178,7 +209,27 @@ class PushPullEngine:
                 and jnp.issubdtype(np.dtype(stacked.dtype), jnp.inexact)):
             scale = 1.0 / denom
             denom = 1
-        pending = _PendingTensor(handle, ctx, out_shape, op, denom)
+        nchunks = len(ctx.chunk_bounds)
+        # Buffer mode (the hot path): uncompressed multi-chunk tensors ride
+        # the fused slice -> reduce-scatter -> sharded-accumulator chunk
+        # programs; each dispatch consumes the previous accumulator by
+        # donation, and one assemble program gathers/scales/reshapes in a
+        # single order-identical pass.  Debug sampling needs per-chunk
+        # outputs, so it forces parts mode; so do chunk bounds the column
+        # layout can't express (non-power-of-2 meshes).
+        use_buffer = (nchunks > 1 and ctx.compressor is None
+                      and not self.cfg.debug_sample_tensor)
+        if use_buffer and ctx.scatter_layout is None:
+            with ctx.lock:
+                if ctx.scatter_layout is None:
+                    # "ineligible" is a computed-and-rejected marker so the
+                    # layout check runs once per tensor, not once per call
+                    ctx.scatter_layout = (scatter_layout(
+                        ctx.chunk_bounds, self.comm.n_ici) or "ineligible")
+        if use_buffer and ctx.scatter_layout == "ineligible":
+            use_buffer = False
+        pending = _PendingTensor(handle, ctx, out_shape, op, denom,
+                                 use_buffer, comm=self.comm, scale=scale)
         with ctx.lock:
             ctx.version += 1
             version = ctx.version
@@ -189,18 +240,36 @@ class PushPullEngine:
         else:  # keep the hot enqueue path lock-free when tracing is off
             step, t_enq = 0, 0.0
         flat = stacked.reshape(r, -1)
+        if ctx.compressor is None:
+            # Stage to the mesh once; chunk programs slice in-graph (no
+            # per-chunk device_put / eager slice materialization).
+            flat = _as_stacked(self.comm, flat)
         itemsize = np.dtype(stacked.dtype).itemsize
-        nchunks = len(ctx.chunk_bounds)
-        for part_idx, (off, ln) in enumerate(ctx.chunk_bounds):
-            chunk = flat if nchunks == 1 else flat[:, off:off + ln]
+        if use_buffer:
+            # Buffer-mode tasks are COLUMN slabs of the [n_ici, C] view
+            # (offset/num in columns); nbytes stays the chunk's real byte
+            # size for credit/telemetry accounting.
+            col_layout, C = ctx.scatter_layout
+            flat = pad_stacked(self.comm, flat, C * self.comm.n_ici)
+            bounds = col_layout
+            unit = self.comm.n_ici * itemsize
+        else:
+            bounds = ctx.chunk_bounds
+            unit = itemsize
+        for part_idx, (off, ln) in enumerate(bounds):
+            # parts mode (compressed / debug-sample) needs the materialized
+            # chunk; buffer mode and single-chunk tensors pass the full flat
+            chunk = (flat[:, off:off + ln]
+                     if (nchunks > 1 and not use_buffer) else flat)
             task = ChunkTask(
                 name=name, key=ctx.key_list[part_idx], priority=prio,
                 version=version, offset_elems=off, num_elems=ln,
-                nbytes=ln * itemsize, total_parts=nchunks,
+                nbytes=ln * unit, total_parts=nchunks,
                 data=chunk,
                 compression=(ctx.compressor[part_idx]
                              if ctx.compressor else None),
                 scale=scale,
+                pending=pending,
                 step=step, t_enqueue=t_enq,
             )
             task.callback = self._make_chunk_callback(pending, part_idx)
@@ -285,33 +354,92 @@ class PushPullEngine:
             task = self.scheduler.get_task(block=True, timeout=0.05)
             if task is None:
                 continue
-            task.t_dispatch = self.tracer.now()
-            try:
-                slot = task.compression
-                rollback = None
-                if slot is not None:
-                    out, new_wst, new_sst = compressed_all_reduce(
-                        self.comm, task.data, slot.worker, slot.server,
-                        slot.wstates, slot.sstate)
-                    # Commit at dispatch time so a later step of the same
-                    # chunk (which can be dispatched before this one syncs)
-                    # sees the advanced EF/momentum/PRNG state; the syncer
-                    # rolls back to the pre-step snapshot if the async
-                    # execution later fails, so a transient device fault
-                    # does not poison the slot.
-                    rollback = (slot, slot.wstates, slot.sstate)
-                    slot.wstates = new_wst
-                    slot.sstate = new_sst
-                elif task.scale is not None:
-                    out = push_pull_array_scaled(self.comm, task.data,
-                                                 task.scale)
+            # Chunk-group batching (reference BYTEPS_NCCL_GROUP_SIZE,
+            # nccl_manager.cc:130-134): opportunistically pop whatever else
+            # is already eligible, then merge contiguous chunks of the same
+            # tensor into one device program.  Popping preserves priority
+            # order; merging only ever joins neighbors in that order.
+            # Multi-host runs keep group_size=1: merging is timing-
+            # dependent, and SPMD processes must dispatch identical
+            # programs in identical order (the reference pins followers to
+            # the root's order via DO_* socket signals, communicator.h:43).
+            group = self._group_size
+            batch = [task]
+            for _ in range(max(0, group - 1)):
+                t2 = self.scheduler.get_task(block=False)
+                if t2 is None:
+                    break
+                batch.append(t2)
+            i = 0
+            while i < len(batch):
+                t = batch[i]
+                if t.pending is not None and t.pending.use_buffer:
+                    # merge a contiguous equal-width column run of the
+                    # same tensor (the grouped scatter program requires
+                    # equal per-chunk slabs)
+                    run = [t]
+                    j = i + 1
+                    while (j < len(batch)
+                           and batch[j].pending is t.pending
+                           and batch[j].num_elems == t.num_elems
+                           and batch[j].offset_elems
+                           == run[-1].offset_elems + run[-1].num_elems):
+                        run.append(batch[j])
+                        j += 1
+                    self._dispatch_buffer_run(run)
+                    i = j
                 else:
-                    out = push_pull_array(self.comm, task.data, op="sum",
-                                          keep_acc=True)
-                self._sync_q.put((task, out, rollback, None))
-            except Exception as e:  # noqa: BLE001
-                get_logger().error("dispatch failed for %s: %s", task.name, e)
-                self._sync_q.put((task, None, None, e))
+                    self._dispatch_single(t)
+                    i += 1
+
+    def _dispatch_buffer_run(self, run: List[ChunkTask]):
+        """One device program for a contiguous run of column-slab chunks:
+        slice -> reduce-scatter -> write shards into the tensor's
+        block-sharded accumulator (donated, in place)."""
+        t0 = run[0]
+        pending = t0.pending
+        now = self.tracer.now() if self.tracer.enabled else 0.0
+        for t in run:
+            t.t_dispatch = now
+        try:
+            _, C = pending.ctx.scatter_layout
+            buf, token = push_pull_chunk_scatter(
+                self.comm, t0.data, pending.buf, t0.offset_elems,
+                t0.num_elems, len(run), C)
+            pending.buf = buf
+            self._sync_q.put((run, token, None, None))
+        except Exception as e:  # noqa: BLE001
+            get_logger().error("dispatch failed for %s: %s", t0.name, e)
+            self._sync_q.put((run, None, None, e))
+
+    def _dispatch_single(self, task: ChunkTask):
+        task.t_dispatch = self.tracer.now()
+        try:
+            slot = task.compression
+            rollback = None
+            if slot is not None:
+                out, new_wst, new_sst = compressed_all_reduce(
+                    self.comm, task.data, slot.worker, slot.server,
+                    slot.wstates, slot.sstate)
+                # Commit at dispatch time so a later step of the same
+                # chunk (which can be dispatched before this one syncs)
+                # sees the advanced EF/momentum/PRNG state; the syncer
+                # rolls back to the pre-step snapshot if the async
+                # execution later fails, so a transient device fault
+                # does not poison the slot.
+                rollback = (slot, slot.wstates, slot.sstate)
+                slot.wstates = new_wst
+                slot.sstate = new_sst
+            elif task.scale is not None:
+                out = push_pull_array_scaled(self.comm, task.data,
+                                             task.scale)
+            else:
+                out = push_pull_array(self.comm, task.data, op="sum",
+                                      keep_acc=True)
+            self._sync_q.put(([task], out, rollback, None))
+        except Exception as e:  # noqa: BLE001
+            get_logger().error("dispatch failed for %s: %s", task.name, e)
+            self._sync_q.put(([task], None, None, e))
 
     def _sync_loop(self):
         # Exits only on the sentinel, which shutdown enqueues *after* the
@@ -321,9 +449,12 @@ class PushPullEngine:
             item = self._sync_q.get()
             if item is _SHUTDOWN:
                 break
-            task, out, rollback, err = item
+            tasks, out, rollback, err = item
             if err is None:
                 try:
+                    # For buffer runs ``out`` is the completion token, not
+                    # the buffer: the buffer itself may already have been
+                    # donated into a later chunk's program.
                     jax.block_until_ready(out)
                 except Exception as e:  # noqa: BLE001
                     err = e
@@ -331,31 +462,33 @@ class PushPullEngine:
                         slot, wst, sst = rollback
                         slot.wstates = wst
                         slot.sstate = sst
-            if err is None:
-                self._debug_sample(task, out)
-            self.scheduler.report_finish(task.nbytes)
-            if self.tracer.enabled:
-                t_done = self.tracer.now()
-                self.tracer.record(task.name, task.key, "queued",
-                                   task.t_enqueue, task.t_dispatch,
-                                   task.step, task.nbytes)
-                self.tracer.record(task.name, task.key, "push_pull",
-                                   task.t_dispatch, t_done, task.step,
-                                   task.nbytes)
-            if self.cfg.telemetry_on:
-                # push + pull wire bytes; compressed chunks report payload
-                # size, which is the point of the feature
-                wire = (task.compression.worker.payload_nbytes()
-                        if task.compression is not None else task.nbytes)
-                self.speed.record(wire * 2)
-            if task.callback is not None:
-                if err is not None:
-                    task.callback(None, Status.error(str(err)))
-                else:
-                    # Average is applied at assembly granularity: the
-                    # reference divides in the done-callback too
-                    # (torch/__init__.py task callback output.div_(size)).
-                    task.callback(out, Status.ok())
+            for task in tasks:
+                if err is None and not (task.pending is not None
+                                        and task.pending.use_buffer):
+                    self._debug_sample(task, out)
+                self.scheduler.report_finish(task.nbytes)
+                if self.tracer.enabled:
+                    t_done = self.tracer.now()
+                    self.tracer.record(task.name, task.key, "queued",
+                                       task.t_enqueue, task.t_dispatch,
+                                       task.step, task.nbytes)
+                    self.tracer.record(task.name, task.key, "push_pull",
+                                       task.t_dispatch, t_done, task.step,
+                                       task.nbytes)
+                if self.cfg.telemetry_on:
+                    # push + pull wire bytes; compressed chunks report
+                    # payload size, which is the point of the feature
+                    wire = (task.compression.worker.payload_nbytes()
+                            if task.compression is not None else task.nbytes)
+                    self.speed.record(wire * 2)
+                if task.callback is not None:
+                    if err is not None:
+                        task.callback(None, Status.error(str(err)))
+                    else:
+                        # Average is applied at assembly granularity: the
+                        # reference divides in the done-callback too
+                        # (torch/__init__.py task callback output.div_(size)).
+                        task.callback(out, Status.ok())
 
     # ---------------------------------------------------------- lifecycle
     def shutdown(self, wait: bool = True):

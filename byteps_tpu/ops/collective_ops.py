@@ -17,6 +17,22 @@ from jax import lax
 
 AxisNames = Union[str, Sequence[str]]
 
+# Minimum per-device DCN shard size (bytes) for the compressed hop to engage.
+# The reference gates its compressors on BYTEPS_MIN_COMPRESS_BYTES
+# (global.cc:137-139); here the knob gates the DCN hop specifically, because
+# the measured crossover is about wire time vs compression compute: on the
+# 8-device CPU mesh the onebit hop LOSES below ~2 MB/shard and wins above
+# (BENCH_r02: 4 MB/rank = 1 MB shard -> 32.5 vs 21.6 ms; 16 MB/rank = 4 MB
+# shard -> compressed faster; docs/performance.md has the table).  On real
+# DCN the crossover is lower (wire is slower), so the env override matters.
+DCN_COMPRESS_MIN_BYTES = 2 * 1024 * 1024
+
+
+def dcn_compress_min_bytes() -> int:
+    from ..common.config import _env_int
+    return _env_int("BYTEPS_DCN_COMPRESS_MIN_BYTES",
+                    DCN_COMPRESS_MIN_BYTES)
+
 
 def _norm_axes(axis_names: AxisNames) -> Tuple[str, ...]:
     if isinstance(axis_names, str):
@@ -101,7 +117,8 @@ def make_onebit_pair(scaling: bool = True):
 
 def hierarchical_push_pull(x, ici_axis: str = "ici", dcn_axis: str = "dcn",
                            op: str = "average",
-                           compress=None, decompress=None):
+                           compress=None, decompress=None,
+                           compress_min_bytes: Optional[int] = None):
     """Two-level reduction of one array with an optional compressed DCN hop.
 
     Reproduces the reference's architecture (docs/architecture.md:14-41):
@@ -111,6 +128,13 @@ def hierarchical_push_pull(x, ici_axis: str = "ici", dcn_axis: str = "dcn",
     the reference's COMPRESS/DECOMPRESS pipeline stages sit
     (operations.cc:199-204): compressed bytes cross the slow network, full
     precision stays on ICI.
+
+    The compressed hop only engages when the per-device DCN shard is at
+    least ``compress_min_bytes`` (default: BYTEPS_DCN_COMPRESS_MIN_BYTES
+    env or the measured crossover) — below that, compression compute costs
+    more than the wire saves (reference's BYTEPS_MIN_COMPRESS_BYTES cutoff,
+    global.cc:137-139).  Shapes are static under jit, so the decision is
+    resolved at trace time per tensor.
     """
     orig_shape = x.shape
     orig_dtype = x.dtype
@@ -120,6 +144,11 @@ def hierarchical_push_pull(x, ici_axis: str = "ici", dcn_axis: str = "dcn",
     if pad:
         flat = jnp.pad(flat, (0, pad))
     shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0, tiled=True)
+    if compress is not None:
+        if compress_min_bytes is None:
+            compress_min_bytes = dcn_compress_min_bytes()
+        if shard.size * shard.dtype.itemsize < compress_min_bytes:
+            compress = None
     if compress is not None:
         # all_gather the compressed shards over DCN and decompress-sum:
         # the server-side "decompress each push, sum" semantics

@@ -357,6 +357,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         interpret = not on_tpu()
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    if causal and tq > tk:
+        # q_off would go negative: rows before the first key position are
+        # fully masked, their lse underflows to ~-1e30 and the backward's
+        # exp(s - lse) explodes.  No caller has this shape (decode-style
+        # alignment always has Tq <= Tk); reject it rather than return
+        # garbage. (round-2 advisor finding)
+        raise ValueError(
+            f"flash_attention(causal=True) requires Tq <= Tk, got "
+            f"Tq={tq} > Tk={tk}")
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     q_off = tk - tq  # decode alignment (0 when square)
 

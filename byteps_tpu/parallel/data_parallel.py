@@ -81,11 +81,15 @@ def make_dp_train_step(comm: CommContext,
         def micro(carry, mb):
             loss_acc, grad_acc = carry
             loss, grads = jax.value_and_grad(loss_fn)(params, mb)
-            return (loss_acc + loss,
+            # f32 loss accumulation keeps the scan carry dtype stable for
+            # bf16-loss models (a weak-typed 0.0 carry would flip dtype
+            # after the first add and fail the scan's carry check)
+            return (loss_acc + loss.astype(jnp.float32),
                     jax.tree.map(jnp.add, grad_acc, grads)), None
 
         zero = jax.tree.map(jnp.zeros_like, params)
-        (loss_sum, grad_sum), _ = lax.scan(micro, (0.0, zero), split)
+        (loss_sum, grad_sum), _ = lax.scan(
+            micro, (jnp.zeros((), jnp.float32), zero), split)
         scale = 1.0 / accum_steps
         return loss_sum * scale, jax.tree.map(
             lambda g: g * scale, grad_sum)

@@ -28,6 +28,36 @@ def make_2d_mesh(devices: Optional[Sequence], n_inner: int,
                 axis_names=axis_names)
 
 
+def check_params_on_mesh(mesh: Mesh, params, reshard_hint: str) -> None:
+    """Guard for GSPMD train-step wrappers: reject params that were never
+    mesh-sharded (fresh ``model.init`` output / host arrays would silently
+    run replicated on one device) or that live on a *different* mesh.
+
+    Accepts any multi-device placement: jit outputs come back as
+    ``GSPMDSharding`` (no ``.mesh`` attribute), so the check is on the
+    device set, not the sharding type."""
+    leaf = jax.tree.leaves(params)[0]
+    sharding = getattr(leaf, "sharding", None)
+    lmesh = getattr(sharding, "mesh", None)
+    if lmesh is not None and getattr(lmesh, "devices", None) is not None:
+        if lmesh != mesh:
+            raise ValueError(
+                "params are placed on a different mesh than the one this "
+                f"train step was built for — re-shard with {reshard_hint}")
+        return
+    if mesh.size <= 1:
+        return
+    device_set = getattr(sharding, "device_set", None)
+    if device_set is None or len(device_set) <= 1:
+        raise ValueError(
+            "params are not mesh-sharded (fresh init output or host "
+            f"arrays) — place them with {reshard_hint} first")
+    if device_set != set(np.asarray(mesh.devices).flat):
+        raise ValueError(
+            "params are placed on different devices than this train "
+            f"step's mesh — re-shard with {reshard_hint}")
+
+
 def jit_mapped_step(mesh: Mesh, step: Callable, spec_of: Callable,
                     batch_spec, donate: bool = True,
                     axis_names=None) -> Callable:

@@ -27,7 +27,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gpt import GPT, GPTConfig, lm_loss
-from .mesh_util import make_2d_mesh
+from .mesh_util import check_params_on_mesh, make_2d_mesh
 
 DP_AXIS = "dp"
 TP_AXIS = "tp"
@@ -126,19 +126,7 @@ def make_dp_tp_train_step(mesh: Mesh, cfg: GPTConfig,
         # model.init output / host arrays), would otherwise just run
         # with whatever layout they carry — replicated on one device in
         # the common case.
-        leaf = jax.tree.leaves(params)[0]
-        lmesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
-        if lmesh is None or getattr(lmesh, "devices", None) is None:
-            if mesh.size > 1:
-                raise ValueError(
-                    "params are not mesh-sharded (fresh init output or "
-                    "host arrays) — place them with "
-                    "shard_gpt_params(mesh, params) first")
-        elif lmesh != mesh:
-            raise ValueError(
-                "params are placed on a different mesh than the one this "
-                "train step was built for — re-shard with "
-                "shard_gpt_params(mesh, params)")
+        check_params_on_mesh(mesh, params, "shard_gpt_params(mesh, params)")
         return jitted(params, opt_state, batch)
 
     return wrapper

@@ -137,3 +137,52 @@ def test_scaled_path_matches_unscaled_math(bps_session):
     out = bps.push_pull(jnp.asarray(x), "sc1", op="average")
     np.testing.assert_allclose(np.asarray(out), x.mean(0),
                                rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------- buffer-mode edges
+# (the scatter-accumulator hot path: slice -> psum_scatter -> block-sharded
+# buffer, donated between chunk dispatches, one-pass assembly)
+
+
+def test_buffer_mode_unaligned_length(bps_chunked):
+    """n not divisible by n_ici: the staged flat is padded, the assemble
+    program drops the pad."""
+    n = 40_000 + 5  # 40005 % 8 != 0
+    x = np.random.RandomState(5).randn(8, n).astype(np.float32)
+    out = bps.push_pull(jnp.asarray(x), "unal", op="sum")
+    eng = bps.core.api._require()
+    assert len(eng.registry.get("unal").chunk_bounds) > 1
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+
+def test_buffer_mode_bf16_average(bps_chunked):
+    """Multi-chunk bf16 average: f32 accumulation in the scatter buffer,
+    scale before the downcast (8 x 10000 would overflow a bf16-free sum
+    only in f16; for bf16 the check is value fidelity)."""
+    x = np.random.RandomState(6).randn(8, 24_576).astype(np.float32)
+    out = bps.push_pull(jnp.asarray(x, jnp.bfloat16), "bfavg", op="average")
+    assert out.dtype == jnp.bfloat16
+    want = np.asarray(jnp.asarray(x, jnp.bfloat16).astype(np.float32)).mean(0)
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32), want,
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_buffer_mode_int_sum_and_average(bps_chunked):
+    x = np.arange(8 * 16_384, dtype=np.int32).reshape(8, 16_384) % 7
+    s = bps.push_pull(jnp.asarray(x), "isum", op="sum")
+    np.testing.assert_array_equal(np.asarray(s), x.sum(0))
+    a = bps.push_pull(jnp.asarray(x), "iavg", op="average")
+    np.testing.assert_array_equal(np.asarray(a), x.sum(0) // 8)
+
+
+def test_buffer_mode_group_size_one_matches(bps_session):
+    """group_size=1 (no chunk merging, the multi-host configuration) gives
+    the same result as the default grouped dispatch."""
+    from byteps_tpu.common.config import set_config
+    x = np.random.RandomState(7).randn(8, 30_000).astype(np.float32)
+    want = bps.push_pull(jnp.asarray(x), "grp/a", op="sum")
+    bps.shutdown()
+    set_config(Config(partition_bytes=4096, group_size=1))
+    bps.init()
+    out = bps.push_pull(jnp.asarray(x), "grp/b", op="sum")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-6)

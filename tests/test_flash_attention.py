@@ -58,6 +58,18 @@ def test_decode_alignment():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_causal_rejects_tq_gt_tk():
+    """causal=True with Tq > Tk is rejected: fully-masked early rows would
+    produce garbage forward values and exploding backward p = exp(s - lse)
+    (round-2 advisor finding)."""
+    b, h, d = 1, 2, 64
+    q = _rand((b, 256, h, d), jnp.float32, 6)
+    k = _rand((b, 64, h, d), jnp.float32, 7)
+    v = _rand((b, 64, h, d), jnp.float32, 8)
+    with pytest.raises(ValueError, match="Tq <= Tk"):
+        flash_attention(q, k, v, causal=True, interpret=True)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_gradients_match_exact(causal):
     b, t, h, d = 2, 128, 2, 64

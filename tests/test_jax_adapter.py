@@ -227,3 +227,29 @@ def test_fused_step_accum_matches_full_batch(session):
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
             results[k][1], results[1][1])
+
+
+def test_fused_step_accum_bf16_loss(session):
+    """accum_steps > 1 with a bf16-returning loss_fn must trace: the scan
+    carry accumulates the loss in f32 regardless of the loss dtype
+    (round-2 advisor finding: a weak-typed 0.0 carry flipped dtype after
+    the first add and failed lax.scan's carry check)."""
+    from byteps_tpu.comm.mesh import get_comm
+    from byteps_tpu.parallel import make_dp_train_step, replicate, shard_batch
+
+    comm = get_comm()
+    model, params = _init_model()
+    loss = _loss_fn(model)
+    x, y = _data()
+    tx = optax.adam(1e-2)
+
+    def bf16_loss_fn(p, b):
+        return loss(p, b["x"], b["y"]).astype(jnp.bfloat16)
+
+    step = make_dp_train_step(comm, bf16_loss_fn, tx, donate=False,
+                              accum_steps=2)
+    p = replicate(comm, params)
+    o = replicate(comm, tx.init(params))
+    b = shard_batch(comm, {"x": x, "y": y})
+    p, o, l_ = step(p, o, b)
+    assert np.isfinite(float(l_))

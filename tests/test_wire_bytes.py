@@ -25,13 +25,14 @@ from byteps_tpu.ops.collective_ops import (hierarchical_push_pull,
 from byteps_tpu.utils.hlo_wire import dcn_ici_bytes as _dcn_ici_bytes
 
 
-def _compile_hierarchical(mesh, n, compressed: bool):
+def _compile_hierarchical(mesh, n, compressed: bool, min_bytes: int = 0):
     compress, decompress = (make_onebit_pair() if compressed
                             else (None, None))
 
     def body(x):
         return hierarchical_push_pull(x[0], op="sum", compress=compress,
-                                      decompress=decompress)
+                                      decompress=decompress,
+                                      compress_min_bytes=min_bytes)
 
     # body returns the full reduced array (it all-gathers internally), so
     # the output is replicated
@@ -74,3 +75,33 @@ def test_compressed_hop_executes_and_is_signwise_correct(mesh):
     # all ranks contribute identical tensors: the onebit hop preserves
     # the sign structure of the sum exactly
     np.testing.assert_array_equal(np.sign(out), np.sign(base * 8).astype(out.dtype))
+
+
+def test_compress_threshold_gates_small_shards(mesh, monkeypatch):
+    """Below the min-bytes cutoff the compressed hop must NOT engage: the
+    DCN wire bytes match the plain path (reference
+    BYTEPS_MIN_COMPRESS_BYTES semantics, global.cc:137-139)."""
+    n = 1 << 16  # 256 KB/rank -> 64 KB shard, below the 2 MB default
+    _, hlo_plain = _compile_hierarchical(mesh, n, compressed=False)
+    _, hlo_gated = _compile_hierarchical(mesh, n, compressed=True,
+                                         min_bytes=None)  # default gate
+    dcn_p, _ = _dcn_ici_bytes(hlo_plain, n_ici=4)
+    dcn_g, _ = _dcn_ici_bytes(hlo_gated, n_ici=4)
+    assert dcn_g == dcn_p, (dcn_g, dcn_p)
+    # env override drops the cutoff and the compression engages again
+    monkeypatch.setenv("BYTEPS_DCN_COMPRESS_MIN_BYTES", "1024")
+    _, hlo_env = _compile_hierarchical(mesh, n, compressed=True,
+                                       min_bytes=None)
+    dcn_e, _ = _dcn_ici_bytes(hlo_env, n_ici=4)
+    assert dcn_e * 25 < dcn_p, (dcn_e, dcn_p)
+
+
+def test_compress_threshold_admits_large_shards(mesh):
+    """Above the cutoff the default gate lets compression through."""
+    n = 1 << 22  # 16 MB/rank -> 4 MB shard, above the 2 MB default
+    _, hlo_c = _compile_hierarchical(mesh, n, compressed=True,
+                                     min_bytes=None)
+    _, hlo_u = _compile_hierarchical(mesh, n, compressed=False)
+    dcn_c, _ = _dcn_ici_bytes(hlo_c, n_ici=4)
+    dcn_u, _ = _dcn_ici_bytes(hlo_u, n_ici=4)
+    assert dcn_c * 25 < dcn_u, (dcn_c, dcn_u)

@@ -1,0 +1,117 @@
+"""TPU watcher: probe the tunneled chip, run bench.py on green, record.
+
+Round-2 VERDICT item 1: the chip drops intermittently, so the bench must be
+run early and often — not once at round end.  This watcher loops:
+
+  1. probe the backend in a subprocess (60 s timeout),
+  2. on green, run the full ``bench.py`` and parse its JSON line,
+  3. if the line is a TPU line, write it to ``BENCH_TPU_LATEST.json`` and
+     append a dated entry to ``BENCH_TPU_MEASURED.json``'s history,
+  4. sleep and repeat (shorter sleep while no green run yet this session).
+
+Run in the background for the whole round:  python tools/tpu_watch.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MEASURED = os.path.join(REPO, "BENCH_TPU_MEASURED.json")
+LATEST = os.path.join(REPO, "BENCH_TPU_LATEST.json")
+
+PROBE = ("import jax, json; ds = jax.devices();"
+         "print('PROBE', ds[0].platform, len(ds), ds[0].device_kind)")
+
+
+def probe(timeout=90.0):
+    try:
+        p = subprocess.run([sys.executable, "-c", PROBE],
+                           capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("PROBE "):
+            parts = line.split(maxsplit=3)
+            return {"platform": parts[1], "n": int(parts[2]),
+                    "kind": parts[3] if len(parts) > 3 else "?"}
+    return None
+
+
+def run_bench():
+    try:
+        p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           capture_output=True, text=True,
+                           timeout=3600, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(p.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def record(line: dict):
+    stamp = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
+    with open(LATEST, "w") as f:
+        json.dump({"recorded": stamp, "line": line}, f, indent=1)
+    doc = {"note": "", "line": {}, "history": []}
+    if os.path.exists(MEASURED):
+        try:
+            with open(MEASURED) as f:
+                doc = json.load(f)
+        except json.JSONDecodeError:
+            pass
+    doc["note"] = ("Most recent green TPU run (%s). Recorded because the "
+                   "tunneled chip drops intermittently; bench.py reproduces "
+                   "this line whenever the chip is reachable." % stamp)
+    doc["line"] = line
+    doc.setdefault("history", []).append({
+        "recorded": stamp,
+        "value": line.get("value"),
+        "mfu": line.get("mfu"),
+        "onebit_pack_gbps": (line.get("onebit_pallas") or {}).get("pack_gbps"),
+        "flash_fwd_speedup": (line.get("flash_attention") or {}).get(
+            "fwd_speedup"),
+        "engine_device_gbps": next(
+            (v for k, v in (line.get("push_pull_gbps") or {}).items()
+             if k.startswith("engine_device")), None),
+    })
+    with open(MEASURED, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def main():
+    greens = 0
+    while True:
+        info = probe()
+        now = time.strftime("%H:%M:%S")
+        if info and info["platform"] not in ("cpu",):
+            print(f"[{now}] probe green: {info}; running bench", flush=True)
+            line = run_bench()
+            if line and str(line.get("device", "")).lower().startswith(
+                    ("tpu", "v5", "v6", "v4")):
+                greens += 1
+                record(line)
+                print(f"[{now}] green TPU bench #{greens}: "
+                      f"value={line.get('value')} mfu={line.get('mfu')}",
+                      flush=True)
+            else:
+                print(f"[{now}] bench ran but no TPU line: "
+                      f"{str(line)[:200]}", flush=True)
+        else:
+            print(f"[{now}] probe: chip unreachable", flush=True)
+        # Dense probing until the first green run, then hourly freshness.
+        time.sleep(300 if greens == 0 else 3600)
+
+
+if __name__ == "__main__":
+    main()
