@@ -2,6 +2,12 @@
 directory (PyTorch MNIST, synthetic ResNet-50, GluonNLP BERT-large —
 SURVEY.md §6 configs)."""
 
+from .llama import (  # noqa: F401
+    Llama,
+    LlamaConfig,
+    llama3_8b,
+    llama_tiny,
+)
 from .mlp import MLP, mnist_mlp  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet,

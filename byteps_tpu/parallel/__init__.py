@@ -76,3 +76,12 @@ from .three_d import (  # noqa: F401
     shard_3d_batch,
     shard_3d_params,
 )
+from .fsdp_tp import (  # noqa: F401
+    init_llama_opt_state,
+    init_llama_params_sharded,
+    llama_shardings,
+    make_fsdp_tp_mesh,
+    make_fsdp_tp_train_step,
+    shard_llama_batch,
+    shard_llama_params,
+)

@@ -1,0 +1,185 @@
+"""(fsdp, tp) composite parallelism for the Llama family — the flagship
+modern-LLM configuration (BASELINE.json configs[4]: "Llama-3-8B").
+
+The reference scales with data parallelism only (SURVEY.md §2.6); an 8B
+model's params + Adam state (~96 GB in f32) outgrow one chip's HBM, so the
+TPU rebuild composes two sharding axes GSPMD-natively:
+
+- **tp** (Megatron-style): attention heads and SwiGLU width are column/
+  row-parallel within the fastest ICI dimension — per-layer all-reduces
+  are latency-bound, so they ride the shortest links;
+- **fsdp** (ZeRO-3 by annotation): the *other* large axis of every weight
+  is sharded over the fsdp axis, and the batch is sharded over it too
+  (fsdp doubles as dp).  XLA streams each layer's parameter all-gather on
+  demand and reduce-scatters its gradients — the per-block streamed
+  gather that the flat-vector path (`zero.py`, whole-vector gather) trades
+  away, here for free from the annotation (the "pick a mesh, annotate,
+  let XLA insert collectives" recipe, in contrast to zero.py's hand-pinned
+  shard_map schedule).
+
+Optimizer state inherits the param shardings via ``jax.jit(tx.init,
+out_shardings=...)`` — persistent memory per device is
+``(params + opt state) / (n_fsdp * n_tp)`` for every sharded leaf.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import Llama, LlamaConfig, lm_loss
+from .mesh_util import check_params_on_mesh, make_2d_mesh
+
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+
+
+def make_fsdp_tp_mesh(devices, n_tp: int) -> Mesh:
+    """(fsdp, tp) mesh; tp innermost (fastest ICI neighbors)."""
+    return make_2d_mesh(devices, n_tp, (FSDP_AXIS, TP_AXIS))
+
+
+# Sharding rules matched against the flax param path.  Every rule carries
+# BOTH axes: tp on the Megatron axis, fsdp on the complementary large axis.
+# First match wins.  Unmatched paths fall back in llama_shardings: large
+# leaves are fsdp-sharded on their largest divisible axis (so a new
+# projection with an unanticipated name never silently replicates
+# gigabytes), small ones (RMSNorm scales) replicate.
+_RULES = [
+    # attention projections: q/k/v kernels are [hidden, heads, head_dim]
+    (r"attn/[qkv]/kernel$", P(FSDP_AXIS, TP_AXIS, None)),
+    (r"attn/out/kernel$", P(TP_AXIS, None, FSDP_AXIS)),
+    # SwiGLU: gate/up column-parallel, down row-parallel
+    (r"mlp/(gate|up)/kernel$", P(FSDP_AXIS, TP_AXIS)),
+    (r"mlp/down/kernel$", P(TP_AXIS, FSDP_AXIS)),
+    # embedding / unembedding: vocab over tp, hidden over fsdp
+    (r"wte/embedding$", P(TP_AXIS, FSDP_AXIS)),
+    (r"lm_head/kernel$", P(FSDP_AXIS, TP_AXIS)),
+    (r"norm/scale$|_norm/scale$|norm_f/scale$", P()),
+]
+
+
+def fsdp_tp_spec_for(path: str) -> P:
+    for pat, spec in _RULES:
+        if re.search(pat, path):
+            return spec
+    return P()
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for k in key_path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(k, attr):
+                parts.append(str(getattr(k, attr)))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def llama_shardings(mesh: Mesh, params):
+    """NamedSharding tree for a Llama param pytree (rule-matched).
+
+    Axes that don't divide their dimension are dropped to replicated for
+    that dim — GQA's few KV heads (num_kv_heads < n_tp) fall back to
+    replicated KV projections exactly like Megatron's GQA handling, and
+    odd vocab sizes degrade gracefully instead of erroring."""
+    import numpy as _np
+
+    def spec(key_path, leaf):
+        p = fsdp_tp_spec_for(_path_str(key_path))
+        if (all(ax is None for ax in p)
+                and int(_np.prod(leaf.shape)) > 1 << 16):
+            # unmatched large leaf: fsdp-shard the largest divisible axis
+            # rather than silently replicating gigabytes per device
+            dims = sorted(range(leaf.ndim), key=lambda d: -leaf.shape[d])
+            for d in dims:
+                if leaf.shape[d] % mesh.shape[FSDP_AXIS] == 0:
+                    p = P(*(FSDP_AXIS if i == d else None
+                            for i in range(leaf.ndim)))
+                    break
+        fixed = tuple(
+            (ax if ax is None or leaf.shape[d] % mesh.shape[ax] == 0
+             else None)
+            for d, ax in enumerate(p))
+        return NamedSharding(mesh, P(*fixed))
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_llama_params(mesh: Mesh, params):
+    return jax.device_put(params, llama_shardings(mesh, params))
+
+
+def init_llama_params_sharded(mesh: Mesh, cfg: LlamaConfig, rng,
+                              sample_ids, attn_fn=None):
+    """``model.init`` under jit with sharded out_shardings: every weight is
+    born on its (fsdp, tp) placement and the full tree never materializes
+    on one device — at 8B the unsharded f32 tree (~32 GB) would OOM a
+    single chip before `shard_llama_params` could run."""
+    model = Llama(cfg, attn_fn=attn_fn)
+    shapes = jax.eval_shape(model.init, rng, sample_ids)
+    shardings = llama_shardings(mesh, shapes)
+    return jax.jit(model.init, out_shardings=shardings)(rng, sample_ids)
+
+
+def shard_llama_batch(mesh: Mesh, batch):
+    """Batch over fsdp (it doubles as dp), sequence replicated over tp."""
+    return jax.device_put(batch, NamedSharding(mesh, P(FSDP_AXIS, None)))
+
+
+def init_llama_opt_state(tx: optax.GradientTransformation, sharded_params):
+    """tx.init with moment buffers pinned to the param shardings (zeros
+    carry no data dependence, so propagation alone would replicate them).
+    Optimizer-state leaves that mirror a param (same shape+dtype — adam
+    mu/nu etc.) inherit that param's sharding; scalars (step counts) stay
+    replicated."""
+    params_flat = jax.tree.leaves(sharded_params)
+    by_shape = {}
+    for p in params_flat:
+        by_shape.setdefault((p.shape, str(p.dtype)), p.sharding)
+    mesh = params_flat[0].sharding.mesh
+    rep = NamedSharding(mesh, P())
+    shapes = jax.eval_shape(tx.init, sharded_params)
+    out_sh = jax.tree.map(
+        lambda s: by_shape.get((s.shape, str(s.dtype)), rep), shapes)
+    return jax.jit(tx.init, out_shardings=out_sh)(sharded_params)
+
+
+def make_fsdp_tp_train_step(mesh: Mesh, cfg: LlamaConfig,
+                            tx: optax.GradientTransformation,
+                            donate: bool = True,
+                            attn_fn: Optional[Callable] = None) -> Callable:
+    """Jitted ``(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    Params must be placed by :func:`shard_llama_params`, the batch by
+    :func:`shard_llama_batch`, opt_state by :func:`init_llama_opt_state`.
+    Every collective — per-layer fsdp parameter gathers, tp activation
+    all-reduces, gradient reduce-scatters — is inserted by XLA from the
+    shardings; there is no hand-placed psum.
+    """
+    model = Llama(cfg, attn_fn=attn_fn)
+
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch["input_ids"])
+            return lm_loss(logits, batch["labels"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    def wrapper(params, opt_state, batch):
+        check_params_on_mesh(mesh, params,
+                             "shard_llama_params(mesh, params)")
+        return jitted(params, opt_state, batch)
+
+    return wrapper

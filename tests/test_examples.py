@@ -22,6 +22,8 @@ def _run(path, *argv):
     ("example/jax/benchmark_bert.py", ("--steps", "1", "--batch", "1")),
     ("example/jax/benchmark_resnet.py",
      ("--model", "tiny", "--batch", "1", "--size", "16", "--steps", "1")),
+    ("example/jax/train_llama.py",
+     ("--steps", "8", "--batch", "8", "--seq", "16")),
     ("example/jax/train_parallel_axes.py",
      ("--mode", "tp", "--steps", "2", "--batch", "8", "--seq", "16")),
     ("example/jax/train_parallel_axes.py",

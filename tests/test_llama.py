@@ -1,0 +1,275 @@
+"""Llama family tests: RoPE/RMSNorm/SwiGLU/GQA correctness and the
+(fsdp, tp) composite step pinned against single-device math.
+
+The established parity pattern (test_tensor_parallel.py): the sharding
+must change the placement, never the numbers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from byteps_tpu.models.llama import (Llama, LlamaConfig, apply_rope,
+                                     llama3_8b, llama_tiny, lm_loss,
+                                     rope_frequencies)
+from byteps_tpu.parallel.fsdp_tp import (
+    FSDP_AXIS, TP_AXIS, fsdp_tp_spec_for, init_llama_opt_state,
+    make_fsdp_tp_mesh, make_fsdp_tp_train_step, shard_llama_batch,
+    shard_llama_params)
+from byteps_tpu.parallel.long_context import synthetic_lm_batch
+
+
+def _cfg():
+    # f32 end to end: the parity tests need bit-comparable math
+    return LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                       num_heads=4, num_kv_heads=2, intermediate_size=64,
+                       max_position=64, rope_theta=10000.0,
+                       dtype=jnp.float32)
+
+
+# ------------------------------------------------------------------ rotary
+
+def test_rope_matches_naive():
+    """apply_rope == the textbook complex-rotation formula."""
+    d, t = 8, 16
+    x = np.random.RandomState(0).randn(1, t, 2, d).astype(np.float32)
+    pos = jnp.arange(t)[None]
+    cos, sin = rope_frequencies(d, pos, theta=10000.0)
+    got = np.asarray(apply_rope(jnp.asarray(x), cos, sin))
+
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    ang = np.arange(t)[:, None] * inv[None]  # [t, d/2]
+    want = np.empty_like(x)
+    for h in range(2):
+        x1, x2 = x[0, :, h, 0::2], x[0, :, h, 1::2]
+        want[0, :, h, 0::2] = x1 * np.cos(ang) - x2 * np.sin(ang)
+        want[0, :, h, 1::2] = x1 * np.sin(ang) + x2 * np.cos(ang)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_rope_relative_position_property():
+    """q(m) . k(n) after RoPE depends only on m - n: shifting both
+    positions by the same offset leaves every dot product unchanged."""
+    d = 16
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 4, 1, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 4, 1, d).astype(np.float32))
+
+    def dots(offset):
+        pos = (jnp.arange(4) + offset)[None]
+        cos, sin = rope_frequencies(d, pos, theta=10000.0)
+        qr, kr = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        return np.asarray(jnp.einsum("bthd,bshd->bhts", qr, kr))
+
+    np.testing.assert_allclose(dots(0), dots(37), rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------------- model
+
+def test_gqa_matches_mha_with_tiled_kv_weights():
+    """The GQA repeat path is exact: a GQA model (kv_heads < heads) must
+    produce bit-identical outputs to an MHA model (kv_heads == heads)
+    whose K/V kernels are the GQA kernels tiled along the head axis —
+    repeating heads after projection == projecting with repeated weights."""
+    cfg_gqa = _cfg()                      # 4 q heads, 2 kv heads
+    cfg_mha = LlamaConfig(**{**cfg_gqa.__dict__, "num_kv_heads": 4})
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 128, (2, 16)))
+    m_gqa, m_mha = Llama(cfg_gqa), Llama(cfg_mha)
+    p_gqa = m_gqa.init(jax.random.PRNGKey(0), ids)
+    groups = cfg_gqa.num_heads // cfg_gqa.num_kv_heads
+
+    p_mha = jax.tree.map(lambda x: x, p_gqa)  # shallow copy of the dicts
+    for layer in (f"h{i}" for i in range(cfg_gqa.num_layers)):
+        attn = dict(p_mha["params"][layer]["attn"])
+        for name in ("k", "v"):
+            kern = attn[name]["kernel"]  # [hidden, kv_heads, head_dim]
+            attn[name] = {"kernel": jnp.repeat(kern, groups, axis=1)}
+        p_mha["params"][layer] = {**p_mha["params"][layer], "attn": attn}
+
+    out_gqa = m_gqa.apply(p_gqa, ids)
+    out_mha = m_mha.apply(p_mha, ids)
+    # ulp-level drift only: the two head layouts contract in different
+    # orders; a wrong-axis repeat would diverge by O(1)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_llama_trains_single_device():
+    cfg = _cfg()
+    model = Llama(cfg)
+    batch = synthetic_lm_batch(jax.random.PRNGKey(3), cfg, batch=8,
+                               seq_len=16)
+    params = model.init(jax.random.PRNGKey(4), batch["input_ids"][:1])
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, b):
+        loss, g = jax.value_and_grad(
+            lambda q: lm_loss(model.apply(q, b["input_ids"]),
+                              b["labels"]))(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    losses = []
+    for _ in range(10):
+        params, opt, loss = step(params, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_llama3_8b_geometry():
+    """The 8B config has the advertised parameter count (structure only —
+    eval_shape, no allocation)."""
+    cfg = llama3_8b()
+    model = Llama(cfg)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))
+    n = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    assert 7.9e9 < n < 8.2e9, n
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        LlamaConfig(num_heads=5, num_kv_heads=2)
+
+
+# ----------------------------------------------------------- (fsdp, tp)
+
+def test_rules_cover_the_sharded_layers():
+    P = jax.sharding.PartitionSpec
+    assert fsdp_tp_spec_for("h0/attn/q/kernel") == P(FSDP_AXIS, TP_AXIS,
+                                                     None)
+    assert fsdp_tp_spec_for("h0/attn/out/kernel") == P(TP_AXIS, None,
+                                                       FSDP_AXIS)
+    assert fsdp_tp_spec_for("h1/mlp/gate/kernel") == P(FSDP_AXIS, TP_AXIS)
+    assert fsdp_tp_spec_for("h1/mlp/down/kernel") == P(TP_AXIS, FSDP_AXIS)
+    assert fsdp_tp_spec_for("h0/attn_norm/scale") == P()
+    assert fsdp_tp_spec_for("wte/embedding") == P(TP_AXIS, FSDP_AXIS)
+
+
+def test_sharded_init_never_materializes_unsharded():
+    """init_llama_params_sharded births every weight on its (fsdp, tp)
+    placement and matches the shard-after-init route bit for bit."""
+    cfg = _cfg()
+    mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=4)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    from byteps_tpu.parallel.fsdp_tp import init_llama_params_sharded
+    p_a = init_llama_params_sharded(mesh, cfg, jax.random.PRNGKey(5), ids)
+    p_b = shard_llama_params(
+        mesh, Llama(cfg).init(jax.random.PRNGKey(5), ids))
+    q = p_a["params"]["h0"]["attn"]["q"]["kernel"]
+    assert q.addressable_shards[0].data.shape[0] * 2 == q.shape[0]
+    # jit-compiled vs eager init differ at ulp level only
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), p_a, p_b)
+
+
+def test_unmatched_large_leaf_gets_fsdp_fallback():
+    """A large param whose path matches no rule is fsdp-sharded on its
+    largest divisible axis, not silently replicated."""
+    from byteps_tpu.parallel.fsdp_tp import llama_shardings
+    mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=4)
+    P = jax.sharding.PartitionSpec
+    fake = {"params": {"adapter": {"lora_A": jnp.zeros((512, 256)),
+                                   "tiny": jnp.zeros((8,))}}}
+    sh = llama_shardings(mesh, fake)
+    assert sh["params"]["adapter"]["lora_A"].spec == P(FSDP_AXIS, None)
+    assert sh["params"]["adapter"]["tiny"].spec == P()
+
+
+def test_fsdp_tp_params_are_distributed():
+    cfg = _cfg()
+    mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=4)  # fsdp=2 x tp=4
+    model = Llama(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = shard_llama_params(
+        mesh, model.init(jax.random.PRNGKey(5), ids))
+    q = params["params"]["h0"]["attn"]["q"]["kernel"]
+    shard = q.addressable_shards[0].data
+    # hidden split over fsdp (2), heads over tp (4): 1/8 per device
+    assert shard.shape[0] * 2 == q.shape[0]
+    assert shard.shape[1] * 4 == q.shape[1]
+    norm = params["params"]["h0"]["attn_norm"]["scale"]
+    assert norm.addressable_shards[0].data.shape == norm.shape
+
+
+def test_fsdp_tp_matches_single_device_math():
+    cfg = _cfg()
+    model = Llama(cfg)
+    rng = jax.random.PRNGKey(6)
+    batch = synthetic_lm_batch(rng, cfg, batch=4, seq_len=16)
+    params0 = model.init(rng, batch["input_ids"][:1])
+    tx = optax.sgd(0.1)
+
+    @jax.jit
+    def ref_step(p, o, b):
+        loss, g = jax.value_and_grad(
+            lambda q: lm_loss(model.apply(q, b["input_ids"]),
+                              b["labels"]))(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    p_ref, o_ref = params0, tx.init(params0)
+    for _ in range(3):
+        p_ref, o_ref, loss_ref = ref_step(p_ref, o_ref, batch)
+
+    mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=4)
+    p_sh = shard_llama_params(mesh, params0)
+    o_sh = init_llama_opt_state(tx, p_sh)
+    step = make_fsdp_tp_train_step(mesh, cfg, tx)
+    b_sh = shard_llama_batch(mesh, batch)
+    for _ in range(3):
+        p_sh, o_sh, loss_sh = step(p_sh, o_sh, b_sh)
+
+    np.testing.assert_allclose(float(loss_sh), float(loss_ref),
+                               rtol=1e-5, atol=1e-6)
+    for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(p_ref),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(p_sh),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=str(ka))
+
+
+def test_fsdp_tp_step_trains_and_keeps_placement():
+    cfg = _cfg()
+    mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=2)  # fsdp=4 x tp=2
+    model = Llama(cfg)
+    rng = jax.random.PRNGKey(7)
+    batch = synthetic_lm_batch(rng, cfg, batch=8, seq_len=16)
+    params = shard_llama_params(mesh,
+                                model.init(rng, batch["input_ids"][:1]))
+    tx = optax.adam(1e-2)
+    opt = init_llama_opt_state(tx, params)
+    step = make_fsdp_tp_train_step(mesh, cfg, tx)
+    batch = shard_llama_batch(mesh, batch)
+    losses = []
+    for _ in range(10):
+        params, opt, loss = step(params, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+    gate = params["params"]["h0"]["mlp"]["gate"]["kernel"]
+    shard = gate.addressable_shards[0].data
+    assert shard.shape[0] * 4 == gate.shape[0]  # fsdp placement survives
+    assert shard.shape[1] * 2 == gate.shape[1]  # tp placement survives
+    # adam moments are sharded like their params (memory scaling claim)
+    mu = opt[0].mu["params"]["h0"]["mlp"]["gate"]["kernel"]
+    assert mu.addressable_shards[0].data.shape == shard.shape
+
+
+def test_unsharded_params_rejected():
+    cfg = _cfg()
+    mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=4)
+    model = Llama(cfg)
+    batch = synthetic_lm_batch(jax.random.PRNGKey(8), cfg, 4, 16)
+    params = model.init(jax.random.PRNGKey(9), batch["input_ids"][:1])
+    tx = optax.sgd(0.1)
+    step = make_fsdp_tp_train_step(mesh, cfg, tx)
+    with pytest.raises(ValueError, match="not mesh-sharded"):
+        step(params, tx.init(params), shard_llama_batch(mesh, batch))
