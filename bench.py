@@ -161,7 +161,8 @@ def _bench_push_pull(devices, on_tpu):
         eng = PushPullEngine(comm, cfg)
         try:
             x = np.random.RandomState(0).randn(nbytes // 4).astype(np.float32)
-            eng.push_pull_local(x, "bench.pp")  # warmup + compile
+            for _ in range(3):  # warmup: group-merge width varies run to
+                eng.push_pull_local(x, "bench.pp")  # run; compile them all
             t0 = time.perf_counter()
             for _ in range(reps):
                 eng.push_pull_local(x, "bench.pp")
@@ -184,7 +185,8 @@ def _bench_push_pull(devices, on_tpu):
             x = jax.device_put(
                 jnp.zeros((n, nbytes // 4), jnp.float32),
                 comm.stacked_sharding(extra_dims=1))
-            eng.push_pull(x, "bench.dev")  # warmup + compile
+            for _ in range(3):  # warmup: all group-merge width variants
+                eng.push_pull(x, "bench.dev")
             t0 = time.perf_counter()
             for _ in range(reps):
                 out = eng.push_pull(x, "bench.dev")
@@ -566,6 +568,80 @@ def _run_inner(extra_env=None, timeout=_INNER_TIMEOUT):
     return None, (" | ".join(tail[-3:]) if tail else f"rc={p.returncode}")
 
 
+def _cpu8_flags() -> str:
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    return (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _run_tool(script: str, timeout: float, env=None):
+    """Run a tools/ script in its own session, returning its last JSON
+    stdout line (or an {"error": ...} dict).  The session matters: these
+    tools spawn their own worker subprocesses (weak_scaling's DMLC
+    groups), and killing only the orchestrator on timeout would orphan
+    workers stuck in rendezvous — they would keep burning CPU under the
+    later bench sections.  killpg reaps the whole tree."""
+    import signal
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", script)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return {"error": f"{script} timed out after {timeout:.0f}s"}
+    for out_line in reversed(out.strip().splitlines()):
+        if out_line.startswith("{"):
+            try:
+                return json.loads(out_line)
+            except json.JSONDecodeError:
+                return {"error": f"{script}: unparseable JSON line"}
+    return {"error": (err or out or "no output")[-300:]}
+
+
+def _merge_tool_section(line: str, key: str, script: str,
+                        timeout: float, env=None) -> str:
+    """Embed a tools/ script's JSON output as ``result[key]``."""
+    try:
+        result = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    if key in result:
+        return line
+    try:
+        result[key] = _run_tool(script, timeout, env=env)
+    except Exception as e:  # noqa: BLE001 - evidence sections must not
+        result[key] = {"error": str(e)[:300]}  # kill the bench
+    return json.dumps(result)
+
+
+def _merge_scaling(line: str) -> str:
+    """Scaling-evidence section (round-2 VERDICT item 3): measured weak
+    scaling over real processes, the contention-free dcn-structure sweep,
+    and the analytic v5e-256 projection (tools/weak_scaling.py).  The
+    timeout covers the tool's own internal worst case (3 groups x 420s +
+    sweep 420s + compile) so a slow box degrades to a clean error."""
+    return _merge_tool_section(line, "scaling", "weak_scaling.py",
+                               timeout=2200.0)
+
+
+def _merge_mechanisms(line: str) -> str:
+    """Mechanism-proof section (round-2 VERDICT item 4): priority and
+    partitioning measured as LATENCY mechanisms under a credit window
+    (tools/mechanism_bench.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _cpu8_flags()
+    return _merge_tool_section(line, "mechanisms", "mechanism_bench.py",
+                               timeout=900.0, env=env)
+
+
 def _merge_dcn_compare(line: str) -> str:
     """If the main bench ran single-chip (no dcn_compare), obtain it from a
     virtual 8-device CPU mesh subprocess and merge into the JSON line."""
@@ -575,14 +651,10 @@ def _merge_dcn_compare(line: str) -> str:
         return line
     if "dcn_compare" in result:
         return line
-    import re
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
     env = {
         "_BPS_BENCH_ONLY": "dcn",
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": (flags +
-                      " --xla_force_host_platform_device_count=8").strip(),
+        "XLA_FLAGS": _cpu8_flags(),
     }
     dcn_line, err = _run_inner(extra_env=env, timeout=600.0)
     if dcn_line is not None:
@@ -609,7 +681,8 @@ def main() -> int:
                 # one retry of the full bench for transient failures
                 line, err = _run_inner()
             if line is not None:
-                print(_merge_dcn_compare(line))
+                print(_merge_mechanisms(
+                    _merge_scaling(_merge_dcn_compare(line))))
                 return 0
             errors.append(f"bench retry failed: {err}")
             break
@@ -618,19 +691,15 @@ def main() -> int:
 
     # Terminal fallback: CPU smoke so the driver still records a number.
     note = "tpu unavailable: " + "; ".join(errors)[:400]
-    import re
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
     env = {
         "_BPS_BENCH_FORCE_CPU": "1",
         "_BPS_BENCH_NOTE": note,
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": (flags +
-                      " --xla_force_host_platform_device_count=8").strip(),
+        "XLA_FLAGS": _cpu8_flags(),
     }
     line, err = _run_inner(extra_env=env, timeout=900.0)
     if line is not None:
-        print(line)
+        print(_merge_mechanisms(_merge_scaling(line)))
         return 0
     print(json.dumps({
         "metric": "bert_large_mlm_train_throughput_per_chip",

@@ -49,7 +49,8 @@ class TensorRegistry:
             return ctx
 
     def init_tensor(self, name: str, shape, dtype,
-                    compression_kwargs: Optional[Dict[str, str]] = None
+                    compression_kwargs: Optional[Dict[str, str]] = None,
+                    partition_bytes: Optional[int] = None
                     ) -> TensorContext:
         """First-call initialization: record shape/dtype, carve chunk keys.
 
@@ -72,10 +73,13 @@ class TensorRegistry:
                         f"{tuple(shape)}/{np_dtype.name}, previously "
                         f"{ctx.shape}/{ctx.dtype_name}")
                 return ctx
-            cfg = get_config()
+            if partition_bytes is None:
+                # engines pass their own bound; bare registry use (tests)
+                # falls back to the process config
+                partition_bytes = get_config().partition_bytes
             num_elems = int(np.prod(shape)) if len(tuple(shape)) else 1
             bounds = chunk_bounds(num_elems, np_dtype.itemsize,
-                                  cfg.partition_bytes)
+                                  partition_bytes)
             ctx.shape = tuple(shape)
             ctx.dtype_name = np_dtype.name
             ctx.num_elems = num_elems

@@ -189,8 +189,9 @@ class PushPullEngine:
                 f"stacked rank axis {r} != mesh ranks {self.comm.num_ranks}")
         if out_shape is None:
             out_shape = stacked.shape[1:]
-        ctx = self.registry.init_tensor(name, out_shape, stacked.dtype,
-                                        compression_kwargs=compression)
+        ctx = self.registry.init_tensor(
+            name, out_shape, stacked.dtype, compression_kwargs=compression,
+            partition_bytes=self.cfg.partition_bytes)
         if priority is None:
             prio = -ctx.declared_key if self.cfg.enable_priority else 0
         else:

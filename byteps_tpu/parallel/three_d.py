@@ -24,9 +24,19 @@ and tp tests use individually.
 Known issue (CPU simulation only): this image's XLA **CPU** backend
 aborts with a compiler CHECK ("Invalid binary instruction opcode copy")
 compiling the composite for **bf16** models — use f32 configs on the
-virtual CPU mesh (tests and the multichip dry-run do).  The CHECK is in
-the CPU emitter; the TPU compile path is separate, but validate bf16 on
-the first real pod run (docs/troubleshooting.md).
+virtual CPU mesh (tests and the multichip dry-run do).  Round-3
+minimal repro (tests/test_three_d.py bf16 canary): a **bf16 psum inside
+a partial-manual shard_map** (``axis_names`` a strict subset of the mesh
+axes) is sufficient; f32 psum, full-manual shard_map, and full-auto
+GSPMD all compile bf16 fine.  Under bf16 compute the autodiff transpose
+inserts bf16 cotangent psums at every pcast site, so the composite
+cannot avoid the pattern from user code.  Coverage consequence: bf16 IS
+validated on CPU for every other composite — fused DP, (dp, sp) ring,
+(dp, pp) full-manual GPipe, (dp, tp) GSPMD, (fsdp, tp) Llama (the
+multichip dry-run runs all of these in their models' default bf16) —
+only this hybrid manual/auto path needs f32 on CPU.  The TPU emitter is
+separate; validate bf16 3D on the first real pod run
+(docs/troubleshooting.md).
 """
 
 from __future__ import annotations

@@ -22,8 +22,12 @@ collectives:
   all-gathers them, runs forward/backward, and reduce-scatters the
   gradients.  Persistent per-device memory is ``(params + opt state)/R``;
   the transient full-params peak during the step is the whole-vector
-  granularity trade (per-layer gather is the GSPMD path,
-  `tensor_parallel.py`, where XLA streams parameters per operand).
+  granularity trade.  The per-block STREAMED gather is `fsdp_tp.py`
+  (GSPMD annotations; XLA gathers each layer where used and re-gathers
+  under remat): measured 1.55x lower transient footprint at 34M params
+  on the 8-device CPU mesh (tools/fsdp_memory.py; docs/performance.md).
+  Use this path for bandwidth-shaped steps on models that fit; use
+  `fsdp_tp` when the transient peak is the constraint.
 
 Both steps are one jitted ``shard_map`` over the ``(dcn, ici)`` mesh — the
 collectives ride ICI within a slice and DCN between slices, exactly like

@@ -73,8 +73,9 @@ def test_gqa_matches_mha_with_tiled_kv_weights():
     produce bit-identical outputs to an MHA model (kv_heads == heads)
     whose K/V kernels are the GQA kernels tiled along the head axis —
     repeating heads after projection == projecting with repeated weights."""
+    import dataclasses
     cfg_gqa = _cfg()                      # 4 q heads, 2 kv heads
-    cfg_mha = LlamaConfig(**{**cfg_gqa.__dict__, "num_kv_heads": 4})
+    cfg_mha = dataclasses.replace(cfg_gqa, num_kv_heads=4)
     ids = jnp.asarray(np.random.RandomState(2).randint(0, 128, (2, 16)))
     m_gqa, m_mha = Llama(cfg_gqa), Llama(cfg_mha)
     p_gqa = m_gqa.init(jax.random.PRNGKey(0), ids)

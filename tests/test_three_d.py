@@ -109,3 +109,56 @@ def test_pp_step_body_reuse_unchanged():
                                      num_microbatches=2)
     p, o, loss = step(p, o, par.shard_pp_batch(mesh, batch))
     assert np.isfinite(float(loss))
+
+
+def test_bf16_partial_manual_psum_canary():
+    """Canary for the XLA CPU bug that forces f32 on the 3D path.
+
+    Minimal repro (isolated in a subprocess — the failure mode is a
+    process-killing compiler CHECK, "Invalid binary instruction opcode
+    copy"): a bf16 psum inside a partial-manual shard_map.  While the
+    bug exists, the subprocess dies and three_d.py's f32-on-CPU gating
+    stays justified.  When an XLA upgrade fixes it, this test FAILS —
+    that is the signal to drop the f32 gating and this canary together.
+    """
+    import subprocess
+    import sys
+
+    code = r"""
+import os
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+mesh = Mesh(devs, ("dp", "pp", "tp"))
+w = jax.device_put(jnp.zeros((16, 16), jnp.bfloat16),
+                   NamedSharding(mesh, P(None, None)))
+x = jax.device_put(jnp.zeros((4, 16), jnp.bfloat16),
+                   NamedSharding(mesh, P("dp", None)))
+def body(x, w):
+    g = jax.grad(lambda w: jnp.sum((x @ w).astype(jnp.float32)))(w)
+    return lax.psum(g, ("dp", "pp"))
+f = jax.jit(jax.shard_map(body, mesh=mesh,
+                          in_specs=(P("dp", None), P(None, None)),
+                          out_specs=P(None, None),
+                          axis_names={"dp", "pp"}, check_vma=False))
+f(x, w).block_until_ready()
+print("BF16_PARTIAL_MANUAL_OK")
+"""
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300)
+    if "BF16_PARTIAL_MANUAL_OK" in p.stdout:
+        raise AssertionError(
+            "XLA now compiles bf16 psum under partial-manual shard_map — "
+            "remove the f32-on-CPU gating in parallel/three_d.py and this "
+            "canary")
+    # It must die with THE documented CHECK — any other failure (renamed
+    # jax API, import error) means the canary no longer tests the bug.
+    assert p.returncode != 0
+    assert "Invalid binary instruction opcode copy" in (p.stderr or ""), (
+        "repro subprocess failed for a different reason:\n"
+        + (p.stderr or "")[-800:])

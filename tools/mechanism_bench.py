@@ -1,0 +1,150 @@
+"""Prove (or retire) the signature BytePS mechanisms on TPU (round-2
+VERDICT item 4).
+
+The round-2 measurements showed priority, partitioning and credit are
+throughput-neutral-to-negative on bulk GB/s — but bulk GB/s is not what
+they are for.  In the reference they exist to cut the LATENCY of the
+gradients the next forward pass needs first (priority scheduling +
+cross-barrier, reference docs/best-practice.md:7; partitioning bounds
+head-of-line blocking, operations.cc:140-180).  This harness measures
+exactly that:
+
+- **priority**: the backward pass produces gradients last-layer-first;
+  the next forward needs first-layer gradients first.  Enqueue K tensors
+  in reverse declaration order and time how long the FIRST-declared
+  (highest-priority) tensor takes to complete, priority on vs off.
+- **partitioning**: enqueue one big low-priority tensor, then a small
+  urgent one; partitioning lets the small tensor preempt at chunk
+  granularity instead of waiting out the whole transfer.
+
+Prints one JSON object; bench.py embeds it as the "mechanisms" section.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _setup():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from byteps_tpu.comm.mesh import CommContext, _build_mesh
+    devices = jax.devices()
+    n = len(devices)
+    comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1, n_ici=n)
+    return comm, n
+
+
+def priority_latency(comm, n, k_tensors=6, mbytes=4, reps=5):
+    """Median time-to-ready of the first-declared tensor when all K are
+    enqueued in reverse order (backward-pass production order).
+
+    The credit window is load-bearing here: JAX async dispatch returns
+    immediately, so with an unlimited window every chunk is dispatched the
+    moment it is enqueued and the priority queue never holds anything to
+    reorder.  A bytes-in-flight budget (the reference's
+    BYTEPS_SCHEDULING_CREDIT) makes dispatch wait for completions — the
+    queue builds depth, and priority picks what goes next.  This is the
+    composition the mechanisms were designed as: credit creates the
+    decision point, priority decides, partitioning sets the granularity.
+    """
+    import numpy as np
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.core.engine import PushPullEngine
+
+    credit = 2 * mbytes * (1 << 20)   # ~2 tensors in flight
+    out = {}
+    for tag, prio in (("priority", True), ("fifo", False)):
+        cfg = Config(telemetry_on=False, trace_on=False,
+                     enable_priority=prio, scheduling_credit=credit)
+        eng = PushPullEngine(comm, cfg)
+        try:
+            xs = [np.random.RandomState(i).randn(
+                mbytes * (1 << 20) // 4).astype(np.float32)
+                for i in range(k_tensors)]
+            # declare in forward order so declared_key (priority) is set
+            for i in range(k_tensors):
+                eng.push_pull_local(xs[i], f"layer{i}")  # init + warmup
+            lats = []
+            for _ in range(reps):
+                handles = {}
+                # enqueue in REVERSE (backward produces last layer first).
+                # The fifo baseline pins priority to arrival order — what
+                # a plain allreduce queue (Horovod/NCCL production order)
+                # executes; with enable_priority the engine's default
+                # -declared_key ordering takes over.  (Config alone can't
+                # express arrival order: the scheduler tie-breaks equal
+                # priorities by key, which IS declaration order.)
+                for pos, i in enumerate(reversed(range(k_tensors))):
+                    handles[i] = eng.push_pull_local_async(
+                        xs[i], f"layer{i}",
+                        **({} if prio else {"priority": -pos}))
+                t0 = time.perf_counter()
+                handles[0].wait()           # the next forward's first need
+                lats.append(time.perf_counter() - t0)
+                for h in handles.values():
+                    h.wait()
+            out[f"layer0_ready_ms_{tag}"] = round(
+                sorted(lats)[len(lats) // 2] * 1e3, 1)
+        finally:
+            eng.shutdown(wait=False)
+    out["speedup"] = round(out["layer0_ready_ms_fifo"]
+                           / max(out["layer0_ready_ms_priority"], 1e-9), 2)
+    return out
+
+
+def partition_latency(comm, n, big_mb=64, small_kb=256, reps=5):
+    """Median time-to-ready of a small urgent tensor enqueued right after
+    a big low-priority one, with and without partitioning."""
+    import numpy as np
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.core.engine import PushPullEngine
+
+    out = {}
+    for tag, pbytes in (("partitioned", 4096 * 1000),
+                        ("whole", 2**31 - 512)):
+        cfg = Config(telemetry_on=False, trace_on=False,
+                     partition_bytes=pbytes,
+                     scheduling_credit=8 * (1 << 20))
+        eng = PushPullEngine(comm, cfg)
+        try:
+            big = np.random.RandomState(0).randn(
+                big_mb * (1 << 20) // 4).astype(np.float32)
+            small = np.random.RandomState(1).randn(
+                small_kb * 1024 // 4).astype(np.float32)
+            eng.push_pull_local(small, "urgent", priority=10)
+            eng.push_pull_local(big, "bulk", priority=-10)
+            lats = []
+            for _ in range(reps):
+                hb = eng.push_pull_local_async(big, "bulk", priority=-10)
+                hs = eng.push_pull_local_async(small, "urgent", priority=10)
+                t0 = time.perf_counter()
+                hs.wait()
+                lats.append(time.perf_counter() - t0)
+                hb.wait()
+            out[f"urgent_ready_ms_{tag}"] = round(
+                sorted(lats)[len(lats) // 2] * 1e3, 1)
+        finally:
+            eng.shutdown(wait=False)
+    out["speedup"] = round(out["urgent_ready_ms_whole"]
+                           / max(out["urgent_ready_ms_partitioned"], 1e-9),
+                           2)
+    return out
+
+
+def main() -> int:
+    comm, n = _setup()
+    result = {"priority": priority_latency(comm, n),
+              "partitioning": partition_latency(comm, n)}
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
