@@ -8,9 +8,10 @@ needs: sequence/context parallelism (sequence.py ring/Ulysses;
 ring_flash.py runs the Pallas flash kernels inside the ring), tensor
 (tensor_parallel.py, GSPMD), pipeline (pipeline.py, GPipe in one
 shard_map), expert (expert.py/moe_lm.py, switch-MoE all_to_all),
-ZeRO-1/FSDP/HSDP sharded-optimizer DP (zero.py), and the 3D (dp, pp,
-tp) composite (three_d.py).  Every axis is pinned step-for-step against
-single-device math by its test file.
+ZeRO-1/FSDP/HSDP sharded-optimizer DP (zero.py), the streamed
+(fsdp, tp) Llama composite (fsdp_tp.py, ZeRO-3 by GSPMD annotation),
+and the 3D (dp, pp, tp) composite (three_d.py).  Every axis is pinned
+step-for-step against single-device math by its test file.
 """
 
 from .data_parallel import (  # noqa: F401

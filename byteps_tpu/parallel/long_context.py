@@ -38,22 +38,34 @@ def replicate(mesh: Mesh, tree):
     return jax.device_put(tree, NamedSharding(mesh, P()))
 
 
-def make_dp_sp_train_step(mesh: Mesh, cfg: GPTConfig,
+def _model_for(cfg, attn):
+    """GPT or Llama by config type: both families share the pluggable
+    ``attn_fn`` + explicit ``positions`` contract, so every sp attention
+    (ring / ring_flash / Ulysses) composes with either — including RoPE,
+    which consumes the shard's absolute positions before K/V rotate."""
+    from ..models.llama import Llama, LlamaConfig
+    if isinstance(cfg, LlamaConfig):
+        return Llama(cfg, attn_fn=attn)
+    return GPT(cfg, attn_fn=attn)
+
+
+def make_dp_sp_train_step(mesh: Mesh, cfg,
                           tx: optax.GradientTransformation,
                           attention: str = "ring",
                           donate: bool = True) -> Callable:
     """Build jitted (params, opt_state, batch) -> (params, opt_state, loss)
     over a (dp, sp) mesh.
 
-    ``batch`` holds ``input_ids`` and ``labels`` (both [B, T], labels
-    already shifted, -1 = ignore), sharded via :func:`shard_lm_batch`.
-    ``attention`` is "ring", "ring_flash" (ring rotation with Pallas
-    flash block kernels), "ulysses", "ulysses_flash", or "flash" (local
-    flash kernels, sp=1 only).
+    ``cfg`` is a :class:`GPTConfig` or :class:`LlamaConfig` (family picked
+    by type).  ``batch`` holds ``input_ids`` and ``labels`` (both [B, T],
+    labels already shifted, -1 = ignore), sharded via
+    :func:`shard_lm_batch`.  ``attention`` is "ring", "ring_flash" (ring
+    rotation with Pallas flash block kernels), "ulysses",
+    "ulysses_flash", or "flash" (local flash kernels, sp=1 only).
     """
     from .sequence import resolve_sp_attention
     attn = resolve_sp_attention(attention, mesh=mesh)
-    model = GPT(cfg, attn_fn=attn)
+    model = _model_for(cfg, attn)
     axes = (DP_AXIS, SP_AXIS)
 
     def step(params, opt_state, batch):

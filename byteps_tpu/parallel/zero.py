@@ -26,8 +26,9 @@ collectives:
   (GSPMD annotations; XLA gathers each layer where used and re-gathers
   under remat): measured 1.55x lower transient footprint at 34M params
   on the 8-device CPU mesh (tools/fsdp_memory.py; docs/performance.md).
-  Use this path for bandwidth-shaped steps on models that fit; use
-  `fsdp_tp` when the transient peak is the constraint.
+  Use zero.py's flat path for bandwidth-shaped steps on models whose
+  transient peak fits; use `fsdp_tp`'s streamed path when that peak is
+  the constraint.
 
 Both steps are one jitted ``shard_map`` over the ``(dcn, ici)`` mesh — the
 collectives ride ICI within a slice and DCN between slices, exactly like
@@ -296,22 +297,32 @@ def make_fsdp_train_step(comm: CommContext, loss_fn: Callable,
         master = optax.apply_updates(master, updates)
         return master, opt_state, lax.pmean(loss, comm.dp_axes)
 
-    def wrapper(zstate, batch):
+    def _build(zstate, jit_donate):
         padded = zstate.master.shape[0]
-        key = (jax.tree.structure(zstate), padded)
+        o_spec = _spec_of_opt(zstate.opt_state, padded, axes)
+        mapped = jax.shard_map(
+            step, mesh=comm.mesh,
+            in_specs=(P(axes), o_spec, P(comm.dp_axes)),
+            out_specs=(P(axes), o_spec, P()),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1) if jit_donate else ())
+
+    def wrapper(zstate, batch):
+        key = (jax.tree.structure(zstate), zstate.master.shape[0])
         fn = cache.get(key)
         if fn is None:
-            o_spec = _spec_of_opt(zstate.opt_state, padded, axes)
-            mapped = jax.shard_map(
-                step, mesh=comm.mesh,
-                in_specs=(P(axes), o_spec, P(comm.dp_axes)),
-                out_specs=(P(axes), o_spec, P()),
-                check_vma=False)
-            fn = cache[key] = jax.jit(
-                mapped, donate_argnums=(0, 1) if donate else ())
+            fn = cache[key] = _build(zstate, donate)
         master, opt_state, loss = fn(zstate.master, zstate.opt_state, batch)
         return ZeroState(master, opt_state), loss
 
+    def lower(zstate, batch):
+        """AOT-lower the EXACT step this wrapper executes (memory/HLO
+        inspection — tools/fsdp_memory.py measures the real program, not
+        a re-implementation)."""
+        return _build(zstate, False).lower(zstate.master, zstate.opt_state,
+                                           batch)
+
+    wrapper.lower = lower
     return wrapper
 
 

@@ -140,3 +140,34 @@ def test_dp_sp_training_matches_single_device_exactly():
                    key=lambda kv: str(kv[0]))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    rtol=2e-3, atol=2e-4, err_msg=str(ka))
+
+
+def test_llama_dp_sp_matches_single_device():
+    """The Llama family rides the same (dp, sp) composite: RoPE consumes
+    each shard's absolute positions before the ring rotates K/V, so the
+    sharded ring-attention model must equal the unsharded one on
+    identical params/batch (f32 for bit-comparable math)."""
+    import dataclasses
+    from byteps_tpu.models.llama import Llama, llama_tiny, lm_loss as llm_loss
+
+    cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32)
+    rng = jax.random.PRNGKey(3)
+    batch = synthetic_lm_batch(rng, cfg, batch=4, seq_len=64)
+    model = Llama(cfg)
+    params = model.init(rng, batch["input_ids"][:1])
+    logits = model.apply(params, batch["input_ids"])
+    ref_loss = float(llm_loss(logits, batch["labels"]))
+
+    mesh = make_sp_mesh(n_sp=4)
+    tx = optax.sgd(0.1)
+    step = make_dp_sp_train_step(mesh, cfg, tx, attention="ring",
+                                 donate=False)
+    p = replicate(mesh, params)
+    o = replicate(mesh, tx.init(params))
+    b = shard_lm_batch(mesh, batch)
+    losses = []
+    for _ in range(3):
+        p, o, loss = step(p, o, b)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], ref_loss, rtol=1e-5, atol=1e-6)
+    assert losses[-1] < losses[0], losses

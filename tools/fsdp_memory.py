@@ -24,11 +24,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _force_cpu8_flags() -> None:
+    """Strip any pre-existing device-count flag and pin 8 (a stale lower
+    count would silently change what the published numbers measure)."""
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
 def main() -> int:
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
-    if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-        os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+    _force_cpu8_flags()
     import jax
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -93,45 +100,16 @@ def main() -> int:
 
 
 def _measure_flat(comm, loss_fn, tx, params, b_dp):
-    """Lower the flat-vector FSDP step exactly as zero.py builds it and
-    read the compiled memory stats."""
-    import jax
-    from jax.sharding import PartitionSpec as P
-    import numpy as np
-    from byteps_tpu.parallel.zero import (ZeroState, _spec_of_opt,
-                                          _unraveler, init_zero_state,
-                                          _cast_like_template)
-    import jax.numpy as jnp
-    import optax
-    from jax import lax
+    """Lower THE step zero.py builds (via its `.lower` hook — not a
+    re-implementation that could drift) and read the compiled memory
+    stats."""
+    from byteps_tpu.parallel.zero import (init_zero_state,
+                                          make_fsdp_train_step)
 
     zstate = init_zero_state(comm, tx, params)
-    axes = comm.dp_axes
-    ranks = comm.num_ranks
-    nelems, unravel = _unraveler(params)
-
-    def step(master, opt_state, batch):
-        pvec = lax.all_gather(master, axes, axis=0, tiled=True)
-        p = unravel(pvec[:nelems])
-        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
-        from jax.flatten_util import ravel_pytree
-        gvec, _ = ravel_pytree(grads)
-        gvec = jnp.pad(gvec.astype(jnp.float32),
-                       (0, master.shape[0] * ranks - gvec.size))
-        gshard = lax.psum_scatter(gvec, axes, scatter_dimension=0,
-                                  tiled=True) / ranks
-        updates, opt_state = tx.update(gshard, opt_state, master)
-        master = optax.apply_updates(master, updates)
-        return master, opt_state, lax.pmean(loss, axes)
-
-    padded = zstate.master.shape[0]
-    o_spec = _spec_of_opt(zstate.opt_state, padded, axes)
-    mapped = jax.shard_map(step, mesh=comm.mesh,
-                           in_specs=(P(axes), o_spec, P(axes)),
-                           out_specs=(P(axes), o_spec, P()),
-                           check_vma=False)
-    lowered = jax.jit(mapped).lower(zstate.master, zstate.opt_state, b_dp)
-    ma = lowered.compile().memory_analysis()
+    fstep = make_fsdp_train_step(comm, loss_fn, tx, params_template=params,
+                                 donate=False)
+    ma = fstep.lower(zstate, b_dp).compile().memory_analysis()
     return {"temp_bytes": int(ma.temp_size_in_bytes),
             "arg_bytes": int(ma.argument_size_in_bytes)}
 

@@ -32,6 +32,14 @@ sys.path.insert(0, REPO)
 
 
 def _setup():
+    # Pin the 8-device CPU mesh ourselves (strip any stale count): a bare
+    # `python tools/mechanism_bench.py` must measure the same multi-rank
+    # configuration bench.py embeds, not a silent 1-device mesh.
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
     from byteps_tpu.comm.mesh import CommContext, _build_mesh

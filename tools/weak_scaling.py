@@ -221,6 +221,23 @@ def validate_wire_formula():
     return n * 4, ar_bytes
 
 
+def _measured_throughput():
+    """Per-chip examples/s from the latest green TPU run — read from
+    BENCH_TPU_MEASURED.json so the projection tracks the hardware record
+    instead of going stale; conservative fallback if absent."""
+    path = os.path.join(REPO, "BENCH_TPU_MEASURED.json")
+    try:
+        with open(path) as f:
+            line = json.load(f)["line"]
+        v = float(line["value"])
+        batch = 32  # bench.py per_dev_batch on TPU
+        if v > 0:
+            return v, batch
+    except Exception:  # noqa: BLE001 - fall through to the recorded value
+        pass
+    return 526.41, 32
+
+
 def analytic_v5e256(measured_step_ms=None, dtype_bytes=2):
     """Project BERT-large DP scaling efficiency at v5e-256.
 
@@ -228,8 +245,8 @@ def analytic_v5e256(measured_step_ms=None, dtype_bytes=2):
     zero overlap (all comm exposed) and full overlap (comm hidden behind
     the backward pass, the reference's priority-scheduling claim)."""
     if measured_step_ms is None:
-        # per-chip measured: 526 ex/s at batch 32 (BENCH_TPU_MEASURED)
-        measured_step_ms = 32 / 526.41 * 1e3
+        ex_per_s, batch = _measured_throughput()
+        measured_step_ms = batch / ex_per_s * 1e3
     grad_bytes = BERT_LARGE_PARAMS * dtype_bytes
     n = 256
     wire = 2 * grad_bytes * (n - 1) / n
