@@ -248,15 +248,14 @@ class PushPullEngine:
         itemsize = np.dtype(stacked.dtype).itemsize
         if use_buffer:
             # Buffer-mode tasks are COLUMN slabs of the [n_ici, C] view
-            # (offset/num in columns); nbytes stays the chunk's real byte
-            # size for credit/telemetry accounting.
+            # (offset/num in columns).  nbytes below is taken from
+            # ctx.chunk_bounds (real element counts), so credit/telemetry
+            # accounting excludes the tail chunk's alignment pad.
             col_layout, C = ctx.scatter_layout
             flat = pad_stacked(self.comm, flat, C * self.comm.n_ici)
             bounds = col_layout
-            unit = self.comm.n_ici * itemsize
         else:
             bounds = ctx.chunk_bounds
-            unit = itemsize
         for part_idx, (off, ln) in enumerate(bounds):
             # parts mode (compressed / debug-sample) needs the materialized
             # chunk; buffer mode and single-chunk tensors pass the full flat
@@ -265,7 +264,8 @@ class PushPullEngine:
             task = ChunkTask(
                 name=name, key=ctx.key_list[part_idx], priority=prio,
                 version=version, offset_elems=off, num_elems=ln,
-                nbytes=ln * unit, total_parts=nchunks,
+                nbytes=ctx.chunk_bounds[part_idx][1] * itemsize,
+                total_parts=nchunks,
                 data=chunk,
                 compression=(ctx.compressor[part_idx]
                              if ctx.compressor else None),

@@ -88,14 +88,18 @@ def rope_frequencies(head_dim: int, positions, theta: float):
 
 
 def apply_rope(x, cos, sin):
-    """Rotate pairs (x[2i], x[2i+1]); x is [B, T, H, D], tables broadcast
-    over the head axis."""
+    """Rotate pairs (x[i], x[i + D/2]) — the *rotate-half* convention used
+    by Llama checkpoints as distributed (HF ``rotate_half``), so pretrained
+    q/k projections import without permutation.  x is [B, T, H, D], tables
+    broadcast over the head axis.  (The interleaved (x[2i], x[2i+1])
+    convention is the same rotation under a fixed channel permutation; we
+    pin the checkpoint-compatible one.)"""
     d2 = x.shape[-1] // 2
-    xf = x.astype(jnp.float32).reshape(*x.shape[:-1], d2, 2)
-    x1, x2 = xf[..., 0], xf[..., 1]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :d2], xf[..., d2:]
     c, s = cos[:, :, None, :], sin[:, :, None, :]
-    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
-    return out.reshape(x.shape).astype(x.dtype)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)
 
 
 class RMSNorm(nn.Module):

@@ -148,6 +148,16 @@ def hierarchical_push_pull(x, ici_axis: str = "ici", dcn_axis: str = "dcn",
         if compress_min_bytes is None:
             compress_min_bytes = dcn_compress_min_bytes()
         if shard.size * shard.dtype.itemsize < compress_min_bytes:
+            # Size gate disables an explicitly supplied compressor: say so
+            # (once per shape — this runs at trace time, not per step).
+            # Callers wanting unconditional compression pass
+            # compress_min_bytes=0.
+            from ..common.logging import get_logger
+            get_logger().debug(
+                "hierarchical_push_pull: DCN shard %d B < compress_min_bytes"
+                " %d B; compressed hop disabled for this tensor "
+                "(pass compress_min_bytes=0 to force)",
+                shard.size * shard.dtype.itemsize, compress_min_bytes)
             compress = None
     if compress is not None:
         # all_gather the compressed shards over DCN and decompress-sum:

@@ -136,18 +136,31 @@ def shard_llama_batch(mesh: Mesh, batch):
 def init_llama_opt_state(tx: optax.GradientTransformation, sharded_params):
     """tx.init with moment buffers pinned to the param shardings (zeros
     carry no data dependence, so propagation alone would replicate them).
-    Optimizer-state leaves that mirror a param (same shape+dtype — adam
-    mu/nu etc.) inherit that param's sharding; scalars (step counts) stay
-    replicated."""
-    params_flat = jax.tree.leaves(sharded_params)
-    by_shape = {}
-    for p in params_flat:
-        by_shape.setdefault((p.shape, str(p.dtype)), p.sharding)
-    mesh = params_flat[0].sharding.mesh
+
+    Optimizer-state subtrees that mirror the params (adam mu/nu etc.) nest
+    the params' own tree structure, so each state leaf's key path *ends
+    with* some param's key path — match structurally on that suffix
+    (longest match wins) rather than by (shape, dtype), which silently
+    mis-pins when two differently-sharded params share a shape (e.g. a
+    square weight when hidden == intermediate).  Leaves matching no param
+    path (step counts, scalars) stay replicated."""
+    params_with_path = jax.tree_util.tree_flatten_with_path(sharded_params)[0]
+    # longest path first so "layers_0/w" beats a bare "w"
+    by_path = sorted(((_path_str(kp), p) for kp, p in params_with_path),
+                     key=lambda kv: -len(kv[0]))
+    mesh = params_with_path[0][1].sharding.mesh
     rep = NamedSharding(mesh, P())
+
+    def sharding_for(key_path, leaf):
+        path = _path_str(key_path)
+        for ppath, p in by_path:
+            if ((path == ppath or path.endswith("/" + ppath))
+                    and leaf.shape == p.shape):
+                return p.sharding
+        return rep
+
     shapes = jax.eval_shape(tx.init, sharded_params)
-    out_sh = jax.tree.map(
-        lambda s: by_shape.get((s.shape, str(s.dtype)), rep), shapes)
+    out_sh = jax.tree_util.tree_map_with_path(sharding_for, shapes)
     return jax.jit(tx.init, out_shardings=out_sh)(sharded_params)
 
 

@@ -32,7 +32,8 @@ def _cfg():
 # ------------------------------------------------------------------ rotary
 
 def test_rope_matches_naive():
-    """apply_rope == the textbook complex-rotation formula."""
+    """apply_rope == the rotate-half formula (HF Llama checkpoint
+    convention: pair (x[i], x[i+d/2]), not interleaved)."""
     d, t = 8, 16
     x = np.random.RandomState(0).randn(1, t, 2, d).astype(np.float32)
     pos = jnp.arange(t)[None]
@@ -43,9 +44,9 @@ def test_rope_matches_naive():
     ang = np.arange(t)[:, None] * inv[None]  # [t, d/2]
     want = np.empty_like(x)
     for h in range(2):
-        x1, x2 = x[0, :, h, 0::2], x[0, :, h, 1::2]
-        want[0, :, h, 0::2] = x1 * np.cos(ang) - x2 * np.sin(ang)
-        want[0, :, h, 1::2] = x1 * np.sin(ang) + x2 * np.cos(ang)
+        x1, x2 = x[0, :, h, :d // 2], x[0, :, h, d // 2:]
+        want[0, :, h, :d // 2] = x1 * np.cos(ang) - x2 * np.sin(ang)
+        want[0, :, h, d // 2:] = x1 * np.sin(ang) + x2 * np.cos(ang)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
@@ -262,6 +263,28 @@ def test_fsdp_tp_step_trains_and_keeps_placement():
     # adam moments are sharded like their params (memory scaling claim)
     mu = opt[0].mu["params"]["h0"]["mlp"]["gate"]["kernel"]
     assert mu.addressable_shards[0].data.shape == shard.shape
+
+
+def test_opt_state_sharding_survives_shape_collision():
+    """Two params with identical shape+dtype but different shardings must
+    each get their own sharding on the adam moments — the structural
+    (key-path suffix) match can't be fooled the way a (shape, dtype)
+    lookup was (round-3 ADVICE: square weights when hidden ==
+    intermediate)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from byteps_tpu.parallel.mesh_util import make_2d_mesh
+
+    mesh = make_2d_mesh(jax.devices()[:8], 2, ("fsdp", "tp"))
+    sh_a = NamedSharding(mesh, P("fsdp", "tp"))
+    sh_b = NamedSharding(mesh, P("tp", "fsdp"))
+    params = {
+        "a": {"kernel": jax.device_put(jnp.ones((8, 8)), sh_a)},
+        "b": {"kernel": jax.device_put(jnp.ones((8, 8)), sh_b)},
+    }
+    opt = init_llama_opt_state(optax.adam(1e-3), params)
+    mu = opt[0].mu
+    assert mu["a"]["kernel"].sharding.spec == P("fsdp", "tp")
+    assert mu["b"]["kernel"].sharding.spec == P("tp", "fsdp")
 
 
 def test_unsharded_params_rejected():
