@@ -642,6 +642,18 @@ def _merge_mechanisms(line: str) -> str:
                                timeout=900.0, env=env)
 
 
+def _merge_overlap(line: str) -> str:
+    """End-to-end overlap section (round-3 VERDICT task 2): full torch
+    training steps through the engine in sync vs cross-barrier mode, with
+    a no-communication floor — the measured answer to the reference's
+    0-15% overlap claim (tools/overlap_bench.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _cpu8_flags()
+    return _merge_tool_section(line, "overlap", "overlap_bench.py",
+                               timeout=900.0, env=env)
+
+
 def _merge_dcn_compare(line: str) -> str:
     """If the main bench ran single-chip (no dcn_compare), obtain it from a
     virtual 8-device CPU mesh subprocess and merge into the JSON line."""
@@ -681,8 +693,8 @@ def main() -> int:
                 # one retry of the full bench for transient failures
                 line, err = _run_inner()
             if line is not None:
-                print(_merge_mechanisms(
-                    _merge_scaling(_merge_dcn_compare(line))))
+                print(_merge_overlap(_merge_mechanisms(
+                    _merge_scaling(_merge_dcn_compare(line)))))
                 return 0
             errors.append(f"bench retry failed: {err}")
             break
@@ -699,7 +711,7 @@ def main() -> int:
     }
     line, err = _run_inner(extra_env=env, timeout=900.0)
     if line is not None:
-        print(_merge_mechanisms(_merge_scaling(line)))
+        print(_merge_overlap(_merge_mechanisms(_merge_scaling(line))))
         return 0
     print(json.dumps({
         "metric": "bert_large_mlm_train_throughput_per_chip",

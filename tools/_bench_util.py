@@ -1,0 +1,94 @@
+"""Shared helpers for the bench tools (mechanism_bench, overlap_bench):
+one copy of the CPU-mesh setup, quantile stats, and core pinning, so the
+tools can't silently drift apart in how they measure."""
+
+from __future__ import annotations
+
+import os
+
+
+def setup_cpu8_mesh():
+    """Force the virtual 8-device CPU mesh, stripping any stale count.
+
+    A bare ``python tools/<bench>.py`` must measure the same multi-rank
+    configuration bench.py embeds, not a silent 1-device mesh.  Must run
+    before the first JAX backend use; jax.config.update is the reliable
+    platform switch (the image's sitecustomize consumes JAX_PLATFORMS at
+    interpreter start)."""
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def quantile_stats(samples):
+    """(median, [q25, q75]) in ms from samples in seconds, linearly
+    interpolated.  The IQR is the honesty term: a shared host can't
+    promise tight medians, so every artifact carries its spread."""
+    xs = sorted(samples)
+    n = len(xs)
+
+    def q(p):
+        i = p * (n - 1)
+        lo, hi = int(i), min(int(i) + 1, n - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
+
+    return (round(q(0.5) * 1e3, 1),
+            [round(q(0.25) * 1e3, 1), round(q(0.75) * 1e3, 1)])
+
+
+def pin_cores():
+    """Pin this process to a stable core subset when that actually changes
+    anything; return the pinned set (or None) for the conditions block.
+
+    Pinning cannot evict other processes, but it stops scheduler migration
+    from adding its own variance.  Only a *strict subset* of the available
+    cores is ever reported: pinning to everything is a no-op and recording
+    it would claim a stabilization that didn't happen.  Opt out with
+    BYTEPS_BENCH_PIN=off; choose cores with e.g. BYTEPS_BENCH_PIN=0-3 or
+    BYTEPS_BENCH_PIN=0,2,5 (a bare "0" pins core 0).
+    """
+    spec = os.environ.get("BYTEPS_BENCH_PIN", "")
+    if spec.lower() in ("off", "none"):
+        return None
+    try:
+        avail = sorted(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        return None
+    if spec and spec != "1":
+        try:
+            want = set()
+            for part in spec.split(","):
+                lo, _, hi = part.partition("-")
+                want |= set(range(int(lo), int(hi or lo) + 1))
+            want &= set(avail)
+        except ValueError:
+            return None  # malformed spec: run unpinned rather than die
+    elif len(avail) >= 4:
+        # leave core 0 (interrupt-heavy) out when there's room
+        want = set(avail[1:])
+    else:
+        # 1-3 cores: any default pin is the full set, i.e. a no-op —
+        # don't report a stabilization that didn't happen
+        return None
+    if not want:
+        return None
+    try:
+        os.sched_setaffinity(0, want)
+    except OSError:
+        return None
+    return sorted(want)
+
+
+def conditions_block(pinned=None, note: str = "") -> dict:
+    """The measurement-environment stamp every bench JSON carries."""
+    return {
+        "pinned_cores": pinned,
+        "host_cores": os.cpu_count(),
+        "loadavg_1m": (round(os.getloadavg()[0], 2)
+                       if hasattr(os, "getloadavg") else None),
+        "note": note,
+    }

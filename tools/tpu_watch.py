@@ -118,12 +118,17 @@ def log_probe(result):
     if not doc.get("started"):
         doc["started"] = stamp
     doc["last"] = stamp
-    doc.setdefault("probes", []).append({"t": stamp, "result": result})
-    doc["n_probes"] = len(doc["probes"])
-    doc["n_green"] = sum(1 for p in doc["probes"]
-                         if p["result"] not in (None, "red")
-                         and isinstance(p["result"], dict)
-                         and p["result"].get("platform") != "cpu")
+    green = (isinstance(result, dict)
+             and result.get("platform") != "cpu")
+    probes = doc.setdefault("probes", [])
+    probes.append({"t": stamp, "result": result})
+    # Running counters + a capped tail: a multi-day watch stays O(1) per
+    # probe while the totals still prove how long it ran and what it saw.
+    doc["n_probes"] = doc.get("n_probes", 0) + 1
+    doc["n_green"] = doc.get("n_green", 0) + (1 if green else 0)
+    if len(probes) > 500:
+        del probes[:len(probes) - 500]
+        doc["probes_truncated_to_last"] = 500
     _atomic_dump(doc, WATCHLOG)
 
 
