@@ -442,6 +442,69 @@ def _bench_flash(devices):
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
+def _bench_bf16_fsdp_tp():
+    """bf16 (fsdp, tp) Llama composite: train llama_tiny (bf16 by
+    default) a few steps and record the loss trajectory (round-3 VERDICT
+    task 7: bf16 composite loss from either backend).
+
+    Subprocess-isolated: the related 3D-path bug is a process-killing XLA
+    CHECK (tests/test_three_d.py canary), so a regression here must
+    report, not kill the bench.  Runs on whatever backend the bench is on
+    — the GSPMD jit path compiles bf16 fine even on CPU (unlike the
+    partial-manual shard_map psum the 3D path needs)."""
+    import subprocess
+    code = r"""
+import os, json
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags and \
+        os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+import optax
+from byteps_tpu.models.llama import Llama, llama_tiny
+from byteps_tpu.parallel.fsdp_tp import (make_fsdp_tp_mesh,
+    shard_llama_params, shard_llama_batch, init_llama_opt_state,
+    make_fsdp_tp_train_step)
+from byteps_tpu.parallel.long_context import synthetic_lm_batch
+devs = jax.devices()
+n_tp = 2 if len(devs) >= 2 else 1
+n_use = (len(devs) // n_tp) * n_tp
+cfg = llama_tiny()
+mesh = make_fsdp_tp_mesh(devs[:n_use], n_tp=n_tp)
+model = Llama(cfg)
+rng = jax.random.PRNGKey(0)
+batch = synthetic_lm_batch(rng, cfg, batch=8, seq_len=16)
+params = shard_llama_params(mesh, model.init(rng, batch["input_ids"][:1]))
+tx = optax.adam(1e-2)
+opt = init_llama_opt_state(tx, params)
+step = make_fsdp_tp_train_step(mesh, cfg, tx)
+b = shard_llama_batch(mesh, batch)
+losses = []
+for _ in range(8):
+    params, opt, loss = step(params, opt, b)
+    losses.append(round(float(loss), 4))
+print("BF16_FSDP_TP " + json.dumps({
+    "dtype": "bfloat16", "mesh": f"fsdp={n_use // n_tp} x tp={n_tp}",
+    "platform": devs[0].platform, "losses": losses,
+    "decreased": losses[-1] < losses[0]}))
+"""
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=600,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"error": "bf16 composite subprocess timed out"}
+    for line in p.stdout.splitlines():
+        if line.startswith("BF16_FSDP_TP "):
+            return json.loads(line.split(" ", 1)[1])
+    return {"error": (f"rc={p.returncode}: "
+                      + (p.stderr or p.stdout or "")[-300:]),
+            "canary": "tests/test_three_d.py tracks the related XLA bug"}
+
+
 def inner_main() -> int:
     """Full bench; assumes the backend choice was made by the environment."""
     import jax
@@ -512,6 +575,7 @@ def inner_main() -> int:
         "push_pull_gbps": push_pull,
         "onebit_pallas": pallas,
         "flash_attention": flash,
+        "bf16_fsdp_tp": _bench_bf16_fsdp_tp(),
     }
     if resnet is not None:
         result["resnet50"] = resnet
@@ -654,6 +718,18 @@ def _merge_overlap(line: str) -> str:
                                timeout=900.0, env=env)
 
 
+def _merge_aot_memory(line: str) -> str:
+    """8B feasibility section (round-3 VERDICT task 6): XLA memory
+    analysis of the AOT-compiled (fsdp, tp) Llama-3-8B train step —
+    per-device persistent/transient bytes vs v5e HBM, layer-count trend
+    (tools/aot_memory.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _cpu8_flags()
+    return _merge_tool_section(line, "aot_memory_8b", "aot_memory.py",
+                               timeout=900.0, env=env)
+
+
 def _merge_dcn_compare(line: str) -> str:
     """If the main bench ran single-chip (no dcn_compare), obtain it from a
     virtual 8-device CPU mesh subprocess and merge into the JSON line."""
@@ -693,8 +769,8 @@ def main() -> int:
                 # one retry of the full bench for transient failures
                 line, err = _run_inner()
             if line is not None:
-                print(_merge_overlap(_merge_mechanisms(
-                    _merge_scaling(_merge_dcn_compare(line)))))
+                print(_merge_aot_memory(_merge_overlap(_merge_mechanisms(
+                    _merge_scaling(_merge_dcn_compare(line))))))
                 return 0
             errors.append(f"bench retry failed: {err}")
             break
@@ -711,7 +787,8 @@ def main() -> int:
     }
     line, err = _run_inner(extra_env=env, timeout=900.0)
     if line is not None:
-        print(_merge_overlap(_merge_mechanisms(_merge_scaling(line))))
+        print(_merge_aot_memory(_merge_overlap(
+            _merge_mechanisms(_merge_scaling(line)))))
         return 0
     print(json.dumps({
         "metric": "bert_large_mlm_train_throughput_per_chip",

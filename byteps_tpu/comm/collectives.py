@@ -200,15 +200,16 @@ def stage_local_replicated(comm: CommContext, flat) -> jax.Array:
     n-byte host->device put, then an async device->devices replication.
 
     The stacked path stages a numpy broadcast *view* [R, n] of the same
-    buffer: R separate n-byte host copies, all host-blocking (measured
-    35 ms for 8 MB on the CPU mesh).  The two-hop put here blocks the
-    host ~0.3 ms (the replication fan-out runs in the device runtime,
-    overlapping with chunk dispatch) and completes in ~9.6 ms total —
-    the round-3 VERDICT "host staging is the realistic path's
-    bottleneck" fix.  The reference pipelines the same stage off its
-    host thread (shm write + NCCL broadcast, core_loops.cc:378-443).
-    Only valid when every rank's contribution is the same host array —
-    i.e. the single-process local push_pull path.
+    buffer: R separate n-byte host copies (quiet 1-core CPU mesh, 8 MB:
+    8.5 ms host-blocking, 19.5 ms total).  The two-hop put here measures
+    2.1 ms host-blocking / 12.7 ms total on the same host — the
+    replication fan-out runs in the device runtime, overlapping with
+    chunk dispatch (docs/performance.md "Host staging" table; round-3
+    VERDICT "host staging is the realistic path's bottleneck" fix).  The
+    reference pipelines the same stage off its host thread (shm write +
+    NCCL broadcast, core_loops.cc:378-443).  Only valid when every
+    rank's contribution is the same host array — i.e. the single-process
+    local push_pull path.
     """
     rep = comm.replicated_sharding()
     if isinstance(flat, jax.Array) and flat.sharding == rep:

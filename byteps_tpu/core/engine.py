@@ -266,10 +266,10 @@ class PushPullEngine:
         else:  # keep the hot enqueue path lock-free when tracing is off
             step, t_enq = 0, 0.0
         if local:
-            # One n-byte host->device put + on-device replication: the
-            # whole-tensor [R, n] broadcast-view staging this replaces was
-            # R copies of the same bytes (measured 35 ms vs 1.5 ms host-
-            # blocking for 8 MB on the CPU mesh).
+            # One n-byte host->device put + async on-device replication:
+            # replaces R host copies of the broadcast view (measured
+            # numbers in stage_local_replicated's docstring and the
+            # docs/performance.md "Host staging" table).
             flat = stage_local_replicated(
                 self.comm, np.asarray(stacked).reshape(-1))
         else:

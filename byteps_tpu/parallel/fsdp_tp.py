@@ -144,24 +144,37 @@ def init_llama_opt_state(tx: optax.GradientTransformation, sharded_params):
     mis-pins when two differently-sharded params share a shape (e.g. a
     square weight when hidden == intermediate).  Leaves matching no param
     path (step counts, scalars) stay replicated."""
-    params_with_path = jax.tree_util.tree_flatten_with_path(sharded_params)[0]
+    shardings = jax.tree.map(lambda p: p.sharding, sharded_params)
+    mesh = jax.tree.leaves(sharded_params)[0].sharding.mesh
+    out_sh = llama_opt_shardings(tx, mesh, sharded_params, shardings)
+    return jax.jit(tx.init, out_shardings=out_sh)(sharded_params)
+
+
+def llama_opt_shardings(tx: optax.GradientTransformation, mesh: Mesh,
+                        params, param_shardings):
+    """Optimizer-state sharding tree via key-path-suffix structural match
+    (see :func:`init_llama_opt_state`).  ``params`` may be real arrays or
+    ``jax.ShapeDtypeStruct``s — AOT memory analysis uses the latter to
+    place 8B-scale state without materializing it."""
+    params_with_path = jax.tree_util.tree_flatten_with_path(params)[0]
+    sh_leaves = jax.tree.leaves(param_shardings)
     # longest path first so "layers_0/w" beats a bare "w"
-    by_path = sorted(((_path_str(kp), p) for kp, p in params_with_path),
-                     key=lambda kv: -len(kv[0]))
-    mesh = params_with_path[0][1].sharding.mesh
+    by_path = sorted(
+        ((_path_str(kp), p.shape, sh)
+         for (kp, p), sh in zip(params_with_path, sh_leaves)),
+        key=lambda kv: -len(kv[0]))
     rep = NamedSharding(mesh, P())
 
     def sharding_for(key_path, leaf):
         path = _path_str(key_path)
-        for ppath, p in by_path:
+        for ppath, pshape, sh in by_path:
             if ((path == ppath or path.endswith("/" + ppath))
-                    and leaf.shape == p.shape):
-                return p.sharding
+                    and leaf.shape == pshape):
+                return sh
         return rep
 
-    shapes = jax.eval_shape(tx.init, sharded_params)
-    out_sh = jax.tree_util.tree_map_with_path(sharding_for, shapes)
-    return jax.jit(tx.init, out_shardings=out_sh)(sharded_params)
+    shapes = jax.eval_shape(tx.init, params)
+    return jax.tree_util.tree_map_with_path(sharding_for, shapes)
 
 
 def make_fsdp_tp_train_step(mesh: Mesh, cfg: LlamaConfig,

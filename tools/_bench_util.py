@@ -49,7 +49,8 @@ def pin_cores():
     cores is ever reported: pinning to everything is a no-op and recording
     it would claim a stabilization that didn't happen.  Opt out with
     BYTEPS_BENCH_PIN=off; choose cores with e.g. BYTEPS_BENCH_PIN=0-3 or
-    BYTEPS_BENCH_PIN=0,2,5 (a bare "0" pins core 0).
+    BYTEPS_BENCH_PIN=0,2,5 (a bare "1" pins core 1 — every non-empty
+    value that isn't "off"/"none" is a core spec).
     """
     spec = os.environ.get("BYTEPS_BENCH_PIN", "")
     if spec.lower() in ("off", "none"):
@@ -58,7 +59,7 @@ def pin_cores():
         avail = sorted(os.sched_getaffinity(0))
     except AttributeError:  # non-Linux
         return None
-    if spec and spec != "1":
+    if spec:
         try:
             want = set()
             for part in spec.split(","):

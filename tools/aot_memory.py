@@ -1,0 +1,164 @@
+"""8B-scale (fsdp, tp) feasibility by AOT compilation — no execution.
+
+Round-3 VERDICT task 6: the flagship (fsdp, tp) Llama config is parity-
+tested at toy scale, but nothing showed the BASELINE.json configs[4]
+target — Llama-3-8B — fits per-device HBM at a plausible mesh.  Execution
+at 8B needs hardware; *placement* does not: ``jax.jit(...).lower(...)``
+over ``ShapeDtypeStruct``s compiles the full sharded train step without
+materializing a single parameter, and XLA's ``memory_analysis()`` reports
+per-device argument (persistent: params + opt state), output, alias
+(donation overlap) and temp (transient: activations, gradients,
+collective buffers) bytes.
+
+The tool compiles the step at Llama-3-8B geometry with a layer-count
+sweep (1/2/4/8), fits the per-layer slope, reports the measured 8-layer
+point and the projected full-depth (32-layer) footprint per device, and
+compares against v5e HBM (16 GB).  Set ``BYTEPS_AOT_FULL=1`` to also
+compile the full 32-layer program directly (minutes of XLA time).
+
+Prints one JSON object; bench.py embeds it as the "aot_memory_8b"
+section.  Reference scale claim being answered:
+/root/reference/README.md:35-41 (BERT-large at 256 GPUs); the rebuild's
+flagship is 8B-class with composite sharding instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools._bench_util import setup_cpu8_mesh  # noqa: E402
+
+V5E_HBM_BYTES = 16 * 1024**3
+FULL_LAYERS = 32
+GiB = float(1024**3)
+
+
+def compile_step(n_layers: int, n_tp: int = 4, batch: int = 8,
+                 seq: int = 2048, remat: bool = True, flash: bool = True):
+    """AOT-compile the (fsdp, tp) train step at 8B geometry with
+    ``n_layers`` layers; return the XLA memory stats (per device).
+
+    ``remat=True`` + ``flash=True`` is the deployable configuration:
+    checkpointed blocks plus flash attention (no [B, H, T, T] score
+    materialization — the O(T) memory path every production long-context
+    config uses)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from byteps_tpu.models.llama import Llama, llama3_8b, lm_loss
+    from byteps_tpu.parallel.fsdp_tp import (
+        llama_opt_shardings, llama_shardings, make_fsdp_tp_mesh)
+
+    cfg = dataclasses.replace(llama3_8b(), num_layers=n_layers,
+                              remat=remat)
+    mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=n_tp)
+    attn_fn = None
+    if flash:
+        from byteps_tpu.ops.flash_attention import flash_attention
+        attn_fn = flash_attention
+    model = Llama(cfg, attn_fn=attn_fn)
+    tx = optax.adamw(3e-4)
+
+    ids = jnp.zeros((1, 8), jnp.int32)
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0), ids)
+    shardings = llama_shardings(mesh, shapes)
+    p_structs = jtu.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings)
+    opt_sh = llama_opt_shardings(tx, mesh, p_structs, shardings)
+    o_structs = jtu.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        jax.eval_shape(tx.init, p_structs), opt_sh)
+    bsh = NamedSharding(mesh, P("fsdp", None))
+    batch_structs = {
+        "input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32,
+                                          sharding=bsh),
+        "labels": jax.ShapeDtypeStruct((batch, seq), jnp.int32,
+                                       sharding=bsh),
+    }
+
+    def step(params, opt_state, b):
+        def loss_fn(p):
+            return lm_loss(model.apply(p, b["input_ids"]), b["labels"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    compiled = jax.jit(step, donate_argnums=(0, 1)).lower(
+        p_structs, o_structs, batch_structs).compile()
+    ma = compiled.memory_analysis()
+    return {
+        "n_layers": n_layers,
+        "argument_gib": round(ma.argument_size_in_bytes / GiB, 3),
+        "temp_gib": round(ma.temp_size_in_bytes / GiB, 3),
+        "output_gib": round(ma.output_size_in_bytes / GiB, 3),
+        "alias_gib": round(ma.alias_size_in_bytes / GiB, 3),
+    }
+
+
+def main() -> int:
+    setup_cpu8_mesh()
+    # exact attention for the sweep: interpret-mode pallas (the CPU stand-
+    # in for flash) allocates interpreter scratch that a Mosaic TPU kernel
+    # never materializes, so it would *inflate* the transient numbers
+    sweep = []
+    for n in (1, 2, 4, 8):
+        sweep.append(compile_step(n, flash=False))
+    # linear fit of persistent + transient vs layer count from the two
+    # largest points (embedding/unembedding are the fixed intercept)
+    a, b = sweep[-2], sweep[-1]
+    d_layers = b["n_layers"] - a["n_layers"]
+    arg_slope = (b["argument_gib"] - a["argument_gib"]) / d_layers
+    tmp_slope = (b["temp_gib"] - a["temp_gib"]) / d_layers
+    proj_arg = b["argument_gib"] + arg_slope * (FULL_LAYERS - b["n_layers"])
+    proj_tmp = b["temp_gib"] + tmp_slope * (FULL_LAYERS - b["n_layers"])
+    out = {
+        "mesh": "fsdp=2 x tp=4 (8 devices)",
+        "geometry": "Llama-3-8B (4096h/32q/8kv/14336ffn), batch 8 x 2048, "
+                    "f32 params + adamw moments, remat blocks",
+        "sweep_per_device": sweep,
+        "per_layer_gib": {"argument": round(arg_slope, 3),
+                          "temp": round(tmp_slope, 3)},
+        "projected_32_layers_per_device_gib": {
+            "argument": round(proj_arg, 2),
+            "temp": round(proj_tmp, 2),
+        },
+        "v5e_hbm_gib": 16,
+        # argument bytes are exact and backend-independent: the sharded
+        # params + adamw state the mesh must persistently hold per device
+        "persistent_fits_v5e_8dev": bool(proj_arg * GiB < V5E_HBM_BYTES),
+        "persistent_at_16dev_gib_est": round(proj_arg / 2, 2),
+        "temp_caveat": (
+            "temp bytes come from the CPU backend's buffer assignment, "
+            "which demonstrably does not reuse remat'd block buffers "
+            "(remat on/off moves the slope only 2.5->2.27 GiB/layer) and "
+            "cannot run the Mosaic flash kernel; on TPU the transient "
+            "term is bounded by one block's flash working set, not this "
+            "projection.  Treat argument bytes as the feasibility datum "
+            "and temp as an upper bound under exact attention."),
+        "note": ("per-device bytes from XLA memory_analysis of the AOT-"
+                 "compiled donated train step (no execution); scaling the "
+                 "mesh divides every sharded term by the device count, so "
+                 "what is tight at 8 devices is comfortable at v5e-16 "
+                 "(docs/run-on-gke.md deployment shape)"),
+    }
+    if os.environ.get("BYTEPS_AOT_FULL") == "1":
+        out["measured_32_layers_per_device_gib"] = compile_step(
+            FULL_LAYERS, flash=False)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
