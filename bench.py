@@ -442,55 +442,69 @@ def _bench_flash(devices):
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
-def _bench_bf16_fsdp_tp():
-    """bf16 (fsdp, tp) Llama composite: train llama_tiny (bf16 by
-    default) a few steps and record the loss trajectory (round-3 VERDICT
-    task 7: bf16 composite loss from either backend).
+def _bf16_composite_body():
+    """Train the bf16 (fsdp, tp) Llama composite a few steps on the
+    CURRENT backend and return the loss trajectory (round-3 VERDICT
+    task 7: bf16 composite loss from either backend).  Mesh sizing:
+    tp=2 when possible, and fsdp clamped to a divisor of the batch (8)
+    so odd device counts don't fail the batch sharding."""
+    import jax
+    import optax
 
-    Subprocess-isolated: the related 3D-path bug is a process-killing XLA
-    CHECK (tests/test_three_d.py canary), so a regression here must
-    report, not kill the bench.  Runs on whatever backend the bench is on
-    — the GSPMD jit path compiles bf16 fine even on CPU (unlike the
-    partial-manual shard_map psum the 3D path needs)."""
+    from byteps_tpu.models.llama import Llama, llama_tiny
+    from byteps_tpu.parallel.fsdp_tp import (
+        init_llama_opt_state, make_fsdp_tp_mesh, make_fsdp_tp_train_step,
+        shard_llama_batch, shard_llama_params)
+    from byteps_tpu.parallel.long_context import synthetic_lm_batch
+
+    devs = jax.devices()
+    n_tp = 2 if len(devs) >= 2 else 1
+    fsdp = max(f for f in (1, 2, 4, 8) if f <= len(devs) // n_tp)
+    mesh = make_fsdp_tp_mesh(devs[:fsdp * n_tp], n_tp=n_tp)
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    rng = jax.random.PRNGKey(0)
+    batch = synthetic_lm_batch(rng, cfg, batch=8, seq_len=16)
+    params = shard_llama_params(mesh,
+                                model.init(rng, batch["input_ids"][:1]))
+    tx = optax.adam(1e-2)
+    opt = init_llama_opt_state(tx, params)
+    step = make_fsdp_tp_train_step(mesh, cfg, tx)
+    b = shard_llama_batch(mesh, batch)
+    losses = []
+    for _ in range(8):
+        params, opt, loss = step(params, opt, b)
+        losses.append(round(float(loss), 4))
+    return {"dtype": "bfloat16", "mesh": f"fsdp={fsdp} x tp={n_tp}",
+            "platform": devs[0].platform, "losses": losses,
+            "decreased": losses[-1] < losses[0]}
+
+
+def _bench_bf16_fsdp_tp(on_tpu: bool):
+    """bf16 (fsdp, tp) composite section, backend-appropriate isolation.
+
+    On TPU: in-process — libtpu is exclusive to this process, so a child
+    could never open the chip; the GSPMD jit path has no known process-
+    killing failure there (the CHECK crash is the CPU emitter's
+    partial-manual shard_map path, tests/test_three_d.py canary).
+    On CPU: subprocess-isolated against exactly that CHECK, on the
+    virtual 8-device mesh."""
+    if on_tpu:
+        try:
+            return _bf16_composite_body()
+        except Exception as e:  # noqa: BLE001 - section must not kill bench
+            return {"error": f"{type(e).__name__}: {e}"[:300]}
     import subprocess
-    code = r"""
-import os, json
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags and \
-        os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-import jax
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-import optax
-from byteps_tpu.models.llama import Llama, llama_tiny
-from byteps_tpu.parallel.fsdp_tp import (make_fsdp_tp_mesh,
-    shard_llama_params, shard_llama_batch, init_llama_opt_state,
-    make_fsdp_tp_train_step)
-from byteps_tpu.parallel.long_context import synthetic_lm_batch
-devs = jax.devices()
-n_tp = 2 if len(devs) >= 2 else 1
-n_use = (len(devs) // n_tp) * n_tp
-cfg = llama_tiny()
-mesh = make_fsdp_tp_mesh(devs[:n_use], n_tp=n_tp)
-model = Llama(cfg)
-rng = jax.random.PRNGKey(0)
-batch = synthetic_lm_batch(rng, cfg, batch=8, seq_len=16)
-params = shard_llama_params(mesh, model.init(rng, batch["input_ids"][:1]))
-tx = optax.adam(1e-2)
-opt = init_llama_opt_state(tx, params)
-step = make_fsdp_tp_train_step(mesh, cfg, tx)
-b = shard_llama_batch(mesh, batch)
-losses = []
-for _ in range(8):
-    params, opt, loss = step(params, opt, b)
-    losses.append(round(float(loss), 4))
-print("BF16_FSDP_TP " + json.dumps({
-    "dtype": "bfloat16", "mesh": f"fsdp={n_use // n_tp} x tp={n_tp}",
-    "platform": devs[0].platform, "losses": losses,
-    "decreased": losses[-1] < losses[0]}))
-"""
+    code = ("import os, json\n"
+            "flags = os.environ.get('XLA_FLAGS', '')\n"
+            "if 'host_platform_device_count' not in flags:\n"
+            "    os.environ['XLA_FLAGS'] = (flags +"
+            " ' --xla_force_host_platform_device_count=8').strip()\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import bench\n"
+            "print('BF16_FSDP_TP ' +"
+            " json.dumps(bench._bf16_composite_body()))\n")
     try:
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=600,
@@ -575,7 +589,7 @@ def inner_main() -> int:
         "push_pull_gbps": push_pull,
         "onebit_pallas": pallas,
         "flash_attention": flash,
-        "bf16_fsdp_tp": _bench_bf16_fsdp_tp(),
+        "bf16_fsdp_tp": _bench_bf16_fsdp_tp(on_tpu),
     }
     if resnet is not None:
         result["resnet50"] = resnet
@@ -718,6 +732,38 @@ def _merge_overlap(line: str) -> str:
                                timeout=900.0, env=env)
 
 
+def _couple_overlap_to_projection(line: str) -> str:
+    """Narrow the analytic 82-100% bracket with the MEASURED overlap
+    fraction (round-3 VERDICT task 2's second half): the v5e-256
+    projection's exposed-comm term becomes (1 - measured_overlap) * comm
+    instead of an assumed bound.  On a saturated host the measured
+    fraction is ~0 and the estimate lands on the no-overlap end — that
+    is the honest reading for that host, and the conditions block says
+    which host it was."""
+    try:
+        result = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    ov = result.get("overlap") or {}
+    an = (result.get("scaling") or {}).get("analytic_v5e256") or {}
+    frac = ov.get("overlap_fraction")
+    step = an.get("measured_step_ms_per_chip")
+    comm = an.get("allreduce_ms")
+    if frac is None or step is None or comm is None:
+        return line
+    f = min(max(frac, 0.0), 1.0)
+    an["measured_overlap_fraction"] = round(f, 3)
+    an["efficiency_at_measured_overlap"] = round(
+        step / (step + (1.0 - f) * comm), 3)
+    an["overlap_note"] = (
+        "overlap fraction from the end-to-end cross-barrier bench on THIS "
+        "host (overlap.conditions records cores/load); hosts with spare "
+        "transport cores — and TPU pods, where compute runs on-chip — "
+        "land nearer the full-overlap end")
+    result["scaling"]["analytic_v5e256"] = an
+    return json.dumps(result)
+
+
 def _merge_aot_memory(line: str) -> str:
     """8B feasibility section (round-3 VERDICT task 6): XLA memory
     analysis of the AOT-compiled (fsdp, tp) Llama-3-8B train step —
@@ -769,8 +815,9 @@ def main() -> int:
                 # one retry of the full bench for transient failures
                 line, err = _run_inner()
             if line is not None:
-                print(_merge_aot_memory(_merge_overlap(_merge_mechanisms(
-                    _merge_scaling(_merge_dcn_compare(line))))))
+                print(_couple_overlap_to_projection(
+                    _merge_aot_memory(_merge_overlap(_merge_mechanisms(
+                        _merge_scaling(_merge_dcn_compare(line)))))))
                 return 0
             errors.append(f"bench retry failed: {err}")
             break
@@ -787,8 +834,8 @@ def main() -> int:
     }
     line, err = _run_inner(extra_env=env, timeout=900.0)
     if line is not None:
-        print(_merge_aot_memory(_merge_overlap(
-            _merge_mechanisms(_merge_scaling(line)))))
+        print(_couple_overlap_to_projection(_merge_aot_memory(
+            _merge_overlap(_merge_mechanisms(_merge_scaling(line))))))
         return 0
     print(json.dumps({
         "metric": "bert_large_mlm_train_throughput_per_chip",

@@ -1,0 +1,103 @@
+"""Unit tests for the evidence-tool helpers (tools/): the pure logic the
+bench artifacts depend on — core-slice math, pin-spec parsing, quantile
+stats — pinned without wall-clock dependence."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools._bench_util import quantile_stats  # noqa: E402
+from tools.weak_scaling import _core_slices  # noqa: E402
+
+
+def test_quantile_stats_median_and_iqr():
+    med, iqr = quantile_stats([0.1, 0.2, 0.3, 0.4])
+    assert med == 250.0
+    assert iqr == [175.0, 325.0]
+
+
+def test_quantile_stats_single_sample():
+    med, iqr = quantile_stats([0.05])
+    assert med == 50.0 and iqr == [50.0, 50.0]
+
+
+def test_core_slices_disjoint_and_capped(monkeypatch):
+    monkeypatch.setattr(os, "sched_getaffinity",
+                        lambda pid: set(range(8)), raising=False)
+    # same per-worker budget regardless of group size (cap = max group's
+    # share): 1-proc group must NOT get all 8 cores when the cap is 2
+    assert _core_slices(1, cores_per_proc=2) == [[0, 1]]
+    s4 = _core_slices(4, cores_per_proc=2)
+    assert s4 == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    flat = [c for s in s4 for c in s]
+    assert len(flat) == len(set(flat))          # disjoint
+    # infeasible: 4 workers x 3 cores > 8
+    assert _core_slices(4, cores_per_proc=3) is None
+
+
+def test_core_slices_single_core(monkeypatch):
+    monkeypatch.setattr(os, "sched_getaffinity",
+                        lambda pid: {0}, raising=False)
+    assert _core_slices(4) is None
+    assert _core_slices(1) == [[0]]
+
+
+def test_couple_overlap_to_projection():
+    import json
+
+    import bench
+
+    line = json.dumps({
+        "overlap": {"overlap_fraction": 0.5},
+        "scaling": {"analytic_v5e256": {
+            "measured_step_ms_per_chip": 60.0, "allreduce_ms": 20.0,
+            "efficiency_no_overlap": 0.75}},
+    })
+    out = json.loads(bench._couple_overlap_to_projection(line))
+    an = out["scaling"]["analytic_v5e256"]
+    assert an["measured_overlap_fraction"] == 0.5
+    assert an["efficiency_at_measured_overlap"] == round(60 / 70, 3)
+    # negative measured fraction clamps to the no-overlap end
+    line2 = json.dumps({
+        "overlap": {"overlap_fraction": -0.2},
+        "scaling": {"analytic_v5e256": {
+            "measured_step_ms_per_chip": 60.0, "allreduce_ms": 20.0}},
+    })
+    an2 = json.loads(bench._couple_overlap_to_projection(line2))[
+        "scaling"]["analytic_v5e256"]
+    assert an2["efficiency_at_measured_overlap"] == 0.75
+    # missing sections pass through untouched
+    assert bench._couple_overlap_to_projection("{}") == "{}"
+
+
+@pytest.mark.parametrize("spec,avail,want", [
+    ("off", {0, 1, 2, 3}, None),
+    ("none", {0, 1, 2, 3}, None),
+    ("1", {0, 1, 2, 3}, [1]),             # bare "1" is core 1, not a flag
+    ("0", {0, 1, 2, 3}, [0]),
+    ("0-2", {0, 1, 2, 3}, [0, 1, 2]),
+    ("0,2", {0, 1, 2, 3}, [0, 2]),
+    ("bogus", {0, 1, 2, 3}, None),        # malformed: unpinned, not dead
+    ("", {0}, None),                      # 1-core default: nothing to pin
+    ("", {0, 1, 2, 3}, [1, 2, 3]),        # default: all but core 0
+    ("", {0, 1}, None),                   # 2-3 cores: full-set pin is a
+                                          # no-op, don't report one
+])
+def test_pin_cores_spec_parsing(monkeypatch, spec, avail, want):
+    from tools import _bench_util
+
+    monkeypatch.setenv("BYTEPS_BENCH_PIN", spec)
+    monkeypatch.setattr(os, "sched_getaffinity",
+                        lambda pid: set(avail), raising=False)
+    pinned = {}
+    monkeypatch.setattr(os, "sched_setaffinity",
+                        lambda pid, cores: pinned.update(c=sorted(cores)),
+                        raising=False)
+    got = _bench_util.pin_cores()
+    assert got == want
+    if want is not None:
+        assert pinned["c"] == want          # affinity actually applied

@@ -39,14 +39,16 @@ GiB = float(1024**3)
 
 
 def compile_step(n_layers: int, n_tp: int = 4, batch: int = 8,
-                 seq: int = 2048, remat: bool = True, flash: bool = True):
+                 seq: int = 2048, remat: bool = True):
     """AOT-compile the (fsdp, tp) train step at 8B geometry with
     ``n_layers`` layers; return the XLA memory stats (per device).
 
-    ``remat=True`` + ``flash=True`` is the deployable configuration:
-    checkpointed blocks plus flash attention (no [B, H, T, T] score
-    materialization — the O(T) memory path every production long-context
-    config uses)."""
+    Exact attention only: the deployable config would use the Mosaic
+    flash kernel, but this tool runs on the CPU backend where the pallas
+    *interpreter* stands in and allocates scratch a real Mosaic kernel
+    never materializes — measured, it INFLATED the transient slope
+    (2.27 -> 2.86 GiB/layer).  So the sweep compiles exact attention and
+    the output labels its transient column an upper bound."""
     import dataclasses
 
     import jax
@@ -62,11 +64,7 @@ def compile_step(n_layers: int, n_tp: int = 4, batch: int = 8,
     cfg = dataclasses.replace(llama3_8b(), num_layers=n_layers,
                               remat=remat)
     mesh = make_fsdp_tp_mesh(jax.devices()[:8], n_tp=n_tp)
-    attn_fn = None
-    if flash:
-        from byteps_tpu.ops.flash_attention import flash_attention
-        attn_fn = flash_attention
-    model = Llama(cfg, attn_fn=attn_fn)
+    model = Llama(cfg)
     tx = optax.adamw(3e-4)
 
     ids = jnp.zeros((1, 8), jnp.int32)
@@ -109,12 +107,9 @@ def compile_step(n_layers: int, n_tp: int = 4, batch: int = 8,
 
 def main() -> int:
     setup_cpu8_mesh()
-    # exact attention for the sweep: interpret-mode pallas (the CPU stand-
-    # in for flash) allocates interpreter scratch that a Mosaic TPU kernel
-    # never materializes, so it would *inflate* the transient numbers
     sweep = []
     for n in (1, 2, 4, 8):
-        sweep.append(compile_step(n, flash=False))
+        sweep.append(compile_step(n))
     # linear fit of persistent + transient vs layer count from the two
     # largest points (embedding/unembedding are the fixed intercept)
     a, b = sweep[-2], sweep[-1]
@@ -154,8 +149,7 @@ def main() -> int:
                  "(docs/run-on-gke.md deployment shape)"),
     }
     if os.environ.get("BYTEPS_AOT_FULL") == "1":
-        out["measured_32_layers_per_device_gib"] = compile_step(
-            FULL_LAYERS, flash=False)
+        out["measured_32_layers_per_device_gib"] = compile_step(FULL_LAYERS)
     print(json.dumps(out))
     return 0
 

@@ -102,6 +102,21 @@ def record(line: dict):
         "engine_device_gbps": next(
             (v for k, v in (line.get("push_pull_gbps") or {}).items()
              if k.startswith("engine_device")), None),
+        # round-4 additions: the reworked-engine-on-hardware question and
+        # the bf16 composite (VERDICT r3 missing #2 / task 7).
+        # engine_host picks the LARGEST plain engine_<N>MB so all three
+        # figures (host / device / fused) compare the same workload size.
+        "engine_host_gbps": max(
+            ((int(k[len("engine_"):-2]), v)
+             for k, v in (line.get("push_pull_gbps") or {}).items()
+             if k.startswith("engine_") and k.endswith("MB")
+             and k[len("engine_"):-2].isdigit()),
+            default=(None, None))[1],
+        "fused_gbps": next(
+            (v for k, v in (line.get("push_pull_gbps") or {}).items()
+             if k.startswith("fused")), None),
+        "bf16_fsdp_tp_decreased": (line.get("bf16_fsdp_tp") or {}).get(
+            "decreased"),
     })
     _atomic_dump(doc, MEASURED)
 
