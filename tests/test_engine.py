@@ -237,3 +237,47 @@ def test_local_push_after_compressed_declaration_falls_back(bps_session):
     got = np.asarray(eng.push_pull_local(x, "mixed.comp"))
     assert got.shape == x.shape and got.dtype == x.dtype
     np.testing.assert_allclose(got, first, rtol=1e-6, atol=1e-7)
+
+
+def test_concurrent_pushes_from_many_threads(bps_chunked):
+    """Torch autograd hooks push gradients from framework threads while
+    the dispatcher pops concurrently — the registry/scheduler/handle
+    table must survive racing producers (reference: per-tensor mutexes in
+    BytePSGlobal, global.cc).  Every tensor must come back equal to its
+    own input (no cross-tensor mixing), across chunked and single-chunk
+    sizes and repeated versions."""
+    import threading
+
+    from byteps_tpu.core import api
+
+    eng = api._require()
+    rng = np.random.RandomState(11)
+    # sizes straddle the 4096 B partition bound: t0 (500 floats = 2000 B)
+    # rides the single-chunk path, the rest are chunked
+    tensors = {f"race.t{i}": rng.randn(500 + 1500 * i).astype(np.float32)
+               for i in range(6)}
+    results = {}
+    errors = []
+
+    def worker(name, x):
+        try:
+            for _ in range(3):          # repeated versions of each tensor
+                out = eng.push_pull_local(x, name)
+            results[name] = np.asarray(out)
+        except Exception as e:  # noqa: BLE001 - surface in main thread
+            errors.append((name, repr(e)))
+
+    # daemon: a deadlocked producer must FAIL the test, not hang pytest
+    # shutdown on a live non-daemon thread
+    threads = [threading.Thread(target=worker, args=(n, x), daemon=True)
+               for n, x in tensors.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "worker deadlocked"
+    assert not errors, errors
+    assert len(results) == len(tensors)
+    for name, x in tensors.items():
+        np.testing.assert_allclose(results[name], x, rtol=1e-6, atol=1e-7,
+                                   err_msg=name)
