@@ -75,6 +75,15 @@ def llama_tiny() -> LlamaConfig:
                        max_position=512, rope_theta=10000.0)
 
 
+def llama_tiny_f32() -> LlamaConfig:
+    """Even smaller, f32 end to end: the parity tests need bit-comparable
+    math (one definition so every test pins the same geometry)."""
+    return LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                       num_heads=4, num_kv_heads=2, intermediate_size=64,
+                       max_position=64, rope_theta=10000.0,
+                       dtype=jnp.float32)
+
+
 # ----------------------------------------------------------------- rotary
 
 def rope_frequencies(head_dim: int, positions, theta: float):

@@ -253,3 +253,46 @@ def test_fused_step_accum_bf16_loss(session):
     b = shard_batch(comm, {"x": x, "y": y})
     p, o, l_ = step(p, o, b)
     assert np.isfinite(float(l_))
+
+
+def test_llama_dp_with_distributed_optimizer(session):
+    """BASELINE.json configs[4] as literally written: a Llama-family model
+    trained through byteps_tpu.jax.distributed_optimizer wrapping optax —
+    plain DP over the mesh (the composite (fsdp, tp) path has its own
+    suite in test_llama.py)."""
+    from jax import lax
+
+    from byteps_tpu.comm.mesh import get_comm
+    from byteps_tpu.models.llama import Llama, llama_tiny_f32, lm_loss
+    from byteps_tpu.parallel.long_context import synthetic_lm_batch
+
+    comm = get_comm()
+    cfg = llama_tiny_f32()
+    model = Llama(cfg)
+    rng = jax.random.PRNGKey(2)
+    batch = synthetic_lm_batch(rng, cfg, batch=8, seq_len=16)
+    params = model.init(rng, batch["input_ids"][:1])
+    tx = bps_jax.distributed_optimizer(optax.adam(1e-2))
+    state = tx.init(params)
+
+    def step(p, s, ids, labels):
+        def loss_fn(q):
+            return lm_loss(model.apply(q, ids), labels)
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        upd, s = tx.update(g, s, p)   # grads reduced across the mesh here
+        return (optax.apply_updates(p, upd), s,
+                lax.pmean(loss, ("dcn", "ici")))
+
+    sharded = jax.jit(jax.shard_map(
+        step, mesh=comm.mesh,
+        in_specs=(P(), P(), P(("dcn", "ici")), P(("dcn", "ici"))),
+        out_specs=(P(), P(), P()),
+        check_vma=False))
+    losses = []
+    for _ in range(6):
+        params, state, loss = sharded(params, state, batch["input_ids"],
+                                      batch["labels"])
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses

@@ -22,11 +22,10 @@ from byteps_tpu.parallel.long_context import synthetic_lm_batch
 
 
 def _cfg():
-    # f32 end to end: the parity tests need bit-comparable math
-    return LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
-                       num_heads=4, num_kv_heads=2, intermediate_size=64,
-                       max_position=64, rope_theta=10000.0,
-                       dtype=jnp.float32)
+    # f32 end to end: the parity tests need bit-comparable math (one
+    # shared definition — models.llama.llama_tiny_f32)
+    from byteps_tpu.models.llama import llama_tiny_f32
+    return llama_tiny_f32()
 
 
 # ------------------------------------------------------------------ rotary

@@ -3,7 +3,7 @@
 Round-2 VERDICT item 1: the chip drops intermittently, so the bench must be
 run early and often — not once at round end.  This watcher loops:
 
-  1. probe the backend in a subprocess (60 s timeout),
+  1. probe the backend in a subprocess (90 s timeout, probe()'s default),
   2. on green, run the full ``bench.py`` and parse its JSON line,
   3. if the line is a TPU line, write it to ``BENCH_TPU_LATEST.json`` and
      append a dated entry to ``BENCH_TPU_MEASURED.json``'s history,
@@ -168,8 +168,11 @@ def main():
                       f"{str(line)[:200]}", flush=True)
         else:
             print(f"[{now}] probe: chip unreachable", flush=True)
-        # Dense probing until the first green run, then hourly freshness.
-        time.sleep(300 if greens == 0 else 3600)
+        # Dense probing until the first green run (a red probe already
+        # burns its 90 s timeout, so 120 s sleep ≈ 3.5 min cadence —
+        # short green windows are the whole reason this watch exists),
+        # then hourly freshness.
+        time.sleep(120 if greens == 0 else 3600)
 
 
 if __name__ == "__main__":
