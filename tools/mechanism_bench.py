@@ -62,20 +62,27 @@ def priority_latency(comm, n, k_tensors=6, mbytes=4, reps=15):
     from byteps_tpu.core.engine import PushPullEngine
 
     credit = 2 * mbytes * (1 << 20)   # ~2 tensors in flight
+    xs = [np.random.RandomState(i).randn(
+        mbytes * (1 << 20) // 4).astype(np.float32)
+        for i in range(k_tensors)]
+    # Both engines up front, reps INTERLEAVED across them: slow load
+    # drift on a shared host then hits priority and fifo equally instead
+    # of whichever ran last (the round-3 artifact's failure mode).
+    engines = {}
+    lats = {}
     out = {}
-    for tag, prio in (("priority", True), ("fifo", False)):
-        cfg = Config(telemetry_on=False, trace_on=False,
-                     enable_priority=prio, scheduling_credit=credit)
-        eng = PushPullEngine(comm, cfg)
-        try:
-            xs = [np.random.RandomState(i).randn(
-                mbytes * (1 << 20) // 4).astype(np.float32)
-                for i in range(k_tensors)]
+    try:
+        for tag, prio in (("priority", True), ("fifo", False)):
+            cfg = Config(telemetry_on=False, trace_on=False,
+                         enable_priority=prio, scheduling_credit=credit)
+            engines[tag] = (PushPullEngine(comm, cfg), prio)
+            lats[tag] = []
+        for tag, (eng, _) in engines.items():
             # declare in forward order so declared_key (priority) is set
             for i in range(k_tensors):
                 eng.push_pull_local(xs[i], f"layer{i}")  # init + warmup
-            lats = []
-            for _ in range(reps):
+        for _ in range(reps):
+            for tag, (eng, prio) in engines.items():
                 handles = {}
                 # enqueue in REVERSE (backward produces last layer first).
                 # The fifo baseline pins priority to arrival order — what
@@ -90,13 +97,15 @@ def priority_latency(comm, n, k_tensors=6, mbytes=4, reps=15):
                         **({} if prio else {"priority": -pos}))
                 t0 = time.perf_counter()
                 handles[0].wait()           # the next forward's first need
-                lats.append(time.perf_counter() - t0)
+                lats[tag].append(time.perf_counter() - t0)
                 for h in handles.values():
                     h.wait()
-            med, iqr = quantile_stats(lats)
+        for tag in engines:
+            med, iqr = quantile_stats(lats[tag])
             out[f"layer0_ready_ms_{tag}"] = med
             out[f"layer0_ready_{tag}_iqr_ms"] = iqr
-        finally:
+    finally:
+        for eng, _ in engines.values():
             eng.shutdown(wait=False)
     out["speedup"] = round(out["layer0_ready_ms_fifo"]
                            / max(out["layer0_ready_ms_priority"], 1e-9), 2)
@@ -117,32 +126,40 @@ def partition_latency(comm, n, big_mb=64, small_kb=256, reps=15):
     from byteps_tpu.common.config import Config
     from byteps_tpu.core.engine import PushPullEngine
 
+    big = np.random.RandomState(0).randn(
+        big_mb * (1 << 20) // 4).astype(np.float32)
+    small = np.random.RandomState(1).randn(
+        small_kb * 1024 // 4).astype(np.float32)
+    engines = {}
+    lats = {}
     out = {}
-    for tag, pbytes in (("partitioned", 4096 * 1000),
-                        ("whole", 2**31 - 512)):
-        cfg = Config(telemetry_on=False, trace_on=False,
-                     partition_bytes=pbytes,
-                     scheduling_credit=8 * (1 << 20))
-        eng = PushPullEngine(comm, cfg)
-        try:
-            big = np.random.RandomState(0).randn(
-                big_mb * (1 << 20) // 4).astype(np.float32)
-            small = np.random.RandomState(1).randn(
-                small_kb * 1024 // 4).astype(np.float32)
+    try:
+        for tag, pbytes in (("partitioned", 4096 * 1000),
+                            ("whole", 2**31 - 512)):
+            cfg = Config(telemetry_on=False, trace_on=False,
+                         partition_bytes=pbytes,
+                         scheduling_credit=8 * (1 << 20))
+            engines[tag] = PushPullEngine(comm, cfg)
+            lats[tag] = []
+        for eng in engines.values():
             eng.push_pull_local(small, "urgent", priority=10)
             eng.push_pull_local(big, "bulk", priority=-10)
-            lats = []
-            for _ in range(reps):
+        # reps interleaved across configs so drift cancels (see
+        # priority_latency)
+        for _ in range(reps):
+            for tag, eng in engines.items():
                 hb = eng.push_pull_local_async(big, "bulk", priority=-10)
                 hs = eng.push_pull_local_async(small, "urgent", priority=10)
                 t0 = time.perf_counter()
                 hs.wait()
-                lats.append(time.perf_counter() - t0)
+                lats[tag].append(time.perf_counter() - t0)
                 hb.wait()
-            med, iqr = quantile_stats(lats)
+        for tag in engines:
+            med, iqr = quantile_stats(lats[tag])
             out[f"urgent_ready_ms_{tag}"] = med
             out[f"urgent_ready_{tag}_iqr_ms"] = iqr
-        finally:
+    finally:
+        for eng in engines.values():
             eng.shutdown(wait=False)
     out["speedup"] = round(out["urgent_ready_ms_whole"]
                            / max(out["urgent_ready_ms_partitioned"], 1e-9),
