@@ -73,9 +73,12 @@ def worker() -> int:
         eng.push_pull_local(x, "ws.grad")
         times.append(time.perf_counter() - t0)
     api.shutdown()
+    from tools._bench_util import quantile_stats
+    med, iqr = quantile_stats(times)
     print("WS_RESULT " + json.dumps({
         "pid": jax.process_index(),
-        "median_ms": sorted(times)[len(times) // 2] * 1e3,
+        "median_ms": med,
+        "iqr_ms": iqr,
     }))
     return 0
 
@@ -132,7 +135,7 @@ def run_group(n_proc: int, timeout: float = 420.0, pin: bool = False,
             [sys.executable, os.path.abspath(__file__), "--worker"],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
-    medians = []
+    results = []
     try:
         for p in procs:
             out, _ = p.communicate(timeout=timeout)
@@ -141,20 +144,30 @@ def run_group(n_proc: int, timeout: float = 420.0, pin: bool = False,
                     f"weak-scaling worker rc={p.returncode}: {out[-800:]}")
             for line in out.splitlines():
                 if line.startswith("WS_RESULT "):
-                    medians.append(json.loads(line.split(" ", 1)[1])
-                                   ["median_ms"])
-    except subprocess.TimeoutExpired:
+                    results.append(json.loads(line.split(" ", 1)[1]))
+    except BaseException as e:
+        # a dead worker must take its siblings down with it: survivors
+        # blocked in the DMLC rendezvous would otherwise orphan, holding
+        # cores and polluting every later group's timings
         for p in procs:
-            p.kill()
-        raise RuntimeError(f"weak-scaling group n={n_proc} timed out")
-    return max(medians)  # slowest process bounds the step
+            if p.poll() is None:
+                p.kill()
+        if isinstance(e, subprocess.TimeoutExpired):
+            raise RuntimeError(
+                f"weak-scaling group n={n_proc} timed out") from e
+        raise
+    # slowest process bounds the step; its IQR is the reported spread
+    slow = max(results, key=lambda r: r["median_ms"])
+    return slow["median_ms"], slow.get("iqr_ms")
 
 
 def _curve(counts, pin: bool, cores_per_proc: int = 0):
     out = {}
     for n in counts:
-        out[f"{n}proc_ms"] = round(
-            run_group(n, pin=pin, cores_per_proc=cores_per_proc), 2)
+        med, iqr = run_group(n, pin=pin, cores_per_proc=cores_per_proc)
+        out[f"{n}proc_ms"] = round(med, 2)
+        if iqr:
+            out[f"{n}proc_iqr_ms"] = [round(q, 2) for q in iqr]
     base = out[f"{counts[0]}proc_ms"]
     last = out[f"{counts[-1]}proc_ms"]
     out[f"efficiency_{counts[-1]}proc"] = round(base / last, 3)
@@ -205,20 +218,25 @@ import numpy as np
 from byteps_tpu.comm.mesh import CommContext, _build_mesh
 from byteps_tpu.comm.collectives import hierarchical_all_reduce
 
-res = {}
 nbytes = 4 * 1024 * 1024
+# set up all three configs first, then interleave reps across them so
+# load drift on a shared host hits every dcn count equally
+cfgs = {}
 for n_dcn in (1, 2, 4):
     comm = CommContext(mesh=_build_mesh(jax.devices()[:8], n_dcn),
                        n_dcn=n_dcn, n_ici=8 // n_dcn)
     x = jax.device_put(jnp.zeros((8, nbytes // 4), jnp.float32),
                        comm.stacked_sharding(extra_dims=1))
-    hierarchical_all_reduce(comm, x).block_until_ready()
-    times = []
-    for _ in range(8):
+    hierarchical_all_reduce(comm, x).block_until_ready()  # compile
+    cfgs[n_dcn] = (comm, x)
+times = {n: [] for n in cfgs}
+for _ in range(8):
+    for n_dcn, (comm, x) in cfgs.items():
         t0 = time.perf_counter()
         hierarchical_all_reduce(comm, x).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    res[f"dcn{n_dcn}_ms"] = round(sorted(times)[4] * 1e3, 2)
+        times[n_dcn].append(time.perf_counter() - t0)
+res = {f"dcn{n}_ms": round(sorted(ts)[4] * 1e3, 2)
+       for n, ts in times.items()}
 print("SWEEP " + json.dumps(res))
 """
     env = dict(os.environ)
