@@ -281,3 +281,31 @@ def test_concurrent_pushes_from_many_threads(bps_chunked):
     for name, x in tensors.items():
         np.testing.assert_allclose(results[name], x, rtol=1e-6, atol=1e-7,
                                    err_msg=name)
+
+
+def test_local_contribution_on_dcn2_mesh():
+    """The local fast path on a two-level (dcn=2, ici=4) mesh: the
+    hierarchical local reduce (psum_scatter over ICI + psum over DCN)
+    and the buffer-mode chunk programs' DCN hop must agree with the
+    plain result for both single-chunk and partitioned tensors."""
+    import jax
+
+    from byteps_tpu.comm.mesh import CommContext, _build_mesh
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.core.engine import PushPullEngine
+
+    comm = CommContext(mesh=_build_mesh(jax.devices()[:8], 2),
+                       n_dcn=2, n_ici=4)
+    eng = PushPullEngine(comm, Config(telemetry_on=False, trace_on=False,
+                                      partition_bytes=4096))
+    try:
+        rng = np.random.RandomState(5)
+        for n in (33, 5000):        # single-chunk and multi-chunk
+            x = rng.randn(n).astype(np.float32)
+            got = np.asarray(eng.push_pull_local(x, f"dcn2.local.{n}"))
+            np.testing.assert_allclose(got, x, rtol=1e-6, atol=1e-7)
+            got_sum = np.asarray(
+                eng.push_pull_local(x, f"dcn2.sum.{n}", op="sum"))
+            np.testing.assert_allclose(got_sum, x, rtol=1e-6, atol=1e-7)
+    finally:
+        eng.shutdown(wait=False)
