@@ -309,3 +309,46 @@ def test_local_contribution_on_dcn2_mesh():
             np.testing.assert_allclose(got_sum, x, rtol=1e-6, atol=1e-7)
     finally:
         eng.shutdown(wait=False)
+
+
+def test_engine_single_device_mesh():
+    """n_ici=1 — the shape every single-chip TPU bench run uses.  The
+    collectives degenerate (psum over one device) but the engine
+    machinery (partitioner, scatter layout, local staging, assembly)
+    must still be exact; a regression here would turn a rare green
+    hardware window into an error line.  Subprocess: the device count is
+    fixed at backend init, so the 8-device conftest mesh can't host it."""
+    import subprocess
+    import sys
+
+    code = r"""
+import os
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import jax.numpy as jnp
+from byteps_tpu.comm.mesh import CommContext, _build_mesh
+from byteps_tpu.common.config import Config
+from byteps_tpu.core.engine import PushPullEngine
+comm = CommContext(mesh=_build_mesh(jax.devices(), 1), n_dcn=1, n_ici=1)
+eng = PushPullEngine(comm, Config(telemetry_on=False, trace_on=False,
+                                  partition_bytes=4096))
+x = np.random.RandomState(0).randn(5000).astype(np.float32)
+np.testing.assert_allclose(
+    np.asarray(eng.push_pull_local(x, 'one.local')), x,
+    rtol=1e-6, atol=1e-7)
+np.testing.assert_allclose(
+    np.asarray(eng.push_pull_local(x[:33], 'one.small')), x[:33],
+    rtol=1e-6, atol=1e-7)
+np.testing.assert_allclose(
+    np.asarray(eng.push_pull_async(jnp.asarray(x[None]), 'one.stacked',
+                                   op='sum', denom=1,
+                                   out_shape=x.shape).wait()), x, rtol=1e-6)
+eng.shutdown(wait=False)
+print('SINGLE_DEVICE_OK')
+"""
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300)
+    assert p.returncode == 0 and "SINGLE_DEVICE_OK" in p.stdout, (
+        (p.stderr or p.stdout)[-600:])
