@@ -101,3 +101,149 @@ def test_pin_cores_spec_parsing(monkeypatch, spec, avail, want):
     assert got == want
     if want is not None:
         assert pinned["c"] == want          # affinity actually applied
+
+
+# ---------------------------------------------------------------------------
+# bench.py chip-drop salvage (round-4: the tunneled chip probed green, then
+# hung 25 min into the first compile and the whole monolithic run was lost;
+# the streamed-section protocol makes half a green window still count).
+# ---------------------------------------------------------------------------
+
+import json  # noqa: E402
+
+import bench  # noqa: E402
+
+
+def _section_line(key, value):
+    return "BENCH_SECTION " + json.dumps({"key": key, "value": value})
+
+
+def test_sections_salvage_and_hung_attribution():
+    out = "\n".join([
+        "BENCH_SECTION_START device",
+        _section_line("device", {"device_kind": "TPU v5 lite",
+                                 "n_devices": 1, "on_tpu": True}),
+        "BENCH_SECTION_START push_pull_gbps",
+        _section_line("push_pull_gbps", {"engine_256MB": 9.9}),
+        "BENCH_SECTION_START train",  # started, never completed
+        "garbage line the parser must skip",
+    ])
+    sections, hung = bench._sections_from_stdout(out)
+    assert sections["push_pull_gbps"] == {"engine_256MB": 9.9}
+    assert hung == "train"
+
+
+def test_sections_salvage_empty_and_malformed():
+    assert bench._sections_from_stdout("") == ({}, None)
+    sections, hung = bench._sections_from_stdout(
+        "BENCH_SECTION not json\nBENCH_SECTION_START flash_attention\n")
+    assert sections == {} and hung == "flash_attention"
+
+
+def test_assemble_partial_without_train_keeps_tpu_identity():
+    sections = {
+        "device": {"device_kind": "TPU v5 lite", "n_devices": 1,
+                   "on_tpu": True},
+        "push_pull_gbps": {"engine_256MB": 9.9, "fused_256MB": 34.0},
+    }
+    result = bench._assemble(sections, note="hung in train")
+    assert result["metric"] == "bert_large_mlm_train_throughput_per_chip"
+    assert result["value"] == 0.0
+    assert result["device"] == "TPU v5 lite"
+    assert result["push_pull_gbps"]["engine_256MB"] == 9.9
+    assert result["flash_attention"] == {"skipped": "not reached"}
+    assert "hung in train" in result["error"]
+
+
+def test_assemble_train_error_dict_is_not_a_result():
+    sections = {
+        "device": {"device_kind": "TPU v5 lite", "n_devices": 1,
+                   "on_tpu": True},
+        "train": {"error": "RuntimeError: chip gone"},
+    }
+    result = bench._assemble(sections)
+    assert result["value"] == 0.0
+    assert "chip gone" in result["error"]
+
+
+def test_prefer_line_complete_beats_partial():
+    partial = json.dumps({"partial": True, "value": 0.0,
+                          "push_pull_gbps": {"engine_1MB": 1.0},
+                          "onebit_pallas": {"pack_gbps": 4.0},
+                          "flash_attention": {"fwd_ms": 1.0},
+                          "bf16_fsdp_tp": {"decreased": True}})
+    complete = json.dumps({"value": 500.0,
+                           "push_pull_gbps": {"engine_1MB": 1.0},
+                           "onebit_pallas": {"skipped": "x"},
+                           "flash_attention": {"error": "x"},
+                           "bf16_fsdp_tp": {"skipped": "x"}})
+    assert bench._prefer_line(partial, complete) == complete
+    assert bench._prefer_line(complete, partial) == complete
+    # two partials: more green sections wins
+    smaller = json.dumps({"partial": True, "value": 0.0,
+                          "push_pull_gbps": {"engine_1MB": 1.0}})
+    assert bench._prefer_line(smaller, partial) == partial
+    # unparseable loses to anything
+    assert bench._prefer_line("not json", smaller) == smaller
+
+
+def test_prefer_line_rich_partial_beats_value0_complete():
+    # Review finding: a retry whose train step RAISED still prints a
+    # non-partial line (value 0.0, error dicts everywhere); it must not
+    # displace a salvaged partial that holds real TPU measurements.
+    rich_partial = json.dumps({"partial": True, "value": 0.0,
+                               "push_pull_gbps": {"engine_256MB": 9.0},
+                               "onebit_pallas": {"pack_gbps": 4.0},
+                               "flash_attention": {"fwd_ms": 1.0},
+                               "bf16_fsdp_tp": {"decreased": True}})
+    value0_complete = json.dumps({"value": 0.0,
+                                  "error": "train: RuntimeError: chip gone",
+                                  "push_pull_gbps": {"error": "x"},
+                                  "onebit_pallas": {"error": "x"},
+                                  "flash_attention": {"error": "x"},
+                                  "bf16_fsdp_tp": {"error": "x"}})
+    assert bench._prefer_line(rich_partial, value0_complete) == rich_partial
+    assert bench._prefer_line(value0_complete, rich_partial) == rich_partial
+
+
+def test_is_degraded():
+    assert bench._is_degraded({"partial": True, "value": 500.0})
+    assert bench._is_degraded({"value": 0.0})
+    assert not bench._is_degraded({"value": 500.0})
+    assert not bench._is_degraded(None)
+
+
+def test_assemble_salvage_does_not_write_baseline(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "MEASURED_BASELINE_FILE",
+                        str(tmp_path / "BASELINE_MEASURED.json"))
+    train = {"on_tpu": True, "per_chip": 100.0, "mfu": 0.5,
+             "tokens_per_sec_per_chip": 1e4, "device_kind": "TPU v5 lite",
+             "n_devices": 1, "seq_len": 128, "per_dev_batch": 32}
+    sections = {"device": {"device_kind": "TPU v5 lite", "n_devices": 1,
+                           "on_tpu": True}, "train": train}
+    bench._assemble(sections, write_baseline=False)
+    assert not (tmp_path / "BASELINE_MEASURED.json").exists()
+    bench._assemble(sections)  # the inner's full-run path does write
+    assert (tmp_path / "BASELINE_MEASURED.json").exists()
+
+
+def test_watch_record_degraded_never_displaces_complete(tmp_path):
+    from tools import tpu_watch as w
+    orig_m, orig_l = w.MEASURED, w.LATEST
+    w.MEASURED = str(tmp_path / "M.json")
+    w.LATEST = str(tmp_path / "L.json")
+    try:
+        complete = {"value": 500.0, "device": "TPU v5 lite"}
+        partial = {"value": 0.0, "partial": True, "hung_section": "train",
+                   "device": "TPU v5 lite"}
+        value0 = {"value": 0.0, "device": "TPU v5 lite",
+                  "error": "train: RuntimeError"}
+        w.record(complete)
+        w.record(partial)
+        w.record(value0)
+        doc = json.load(open(w.MEASURED))
+        assert doc["line"]["value"] == 500.0
+        assert len(doc["history"]) == 3
+        assert doc["history"][1]["partial"] is True
+    finally:
+        w.MEASURED, w.LATEST = orig_m, orig_l
