@@ -247,3 +247,17 @@ def test_watch_record_degraded_never_displaces_complete(tmp_path):
         assert doc["history"][1]["partial"] is True
     finally:
         w.MEASURED, w.LATEST = orig_m, orig_l
+
+
+def test_tpu_overlap_section_shape_on_cpu_mesh():
+    # The section runs on-TPU in the bench; this pins its structure at CPU
+    # scale so API drift can't break the TPU capture right when a green
+    # window opens (the fraction itself is jitter on a shared host and is
+    # deliberately not asserted).
+    import jax
+    out = bench._bench_tpu_overlap(jax.devices())
+    assert "error" not in out, out
+    for key in ("compute_ms", "comm_ms", "serial_ms", "pipelined_ms",
+                "overlap_fraction", "grad_mb", "note"):
+        assert key in out
+    assert out["serial_ms"] > 0 and out["pipelined_ms"] > 0

@@ -126,6 +126,8 @@ def record(line: dict):
              if k.startswith("fused")), None),
         "bf16_fsdp_tp_decreased": (line.get("bf16_fsdp_tp") or {}).get(
             "decreased"),
+        "tpu_overlap_fraction": (line.get("tpu_overlap") or {}).get(
+            "overlap_fraction"),
         **({"partial": True, "hung_section": line.get("hung_section")}
            if line.get("partial") else {}),
     })
