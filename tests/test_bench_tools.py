@@ -261,3 +261,58 @@ def test_tpu_overlap_section_shape_on_cpu_mesh():
                 "overlap_fraction", "grad_mb", "note"):
         assert key in out
     assert out["serial_ms"] > 0 and out["pipelined_ms"] > 0
+
+
+def test_sections_salvage_progress_lines():
+    # A section killed mid-stream: its last PROGRESS value is salvaged and
+    # it is still attributed as the hung section; a later full SECTION
+    # line for the same key wins over progress.
+    out = "\n".join([
+        "BENCH_SECTION_START push_pull_gbps",
+        "BENCH_SECTION_PROGRESS " + json.dumps(
+            {"key": "push_pull_gbps", "value": {"fused_256MB": 34.0}}),
+        "BENCH_SECTION_PROGRESS " + json.dumps(
+            {"key": "push_pull_gbps",
+             "value": {"fused_256MB": 34.0, "engine_device_256MB": 12.0}}),
+    ])
+    sections, hung = bench._sections_from_stdout(out)
+    assert sections["push_pull_gbps"]["engine_device_256MB"] == 12.0
+    assert hung == "push_pull_gbps"
+    # completed section: full line wins, no hang
+    out2 = out + "\nBENCH_SECTION " + json.dumps(
+        {"key": "push_pull_gbps", "value": {"fused_256MB": 35.0}})
+    sections2, hung2 = bench._sections_from_stdout(out2)
+    assert sections2["push_pull_gbps"] == {"fused_256MB": 35.0}
+    assert hung2 is None
+
+
+def test_push_pull_raising_measurement_keeps_partials():
+    # Review finding: a chip drop that RAISES (vs hangs) mid-section must
+    # keep the sizes already measured and skip the rest.
+    import jax
+
+    # _bench_push_pull imports PushPullEngine per call, so patching the
+    # module attribute faults the Nth engine construction for real.
+    import byteps_tpu.core.engine as eng_mod
+    real_engine = eng_mod.PushPullEngine
+    n_made = [0]
+
+    class FlakyEngine(real_engine):
+        def __init__(self, *a, **kw):
+            n_made[0] += 1
+            if n_made[0] >= 2:   # first engine (device path) OK, then die
+                raise RuntimeError("chip gone")
+            super().__init__(*a, **kw)
+
+    snaps = []
+    eng_mod.PushPullEngine = FlakyEngine
+    try:
+        out = bench._bench_push_pull(jax.devices(), on_tpu=False,
+                                     emit=lambda v: snaps.append(v))
+    finally:
+        eng_mod.PushPullEngine = real_engine
+    assert "fused_8MB" in out            # measured before the fault
+    assert "engine_device_8MB" in out    # first engine construction OK
+    assert "error" in out and "chip gone" in out["error"]
+    assert "engine_8MB_credit16MB" not in out   # skipped after the fault
+    assert snaps[-1] == out
