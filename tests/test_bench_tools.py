@@ -316,3 +316,19 @@ def test_push_pull_raising_measurement_keeps_partials():
     assert "error" in out and "chip gone" in out["error"]
     assert "engine_8MB_credit16MB" not in out   # skipped after the fault
     assert snaps[-1] == out
+
+
+def test_prefer_line_counts_entries_not_sections():
+    # Review finding: an error-annotated section holding five salvaged
+    # measurements must outweigh an error-free one holding a single entry.
+    rich = json.dumps({"partial": True, "value": 0.0,
+                       "push_pull_gbps": {"fused_256MB": 34.0,
+                                          "engine_device_256MB": 12.0,
+                                          "engine_1MB": 1.0,
+                                          "engine_16MB": 2.0,
+                                          "engine_256MB": 3.0,
+                                          "error": "engine_256MB_x: gone"}})
+    thin = json.dumps({"partial": True, "value": 0.0,
+                       "push_pull_gbps": {"fused_256MB": 34.0}})
+    assert bench._prefer_line(rich, thin) == rich
+    assert bench._prefer_line(thin, rich) == rich
