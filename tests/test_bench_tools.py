@@ -82,6 +82,8 @@ def test_couple_overlap_to_projection():
     ("0-2", {0, 1, 2, 3}, [0, 1, 2]),
     ("0,2", {0, 1, 2, 3}, [0, 2]),
     ("bogus", {0, 1, 2, 3}, None),        # malformed: unpinned, not dead
+    ("0-3", {0, 1, 2, 3}, None),          # explicit full set: no-op, no
+                                          # stabilization to report
     ("", {0}, None),                      # 1-core default: nothing to pin
     ("", {0, 1, 2, 3}, [1, 2, 3]),        # default: all but core 0
     ("", {0, 1}, None),                   # 2-3 cores: full-set pin is a
@@ -245,6 +247,12 @@ def test_watch_record_degraded_never_displaces_complete(tmp_path):
         assert doc["line"]["value"] == 500.0
         assert len(doc["history"]) == 3
         assert doc["history"][1]["partial"] is True
+        # the note describes doc["line"]: degraded records left it intact
+        # (set when the complete line landed), and a new complete line
+        # still displaces normally
+        assert "Most recent green TPU run" in doc["note"]
+        w.record({"value": 600.0, "device": "TPU v5 lite"})
+        assert json.load(open(w.MEASURED))["line"]["value"] == 600.0
     finally:
         w.MEASURED, w.LATEST = orig_m, orig_l
 

@@ -7,19 +7,28 @@ from __future__ import annotations
 import os
 
 
+def cpu8_flags(existing=None) -> str:
+    """XLA_FLAGS value forcing the virtual 8-device CPU mesh, stripping
+    any stale device-count flag first.  The ONE copy of this
+    strip-and-append (bench.py and every tool import it), so embedded and
+    standalone runs can't drift in what mesh they measure.  jax-free:
+    safe to import from processes that must not init a backend."""
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", "")
+                   if existing is None else existing)
+    return (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
 def setup_cpu8_mesh():
-    """Force the virtual 8-device CPU mesh, stripping any stale count.
+    """Force the virtual 8-device CPU mesh in THIS process.
 
     A bare ``python tools/<bench>.py`` must measure the same multi-rank
     configuration bench.py embeds, not a silent 1-device mesh.  Must run
     before the first JAX backend use; jax.config.update is the reliable
     platform switch (the image's sitecustomize consumes JAX_PLATFORMS at
     interpreter start)."""
-    import re
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = cpu8_flags()
     import jax
     jax.config.update("jax_platforms", "cpu")
 
@@ -68,6 +77,11 @@ def pin_cores():
             want &= set(avail)
         except ValueError:
             return None  # malformed spec: run unpinned rather than die
+        if want == set(avail):
+            # explicit spec covering every available core: setting the
+            # affinity is a no-op; honoring the strict-subset invariant
+            # beats honoring the spec literally
+            return None
     elif len(avail) >= 4:
         # leave core 0 (interrupt-heavy) out when there's room
         want = set(avail[1:])

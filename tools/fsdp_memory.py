@@ -24,20 +24,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def _force_cpu8_flags() -> None:
-    """Strip any pre-existing device-count flag and pin 8 (a stale lower
-    count would silently change what the published numbers measure)."""
-    import re
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-
 def main() -> int:
-    _force_cpu8_flags()
+    from tools._bench_util import setup_cpu8_mesh
+    setup_cpu8_mesh()
     import jax
-    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     import optax
