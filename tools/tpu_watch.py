@@ -115,7 +115,8 @@ def record(line: dict):
             "fwd_speedup"),
         "engine_device_gbps": next(
             (v for k, v in (line.get("push_pull_gbps") or {}).items()
-             if k.startswith("engine_device")), None),
+             if k.startswith("engine_device")
+             and not k.endswith("_iqr")), None),
         # round-4 additions: the reworked-engine-on-hardware question and
         # the bf16 composite (VERDICT r3 missing #2 / task 7).
         # engine_host picks the LARGEST plain engine_<N>MB so all three
@@ -128,7 +129,7 @@ def record(line: dict):
             default=(None, None))[1],
         "fused_gbps": next(
             (v for k, v in (line.get("push_pull_gbps") or {}).items()
-             if k.startswith("fused")), None),
+             if k.startswith("fused") and not k.endswith("_iqr")), None),
         "bf16_fsdp_tp_decreased": (line.get("bf16_fsdp_tp") or {}).get(
             "decreased"),
         "tpu_overlap_fraction": (line.get("tpu_overlap") or {}).get(
