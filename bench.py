@@ -571,29 +571,37 @@ def _bench_tpu_overlap(devices):
             eng.handles.release(h.id)
 
         def timeit(fn):
+            # per-rep median + IQR (same rationale and convention as
+            # _bench_push_pull.to_gbps): the engine modes can hit a
+            # timing-dependent group-merge recompile mid-measurement; the
+            # median rejects that rep and the bracket shows the spread.
+            # digits=4: the CPU smoke path's sub-ms times must not
+            # quantize to zero.
+            from tools._bench_util import quantile_stats
             fn()  # warm (compile + engine program cache)
-            t0 = time.perf_counter()
+            times = []
             for _ in range(reps):
+                t0 = time.perf_counter()
                 fn()
-            return (time.perf_counter() - t0) / reps * 1e3
+                times.append(time.perf_counter() - t0)
+            return quantile_stats(times, digits=4)
 
-        t_compute = timeit(lambda: compute(x).block_until_ready())
-        t_comm = timeit(comm_only)
-        t_serial = timeit(serial)
-        t_pipe = timeit(pipelined)
-        hideable = min(t_compute, t_comm)
-        out = {
-            "compute_ms": round(t_compute, 2),
-            "comm_ms": round(t_comm, 2),
-            "serial_ms": round(t_serial, 2),
-            "pipelined_ms": round(t_pipe, 2),
-            "overlap_fraction": round(
-                (t_serial - t_pipe) / hideable, 3) if hideable > 0 else None,
-            "grad_mb": grad_elems * 4 // (1 << 20),
-            "note": ("async engine push_pull issued before a ~%d ms device "
-                     "compute; overlap_fraction = recovered / min(compute, "
-                     "comm)" % round(t_compute)),
-        }
+        out = {"grad_mb": grad_elems * 4 // (1 << 20)}
+
+        def add_t(key, fn):
+            out[key + "_ms"], out[key + "_ms_iqr"] = timeit(fn)
+
+        add_t("compute", lambda: compute(x).block_until_ready())
+        add_t("comm", comm_only)
+        add_t("serial", serial)
+        add_t("pipelined", pipelined)
+        hideable = min(out["compute_ms"], out["comm_ms"])
+        out["overlap_fraction"] = (
+            round((out["serial_ms"] - out["pipelined_ms"]) / hideable, 3)
+            if hideable > 0 else None)
+        out["note"] = ("async engine push_pull issued before a ~%d ms "
+                       "device compute; overlap_fraction = recovered / "
+                       "min(compute, comm)" % round(out["compute_ms"]))
         return out
     except Exception as e:  # noqa: BLE001 - secondary metric only
         return {"error": f"{type(e).__name__}: {e}"[:300]}

@@ -33,7 +33,7 @@ def setup_cpu8_mesh():
     jax.config.update("jax_platforms", "cpu")
 
 
-def quantile_stats(samples):
+def quantile_stats(samples, digits=1):
     """(median, [q25, q75]) in ms from samples in seconds, linearly
     interpolated.  The IQR is the honesty term: a shared host can't
     promise tight medians, so every artifact carries its spread."""
@@ -45,8 +45,8 @@ def quantile_stats(samples):
         lo, hi = int(i), min(int(i) + 1, n - 1)
         return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
 
-    return (round(q(0.5) * 1e3, 1),
-            [round(q(0.25) * 1e3, 1), round(q(0.75) * 1e3, 1)])
+    return (round(q(0.5) * 1e3, digits),
+            [round(q(0.25) * 1e3, digits), round(q(0.75) * 1e3, digits)])
 
 
 def pin_cores():
