@@ -340,3 +340,36 @@ def test_prefer_line_counts_entries_not_sections():
                        "push_pull_gbps": {"fused_256MB": 34.0}})
     assert bench._prefer_line(rich, thin) == rich
     assert bench._prefer_line(thin, rich) == rich
+
+
+def test_merge_watch_summary_on_cpu_fallback(tmp_path, monkeypatch):
+    # VERDICT r3 item 1: a chipless round's bench line must itself carry
+    # the watch evidence.  Green complete lines stay untouched.
+    watch = {"started": "2026-07-31T04:52:27Z", "last": "2026-07-31T06:00:00Z",
+             "n_probes": 20, "n_green": 0, "probes": []}
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    (tmp_path / "TPU_WATCH_LOG.json").write_text(json.dumps(watch))
+    cpu_line = json.dumps({"value": 20.0, "device": "cpu",
+                           "error": "tpu unavailable"})
+    out = json.loads(bench._merge_watch_summary(cpu_line))
+    assert out["tpu_watch"]["n_probes"] == 20
+    assert out["tpu_watch"]["n_green"] == 0
+    green = json.dumps({"value": 500.0, "device": "TPU v5 lite"})
+    assert bench._merge_watch_summary(green) == green
+    partial = json.dumps({"value": 0.0, "device": "TPU v5 lite",
+                          "partial": True})
+    assert "tpu_watch" in json.loads(bench._merge_watch_summary(partial))
+    # missing log file: documented as absent, not an exception
+    monkeypatch.setattr(bench, "REPO", str(tmp_path / "nowhere"))
+    out2 = json.loads(bench._merge_watch_summary(cpu_line))
+    assert "absent" in out2["tpu_watch"]["log"]
+
+
+def test_merge_watch_summary_non_dict_log(tmp_path, monkeypatch):
+    # Review finding: a truncated/hand-edited log parsing to non-dict JSON
+    # must degrade to "absent", never crash the final print.
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    (tmp_path / "TPU_WATCH_LOG.json").write_text("null")
+    out = json.loads(bench._merge_watch_summary(
+        json.dumps({"value": 0.0, "device": "cpu"})))
+    assert "absent" in out["tpu_watch"]["log"]
