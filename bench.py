@@ -1098,7 +1098,7 @@ def _merge_watch_summary(line: str) -> str:
         return line
     on_tpu_line = str(result.get("device", "")).lower().startswith(
         ("tpu", "v5", "v6", "v4"))
-    if on_tpu_line and not result.get("partial"):
+    if on_tpu_line and not _is_degraded(result):
         return line  # a green capture speaks for itself
     path = os.path.join(REPO, "TPU_WATCH_LOG.json")
     try:

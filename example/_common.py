@@ -1,0 +1,22 @@
+"""Shared example bootstrap.
+
+Every example inserts the repo root on sys.path (so a fresh checkout runs
+without installation) and then calls :func:`honor_jax_platforms` — some
+host images pre-import jax at interpreter start, which consumes
+JAX_PLATFORMS before the example's own imports run; re-applying the
+requested platform via jax.config is then the only effective switch.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Re-apply a JAX_PLATFORMS env request that a pre-imported jax may
+    have missed.  Passes the value through verbatim (e.g. "cpu,tpu" keeps
+    its fallback semantics); no-op when the variable is unset."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms:
+        import jax
+        jax.config.update("jax_platforms", platforms)

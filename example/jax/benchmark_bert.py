@@ -6,6 +6,16 @@ Run:  python example/jax/benchmark_bert.py [--steps N] [--batch B]
 CPU smoke uses bert_tiny automatically.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 import time
 

@@ -12,6 +12,16 @@ CPU smoke:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     --steps 3 --seq 256 --sp 4 --tiny
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 import time
 

@@ -9,6 +9,16 @@ CPU smoke:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
             JAX_PLATFORMS=cpu python example/jax/train_mnist_mlp.py --steps 3
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 import time
 

@@ -10,6 +10,16 @@ Requires mxnet (pip install mxnet); the adapter itself does not.
 Run:  python example/mxnet/train_gluon_mnist_byteps_gc.py [--steps N]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 
 import numpy as np

@@ -6,6 +6,16 @@ Run:  python example/pytorch/benchmark_byteps.py [--num-iters N]
       [--compressor onebit|topk|randomk|dithering]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 import time
 

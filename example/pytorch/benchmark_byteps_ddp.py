@@ -5,6 +5,16 @@ hooks with bucketing + no_sync() accumulation.
 Run:  python example/pytorch/benchmark_byteps_ddp.py [--num-iters N]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 import time
 

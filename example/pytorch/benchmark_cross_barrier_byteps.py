@@ -6,6 +6,16 @@ pass; per-layer averaged gradients are applied just-in-time.
 Run:  python example/pytorch/benchmark_cross_barrier_byteps.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 import time
 

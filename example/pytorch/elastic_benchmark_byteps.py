@@ -6,6 +6,16 @@ continues with identical scheduling.
 Run:  python example/pytorch/elastic_benchmark_byteps.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import torch
 import torch.nn.functional as F
 

@@ -4,6 +4,16 @@ example/tensorflow/tensorflow2_mnist.py, synthetic data).
 Run:  python example/tensorflow/tensorflow2_mnist.py [--steps N]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 
 import numpy as np

@@ -5,6 +5,16 @@ reduction routes through the engine's push_pull.
 Run:  python example/tensorflow/tensorflow2_mnist_bps_MirroredStrategy.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from example._common import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
+
 import argparse
 
 import numpy as np

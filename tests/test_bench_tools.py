@@ -373,3 +373,14 @@ def test_merge_watch_summary_non_dict_log(tmp_path, monkeypatch):
     out = json.loads(bench._merge_watch_summary(
         json.dumps({"value": 0.0, "device": "cpu"})))
     assert "absent" in out["tpu_watch"]["log"]
+
+
+def test_merge_watch_summary_degraded_tpu_line(tmp_path, monkeypatch):
+    # Review finding: a value-0 "complete" TPU line (train raised) is
+    # degraded and must carry the watch evidence too.
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    (tmp_path / "TPU_WATCH_LOG.json").write_text(json.dumps(
+        {"started": "s", "last": "l", "n_probes": 5, "n_green": 1}))
+    degraded = json.dumps({"value": 0.0, "device": "TPU v5 lite",
+                           "error": "train: RuntimeError"})
+    assert "tpu_watch" in json.loads(bench._merge_watch_summary(degraded))
