@@ -14,9 +14,13 @@ import os
 
 def honor_jax_platforms() -> None:
     """Re-apply a JAX_PLATFORMS env request that a pre-imported jax may
-    have missed.  Passes the value through verbatim (e.g. "cpu,tpu" keeps
-    its fallback semantics); no-op when the variable is unset."""
+    have missed.  Only acts when the request puts CPU first — that is the
+    case a pre-import breaks (the image's own accelerator platform is
+    already the default, and images that pre-import jax typically export
+    their platform name in JAX_PLATFORMS, which must not override a test
+    harness's deliberate CPU mesh).  The full value passes through
+    verbatim, so "cpu,tpu" keeps its fallback semantics."""
     platforms = os.environ.get("JAX_PLATFORMS", "")
-    if platforms:
+    if platforms.split(",")[0].strip().lower() == "cpu":
         import jax
         jax.config.update("jax_platforms", platforms)

@@ -423,3 +423,23 @@ def test_main_degraded_retry_prefers_better_line(monkeypatch, capsys,
     assert out["value"] == 500.0          # the complete retry won
     assert calls["inner"] == 2
     assert calls["probe"] == 2            # initial + pre-retry re-probe
+
+
+def test_honor_jax_platforms_gates_on_cpu_first(monkeypatch):
+    # The image exports JAX_PLATFORMS=axon globally; the helper must NOT
+    # re-apply a non-cpu platform (it would override a test harness's
+    # deliberate CPU mesh and hang on an unreachable chip), while a
+    # cpu-first request passes through verbatim with its fallbacks.
+    import types
+    import example._common as c
+    seen = []
+    fake_jax = types.ModuleType("jax")
+    fake_jax.config = types.SimpleNamespace(
+        update=lambda k, v: seen.append((k, v)))
+    monkeypatch.setitem(sys.modules, "jax", fake_jax)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    c.honor_jax_platforms()
+    assert seen == []
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu,tpu")
+    c.honor_jax_platforms()
+    assert seen == [("jax_platforms", "cpu,tpu")]
