@@ -443,3 +443,57 @@ def test_honor_jax_platforms_gates_on_cpu_first(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu,tpu")
     c.honor_jax_platforms()
     assert seen == [("jax_platforms", "cpu,tpu")]
+
+
+def test_inner_main_tpu_branch_order_and_assembly(monkeypatch, capsys,
+                                                  tmp_path):
+    # The TPU branch only executes on a green chip — exactly when a
+    # regression would be found too late.  Stub every section and check
+    # dispatch order (cheap evidence before the multi-minute compiles),
+    # the emission protocol, and the assembled line.
+    import jax
+
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v5 lite (fake)"
+
+    order = []
+
+    def stub(name, val=None):
+        def f(*a, **kw):
+            order.append(name)
+            return val if val is not None else {"ok": name}
+        return f
+
+    monkeypatch.setattr(bench, "_bench_push_pull", stub("push_pull_gbps"))
+    monkeypatch.setattr(bench, "_bench_tpu_overlap",
+                        stub("tpu_overlap", {"overlap_fraction": 0.9}))
+    monkeypatch.setattr(bench, "_bench_pallas", stub("onebit_pallas"))
+    monkeypatch.setattr(bench, "_bench_flash", stub("flash_attention"))
+    monkeypatch.setattr(bench, "_bench_train_step", stub("train", {
+        "on_tpu": True, "per_chip": 500.0, "mfu": 0.75,
+        "tokens_per_sec_per_chip": 64000.0,
+        "device_kind": "TPU v5 lite (fake)", "n_devices": 1,
+        "seq_len": 128, "per_dev_batch": 32}))
+    monkeypatch.setattr(bench, "_bench_resnet", stub("resnet50"))
+    monkeypatch.setattr(bench, "_bench_bf16_fsdp_tp", stub("bf16_fsdp_tp"))
+    monkeypatch.setattr(bench, "MEASURED_BASELINE_FILE",
+                        str(tmp_path / "b.json"))
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    for var in ("_BPS_BENCH_NOTE", "_BPS_BENCH_FORCE_CPU",
+                "_BPS_BENCH_ONLY"):
+        monkeypatch.delenv(var, raising=False)
+
+    assert bench.inner_main() == 0
+    out = capsys.readouterr().out
+    assert order == ["push_pull_gbps", "tpu_overlap", "onebit_pallas",
+                     "flash_attention", "train", "resnet50",
+                     "bf16_fsdp_tp"]
+    starts = [ln.split()[1] for ln in out.splitlines()
+              if ln.startswith("BENCH_SECTION_START")]
+    assert starts[0] == "device" and starts[1] == "push_pull_gbps"
+    final = json.loads(out.strip().splitlines()[-1])
+    assert final["value"] == 500.0
+    assert final["tpu_overlap"]["overlap_fraction"] == 0.9
+    assert final["device"] == "TPU v5 lite (fake)"
+    assert (tmp_path / "b.json").exists()   # first-green baseline written
