@@ -497,3 +497,25 @@ def test_inner_main_tpu_branch_order_and_assembly(monkeypatch, capsys,
     assert final["tpu_overlap"]["overlap_fraction"] == 0.9
     assert final["device"] == "TPU v5 lite (fake)"
     assert (tmp_path / "b.json").exists()   # first-green baseline written
+
+
+def test_push_pull_ablations_skip_when_projected_slow(monkeypatch):
+    # Window economy: a catastrophically slow hardware engine must not
+    # spend the green window on secondary ablations — but the headline
+    # engine figure itself always runs.  A stepping clock makes every
+    # per-rep median enormous (and the headline round to 0.0 GB/s, the
+    # slowest case, which must hit the skip rather than dodge it).
+    import jax
+    ticks = [0.0]
+
+    def fake_clock():
+        # two calls per rep (t0 and the delta read) -> 62 s per rep,
+        # projecting 8 x 62 = 496 s per ablation, past the 240 s budget
+        ticks[0] += 31.0
+        return ticks[0]
+
+    monkeypatch.setattr(bench.time, "perf_counter", fake_clock)
+    out = bench._bench_push_pull(jax.devices(), on_tpu=False)
+    assert "ablations_skipped" in out
+    assert "engine_8MB" in out                 # headline still measured
+    assert "engine_8MB_no_priority" not in out
