@@ -177,13 +177,16 @@ def _bench_push_pull(devices, on_tpu, emit=None):
         and the IQR carries the spread (the repo convention — every
         artifact shows its honesty term).  The raw median seconds feed
         the ablation window-economy guard without round-trip through the
-        3-decimal GB/s rounding."""
-        from tools._bench_util import quantile_stats
-        med_ms, (q25_ms, q75_ms) = quantile_stats(times, digits=4)
-        return (round(nbytes / med_ms / 1e6, 3),
-                [round(nbytes / q75_ms / 1e6, 3),     # slow quartile ->
-                 round(nbytes / q25_ms / 1e6, 3)],    # low GB/s bound
-                med_ms / 1e3)
+        3-decimal GB/s rounding.  Rates divide by the UNROUNDED median
+        seconds: the display rounding collapses sub-50 ns medians to 0
+        and a rate computed from it would divide by zero, aborting the
+        section's remaining sizes."""
+        from tools._bench_util import quantile_stats_raw
+        med_s, q25_s, q75_s = quantile_stats_raw(times)
+        return (round(nbytes / med_s / 1e9, 3),
+                [round(nbytes / q75_s / 1e9, 3),      # slow quartile ->
+                 round(nbytes / q25_s / 1e9, 3)],     # low GB/s bound
+                med_s)
 
     def engine_gbps(nbytes, reps=5, **cfg_kw):
         cfg = Config(telemetry_on=False, trace_on=False, **cfg_kw)
@@ -922,6 +925,19 @@ def _sections_from_stdout(text):
     return sections, hung
 
 
+def _echo_inner_stream(out):
+    """Re-emit the inner's section stream on the OUTER's stdout (flushed).
+    The outer otherwise prints nothing until its final BENCH_FULL +
+    compact lines, which can be hours after the sections were measured
+    (merge tools); an outer-level kill — e.g. tools/tpu_watch.py's bench
+    timeout — would lose every section the inner already streamed.  With
+    the echo, any consumer of the outer's partial stdout can reassemble
+    them (_sections_from_stdout)."""
+    for ln in (out or "").splitlines():
+        if ln.startswith("BENCH_SECTION"):
+            print(ln, flush=True)
+
+
 def _run_inner(extra_env=None, timeout=_INNER_TIMEOUT):
     env = dict(os.environ)
     env.update(extra_env or {})
@@ -929,11 +945,13 @@ def _run_inner(extra_env=None, timeout=_INNER_TIMEOUT):
         p = subprocess.run([sys.executable, os.path.abspath(__file__),
                             "--inner"], capture_output=True, text=True,
                            timeout=timeout, cwd=REPO, env=env)
+        _echo_inner_stream(p.stdout)
     except subprocess.TimeoutExpired as e:
         # subprocess.run kills the child and attaches the output read so
         # far; any sections the inner streamed before the hang survive.
         out = e.stdout if isinstance(e.stdout, str) else (
             (e.stdout or b"").decode("utf-8", "replace"))
+        _echo_inner_stream(out)
         sections, hung = _sections_from_stdout(out)
         if sections:
             note = ("inner bench timed out after %ds" % timeout
@@ -1143,6 +1161,151 @@ def _merge_watch_summary(line: str) -> str:
     return json.dumps(result)
 
 
+# The driver snapshots the last ~2000 stdout chars; staying well under
+# leaves room for a stray warning line landing after ours.
+_COMPACT_BUDGET = 1500
+
+
+def _round_number():
+    """Best-effort current round index: one past the newest BENCH_r{N}.json
+    (the driver writes those at each round end)."""
+    import re
+    ns = [int(m.group(1)) for f in os.listdir(REPO)
+          for m in [re.match(r"BENCH_r(\d+)\.json$", f)] if m]
+    return (max(ns) + 1) if ns else None
+
+
+_SCALAR_KEYS = ("metric", "value", "unit", "vs_baseline", "mfu",
+                "tokens_per_sec_per_chip", "device", "n_devices")
+
+
+def _section_status(v):
+    """One-word health flag for the compact line's per-section map."""
+    if not isinstance(v, dict):
+        return "ok"
+    if "error" in v:
+        data = [k for k in v if k not in ("error", "skipped", "note")]
+        return "error+data" if data else "error"
+    if "skipped" in v:
+        return "skip"
+    return "ok"
+
+
+def _compact_summary(doc):
+    """The FINAL stdout line: ≤_COMPACT_BUDGET chars so the driver's tail
+    capture always ends in one parseable JSON object.  Rounds 3 and 4
+    lost their records (BENCH_r0{3,4}.json parsed: null) because the full
+    ~10 kB line outgrew the 2000-char tail window — the compact line
+    carries the scalars, per-section status flags and a few headline
+    figures; everything else lives in the committed full record."""
+    import re
+    out = {k: doc[k] for k in _SCALAR_KEYS if k in doc}
+    for k in ("partial", "hung_section"):
+        if doc.get(k):
+            out[k] = doc[k]
+    skip = set(_SCALAR_KEYS) | {"partial", "hung_section", "error",
+                                "tpu_watch", "recorded", "round"}
+    out["sections"] = {k: _section_status(v) for k, v in doc.items()
+                       if k not in skip}
+    heads = {}
+    pp = doc.get("push_pull_gbps")
+
+    def _largest(prefix):
+        best = None
+        if isinstance(pp, dict):
+            for k, v in pp.items():
+                m = re.match(re.escape(prefix) + r"_(\d+)MB$", k)
+                if m and isinstance(v, (int, float)):
+                    if best is None or int(m.group(1)) > best[0]:
+                        best = (int(m.group(1)), k, v)
+        return best
+
+    for prefix in ("fused", "engine_device", "engine"):
+        b = _largest(prefix)
+        if b:
+            heads[b[1] + "_gbps"] = b[2]
+    for sec, label in (("tpu_overlap", "tpu_overlap_fraction"),
+                       ("overlap", "host_overlap_fraction")):
+        v = doc.get(sec)
+        if isinstance(v, dict) and isinstance(
+                v.get("overlap_fraction"), (int, float)):
+            heads[label] = v["overlap_fraction"]
+    if heads:
+        out["headline"] = heads
+    tw = doc.get("tpu_watch")
+    if isinstance(tw, dict):
+        out["tpu_watch"] = {k: tw[k] for k in ("n_probes", "n_green", "last")
+                            if k in tw}
+    if doc.get("round") is not None:
+        out["round"] = doc["round"]
+    out["full_record"] = "BENCH_FULL.json"
+    if doc.get("error"):
+        out["error"] = str(doc["error"])[:200]
+    s = json.dumps(out, separators=(",", ":"))
+    for drop in ("headline", "sections"):  # belt-and-braces; the normal
+        if len(s) <= _COMPACT_BUDGET:      # line is a few hundred chars
+            break
+        out.pop(drop, None)
+        s = json.dumps(out, separators=(",", ":"))
+    if len(s) > _COMPACT_BUDGET and "error" in out:
+        out["error"] = out["error"][:80]
+        s = json.dumps(out, separators=(",", ":"))
+    return s
+
+
+def _record_class(doc):
+    """Displacement rank for the numbers-of-record file: a complete TPU
+    record (2) outranks a complete chipless/CPU record (1) outranks a
+    degraded or terminal-failure record (0).  Same idea as
+    tools/tpu_watch.record()'s guard: a red round's failure line must not
+    clobber the last good record at the path docs cite."""
+    if not isinstance(doc, dict) or _is_degraded(doc):
+        return 0
+    on_tpu = str(doc.get("device", "")).lower().startswith(
+        ("tpu", "v5", "v6", "v4"))
+    return 2 if on_tpu else 1
+
+
+def _atomic_write(doc, path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _finalize(line: str) -> str:
+    """Persist the full assembled record and return the compact final line
+    (round-4 VERDICT task 1).  The full record is echoed to stdout as a
+    'BENCH_FULL '-prefixed line for stream consumers (tools/tpu_watch.py)
+    and written to two committed files: BENCH_FULL_LATEST.json (every
+    run, any quality) and BENCH_FULL.json — the numbers of record
+    docs/performance.md cites — which a lower-class record never
+    displaces (_record_class).  The returned compact summary is printed
+    LAST so the driver's 2000-char tail capture always parses."""
+    doc = _parse_line(line)
+    if doc is None:
+        return line
+    doc["recorded"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rnd = _round_number()
+    if rnd is not None:
+        doc["round"] = rnd
+    full = json.dumps(doc)
+    record_path = os.path.join(REPO, "BENCH_FULL.json")
+    try:
+        _atomic_write(doc, os.path.join(REPO, "BENCH_FULL_LATEST.json"))
+        try:
+            with open(record_path) as f:
+                existing = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            existing = None
+        if _record_class(doc) >= _record_class(existing):
+            _atomic_write(doc, record_path)
+    except OSError:
+        pass  # unwritable tree: stdout still carries the full line
+    print("BENCH_FULL " + full, flush=True)
+    return _compact_summary(doc)
+
+
 def _is_degraded(doc):
     """A line that must not be trusted as the round's record: salvaged
     partial, or a 'complete' line whose train section failed (section()
@@ -1160,13 +1323,15 @@ def _prefer_line(a, b):
         doc = _parse_line(line)
         if not doc:
             return (-1, -1, -1)
-        keys = ("push_pull_gbps", "onebit_pallas", "flash_attention",
-                "bf16_fsdp_tp", "resnet50")
+        keys = ("push_pull_gbps", "tpu_overlap", "onebit_pallas",
+                "flash_attention", "bf16_fsdp_tp", "resnet50")
         # Count measurement ENTRIES, not whole sections: an error-annotated
         # section that salvaged five sizes before the drop outweighs an
-        # error-free one holding a single measurement.
-        meta = {"skipped", "error", "note", "shape"}
-        done = sum(sum(1 for kk in doc[k] if kk not in meta)
+        # error-free one holding a single measurement.  IQR brackets and
+        # the ablation-skip note describe measurements, they aren't ones.
+        meta = {"skipped", "error", "note", "shape", "ablations_skipped"}
+        done = sum(sum(1 for kk in doc[k]
+                       if kk not in meta and not kk.endswith("_iqr"))
                    for k in keys if isinstance(doc.get(k), dict))
         return (1 if doc.get("value") else 0, done,
                 0 if doc.get("partial") else 1)
@@ -1190,17 +1355,20 @@ def main() -> int:
                 # The chip dropped mid-run (salvaged partial) or the train
                 # step raised (value-0 line).  Retry the full bench only if
                 # the chip probes green again, and keep whichever run
-                # captured more.  Shorter timeout: a real window completes
-                # the cheap sections well inside it, and a second hang
-                # should not burn another full inner budget.
+                # captured more.  The retry budget must cover the nominal
+                # full TPU section list (~25-35 min, see _INNER_TIMEOUT's
+                # comment) — a shorter budget could only ever produce
+                # another partial, never the complete line it exists to
+                # recover (round-4 advisor finding).
                 info2, _ = _probe(90.0)
                 if info2 is not None:
-                    line2, _ = _run_inner(timeout=1200.0)
+                    line2, _ = _run_inner(timeout=2400.0)
                     line = _prefer_line(line, line2)
             if line is not None:
-                print(_merge_watch_summary(_couple_overlap_to_projection(
-                    _merge_aot_memory(_merge_overlap(_merge_mechanisms(
-                        _merge_scaling(_merge_dcn_compare(line))))))))
+                print(_finalize(_merge_watch_summary(
+                    _couple_overlap_to_projection(_merge_aot_memory(
+                        _merge_overlap(_merge_mechanisms(_merge_scaling(
+                            _merge_dcn_compare(line)))))))))
                 return 0
             errors.append(f"bench retry failed: {err}")
             break
@@ -1217,17 +1385,17 @@ def main() -> int:
     }
     line, err = _run_inner(extra_env=env, timeout=900.0)
     if line is not None:
-        print(_merge_watch_summary(_couple_overlap_to_projection(
+        print(_finalize(_merge_watch_summary(_couple_overlap_to_projection(
             _merge_aot_memory(_merge_overlap(_merge_mechanisms(
-                _merge_scaling(line)))))))
+                _merge_scaling(line))))))))
         return 0
     # Terminal failure is the line that needs the watch evidence MOST:
     # nothing else documents that the chip was being probed all round.
-    print(_merge_watch_summary(json.dumps({
+    print(_finalize(_merge_watch_summary(json.dumps({
         "metric": "bert_large_mlm_train_throughput_per_chip",
         "value": 0.0, "unit": "examples/s", "vs_baseline": 0.0,
         "error": note + f"; cpu fallback also failed: {err}",
-    })))
+    }))))
     return 0
 
 

@@ -33,10 +33,11 @@ def setup_cpu8_mesh():
     jax.config.update("jax_platforms", "cpu")
 
 
-def quantile_stats(samples, digits=1):
-    """(median, [q25, q75]) in ms from samples in seconds, linearly
-    interpolated.  The IQR is the honesty term: a shared host can't
-    promise tight medians, so every artifact carries its spread."""
+def quantile_stats_raw(samples):
+    """(median_s, q25_s, q75_s) unrounded, in seconds, linearly
+    interpolated.  Derived rates (GB/s) must divide by THESE, not the
+    display-rounded ms from quantile_stats: a sub-50 ns median rounds to
+    0.0 ms at 4 digits and a rate computed from it divides by zero."""
     xs = sorted(samples)
     n = len(xs)
 
@@ -45,8 +46,16 @@ def quantile_stats(samples, digits=1):
         lo, hi = int(i), min(int(i) + 1, n - 1)
         return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
 
-    return (round(q(0.5) * 1e3, digits),
-            [round(q(0.25) * 1e3, digits), round(q(0.75) * 1e3, digits)])
+    return q(0.5), q(0.25), q(0.75)
+
+
+def quantile_stats(samples, digits=1):
+    """(median, [q25, q75]) in ms from samples in seconds, rounded for
+    display.  The IQR is the honesty term: a shared host can't promise
+    tight medians, so every artifact carries its spread."""
+    med, q25, q75 = quantile_stats_raw(samples)
+    return (round(med * 1e3, digits),
+            [round(q25 * 1e3, digits), round(q75 * 1e3, digits)])
 
 
 def pin_cores():
