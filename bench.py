@@ -275,6 +275,14 @@ def _bench_push_pull(devices, on_tpu, emit=None):
     add(f"engine_device_{big // mb}MB", lambda: engine_device_gbps(big))
     for nbytes in sizes:
         add(f"engine_{nbytes // mb}MB", lambda n=nbytes: engine_gbps(n))
+    # Drain-mode dispatch amortization (round-4 VERDICT task 3): the whole
+    # eligible window executes as the fewest XLA programs (one chunk-
+    # scatter program per contiguous run) — the ready answer if hardware
+    # says per-chunk dispatch dominates the engine's rent.  Runs before
+    # the window-economy gate on purpose: when the plain engine is slow
+    # is exactly when this figure matters.
+    add(f"engine_grouped_{big // mb}MB",
+        lambda: engine_gbps(big, group_size=-1))
     # The three ablations are secondary to the headline engine figure; if
     # the hardware engine path is slow enough that each would eat minutes
     # of a possibly-short green window, skip them with the projection

@@ -424,6 +424,82 @@ def push_pull_chunk_scatter(comm: CommContext, flat, buf, col_off: int,
     return fn(flat, offa, buf)
 
 
+def _batched_all_reduce_fn(comm: CommContext, k: int, shape, dtype,
+                           scaled: bool, local: bool):
+    """One program reducing ``k`` equal-shape chunks of DISTINCT tensors
+    (the cross-tensor half of the reference's NCCL group batching,
+    nccl_manager.cc:130-134): k collectives in one XLA executable, so one
+    host dispatch replaces k.  XLA's all-reduce combiner is free to merge
+    them into fewer wire operations.  The reduction body MATCHES what a
+    single dispatch of the same chunk would run — flat psum on a 1-slice
+    mesh, hierarchical RS -> DCN-psum when n_dcn > 1 — so grouping (a
+    timing-dependent decision) can never change a result bitwise.
+    Epilogue semantics match push_pull_array(keep_acc=True) /
+    push_pull_array_scaled exactly."""
+    hierarchical = comm.n_dcn > 1
+    n_ici = comm.n_ici
+
+    def build():
+        axes = comm.dp_axes
+
+        def body(*args):
+            xs, scale = (args[:k], args[k] if scaled else None)
+            outs = []
+            for x in xs:
+                x0 = x if local else x[0]
+                if hierarchical:
+                    r = lax.psum_scatter(_acc(x0), ICI_AXIS,
+                                         scatter_dimension=0, tiled=True)
+                    r = lax.psum(r, DCN_AXIS)
+                else:
+                    r = lax.psum(_acc(x0), axes)
+                outs.append(_epilogue(r, x0.dtype, comm, False, True, scale))
+            return tuple(outs)
+
+        spec = P() if local else P(comm.dp_axes)
+        in_specs = tuple([spec] * k) + ((P(),) if scaled else ())
+        out_spec = P(ICI_AXIS) if hierarchical else P()
+        inner = jax.shard_map(body, mesh=comm.mesh, in_specs=in_specs,
+                              out_specs=tuple([out_spec] * k))
+        if not hierarchical:
+            return jax.jit(inner)
+
+        # hierarchical needs n % n_ici == 0 for the tiled scatter; pad
+        # inside the jitted program and strip after, exactly like
+        # _hierarchical_fn does for the single-chunk path
+        def fn(*args):
+            xs, rest = args[:k], args[k:]
+            n = xs[0].shape[-1]
+            pad = (-n) % n_ici
+            if pad:
+                widths = (0, pad) if local else ((0, 0), (0, pad))
+                xs = tuple(jnp.pad(x, widths) for x in xs)
+            outs = inner(*xs, *rest)
+            if pad:
+                outs = tuple(o[:n] for o in outs)
+            return outs
+
+        return jax.jit(fn)
+
+    return _cached(comm, ("batched_ar", k, tuple(shape), str(dtype),
+                          scaled, local), build)
+
+
+def push_pull_arrays_batched(comm: CommContext, xs, scale=None,
+                             local: bool = False):
+    """Reduce ``k`` equal-shape chunks in ONE dispatched program; returns
+    a list of per-chunk results.  ``scale=None`` keeps the accumulation
+    dtype (engine keep_acc semantics); a float fuses sum*scale.  With
+    ``local=True`` each x is a replicated [n] contribution."""
+    k = len(xs)
+    fn = _batched_all_reduce_fn(comm, k, xs[0].shape, xs[0].dtype,
+                                scale is not None, local)
+    if scale is not None:
+        acc = jnp.float64 if xs[0].dtype == jnp.float64 else jnp.float32
+        return list(fn(*xs, jnp.asarray(scale, acc)))
+    return list(fn(*xs))
+
+
 def _pad_program(comm: CommContext, n: int, n_pad: int, local: bool):
     def build():
         if local:

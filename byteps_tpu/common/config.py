@@ -90,7 +90,10 @@ class Config:
     enable_priority: bool = True     # priority ordering of chunk dispatch
     group_size: int = 4              # BYTEPS_GROUP_SIZE: chunks per device
     #                                  program (reference BYTEPS_NCCL_GROUP_SIZE
-    #                                  batching, nccl_manager.cc:130-134)
+    #                                  batching, nccl_manager.cc:130-134).
+    #                                  -1 = drain mode: every dispatch empties
+    #                                  the whole eligible credit window into
+    #                                  the fewest programs (engine._plan_batch)
 
     # --- compression ---
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES

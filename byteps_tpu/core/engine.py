@@ -29,6 +29,7 @@ import numpy as np
 
 from ..comm.collectives import (_as_stacked, assemble_scatter, pad_stacked,
                                 push_pull_array, push_pull_array_scaled,
+                                push_pull_arrays_batched,
                                 push_pull_chunk_scatter, scatter_layout,
                                 stage_local_replicated)
 from ..comm.compressed import compressed_all_reduce
@@ -45,6 +46,81 @@ from ..common.types import ChunkTask, Status, TensorContext
 
 
 _SHUTDOWN = object()  # sync-queue sentinel
+
+
+def _pow2_split(seq):
+    """Split a task run into power-of-two-sized groups.  Drain mode merges
+    runs of unbounded width; each distinct width is a fresh XLA compile
+    (the group program's k is static), so bucketing widths to powers of
+    two bounds the compile cache at log2(n) entries per layout while
+    keeping the dispatch count within 2x of optimal."""
+    out, i, n = [], 0, len(seq)
+    while i < n:
+        k = 1 << ((n - i).bit_length() - 1)
+        out.append(seq[i:i + k])
+        i += k
+    return out
+
+
+def _plan_batch(batch, pow2_runs: bool = False):
+    """Group a popped priority-ordered task batch into dispatch units:
+
+    - ``("run", tasks)``: contiguous equal-width column slabs of ONE
+      buffer-mode tensor — one chunk-scatter program.
+    - ``("group", tasks)``: consecutive uncompressed equal-shape chunks of
+      DISTINCT tensors — one batched-collective program (the cross-tensor
+      half of the reference's NCCL group batching).
+    - ``("single", [task])``: everything else (compressed chunks, odd
+      shapes).
+
+    Only ADJACENT tasks ever merge, so dispatch order — the priority
+    mechanism — is preserved across units; within a unit all chunks
+    execute as one program, which collapses their relative order the same
+    way the reference's ncclGroupStart/End does."""
+    units = []
+    i = 0
+    while i < len(batch):
+        t = batch[i]
+        if t.pending is not None and t.pending.use_buffer:
+            run = [t]
+            j = i + 1
+            while (j < len(batch)
+                   and batch[j].pending is t.pending
+                   and batch[j].num_elems == t.num_elems
+                   and batch[j].offset_elems
+                   == run[-1].offset_elems + run[-1].num_elems):
+                run.append(batch[j])
+                j += 1
+            if pow2_runs and len(run) > 1:
+                units.extend(("run", sub) for sub in _pow2_split(run))
+            else:
+                units.append(("run", run))
+            i = j
+            continue
+        if t.compression is None:
+            group = [t]
+            j = i + 1
+            while (j < len(batch)
+                   and batch[j].compression is None
+                   and not (batch[j].pending is not None
+                            and batch[j].pending.use_buffer)
+                   and batch[j].data.shape == t.data.shape
+                   and batch[j].data.dtype == t.data.dtype
+                   and batch[j].scale == t.scale):
+                group.append(batch[j])
+                j += 1
+            subs = (_pow2_split(group) if pow2_runs and len(group) > 1
+                    else [group])
+            # a width-1 "group" would compile a fresh batched_ar program
+            # for a computation the single-task all_reduce cache already
+            # holds — route it through _dispatch_single instead
+            units.extend(("group" if len(sub) > 1 else "single", sub)
+                         for sub in subs)
+            i = j
+            continue
+        units.append(("single", [t]))
+        i += 1
+    return units
 
 
 class _CompressionSlot:
@@ -144,8 +220,17 @@ class PushPullEngine:
         self.speed = SpeedMonitor()
         self.tracer = Tracer()
         self._sync_q: "queue.Queue" = queue.Queue()
+        # group_size < 0 = drain mode (VERDICT r4 task 3): every dispatch
+        # iteration empties the whole eligible credit window and executes
+        # it as the fewest programs _plan_batch can form.  Multi-host
+        # stays at 1: merging is timing-dependent and SPMD processes must
+        # dispatch identical programs in identical order.
         self._group_size = (1 if jax.process_count() > 1
-                            else max(1, cfg.group_size))
+                            else (-1 if cfg.group_size < 0
+                                  else max(1, cfg.group_size)))
+        # dispatch amortization accounting: programs launched vs chunk
+        # tasks consumed (the bench's engine_grouped_* evidence)
+        self.stats = {"dispatches": 0, "chunks": 0}
         self._running = True
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="bps-dispatch", daemon=True)
@@ -392,41 +477,35 @@ class PushPullEngine:
                 continue
             # Chunk-group batching (reference BYTEPS_NCCL_GROUP_SIZE,
             # nccl_manager.cc:130-134): opportunistically pop whatever else
-            # is already eligible, then merge contiguous chunks of the same
-            # tensor into one device program.  Popping preserves priority
+            # is already eligible, then merge neighbors into the fewest
+            # device programs (_plan_batch).  Popping preserves priority
             # order; merging only ever joins neighbors in that order.
-            # Multi-host runs keep group_size=1: merging is timing-
-            # dependent, and SPMD processes must dispatch identical
-            # programs in identical order (the reference pins followers to
-            # the root's order via DO_* socket signals, communicator.h:43).
-            group = self._group_size
+            # group_size=-1 drains the ENTIRE eligible credit window per
+            # iteration (one program per mergeable run); a positive value
+            # caps the pop count.  Multi-host runs keep group_size=1 (the
+            # reference pins followers to the root's order via DO_*
+            # socket signals, communicator.h:43).
+            drain = self._group_size < 0
+            # Drain bound = the queue depth at drain START (snapshot
+            # semantics): tasks enqueued while we pop wait for the next
+            # iteration, so a fast producer can neither defer the popped
+            # head's dispatch indefinitely nor grow the batch without
+            # limit (the credit window, when set, additionally gates each
+            # pop inside get_task).
+            limit = self.scheduler.pending if drain else self._group_size - 1
             batch = [task]
-            for _ in range(max(0, group - 1)):
+            while len(batch) - 1 < limit:
                 t2 = self.scheduler.get_task(block=False)
                 if t2 is None:
                     break
                 batch.append(t2)
-            i = 0
-            while i < len(batch):
-                t = batch[i]
-                if t.pending is not None and t.pending.use_buffer:
-                    # merge a contiguous equal-width column run of the
-                    # same tensor (the grouped scatter program requires
-                    # equal per-chunk slabs)
-                    run = [t]
-                    j = i + 1
-                    while (j < len(batch)
-                           and batch[j].pending is t.pending
-                           and batch[j].num_elems == t.num_elems
-                           and batch[j].offset_elems
-                           == run[-1].offset_elems + run[-1].num_elems):
-                        run.append(batch[j])
-                        j += 1
-                    self._dispatch_buffer_run(run)
-                    i = j
+            for kind, unit in _plan_batch(batch, pow2_runs=drain):
+                if kind == "run":
+                    self._dispatch_buffer_run(unit)
+                elif kind == "group":
+                    self._dispatch_parts_group(unit)
                 else:
-                    self._dispatch_single(t)
-                    i += 1
+                    self._dispatch_single(unit[0])
 
     def _dispatch_buffer_run(self, run: List[ChunkTask]):
         """One device program for a contiguous run of column-slab chunks:
@@ -437,6 +516,8 @@ class PushPullEngine:
         now = self.tracer.now() if self.tracer.enabled else 0.0
         for t in run:
             t.t_dispatch = now
+        self.stats["dispatches"] += 1
+        self.stats["chunks"] += len(run)
         try:
             _, C = pending.ctx.scatter_layout
             buf, token = push_pull_chunk_scatter(
@@ -448,8 +529,30 @@ class PushPullEngine:
             get_logger().error("dispatch failed for %s: %s", t0.name, e)
             self._sync_q.put((run, None, None, e))
 
+    def _dispatch_parts_group(self, group: List[ChunkTask]):
+        """One program for k equal-shape uncompressed chunks of distinct
+        tensors (push_pull_arrays_batched): one dispatch replaces k, the
+        per-chunk results come back separately so every downstream
+        consumer (assembly, debug sampling, callbacks) is unchanged."""
+        now = self.tracer.now() if self.tracer.enabled else 0.0
+        t0 = group[0]
+        for t in group:
+            t.t_dispatch = now
+        self.stats["dispatches"] += 1
+        self.stats["chunks"] += len(group)
+        try:
+            outs = push_pull_arrays_batched(
+                self.comm, [t.data for t in group], scale=t0.scale,
+                local=t0.data.ndim == 1)
+            self._sync_q.put((group, outs, None, None))
+        except Exception as e:  # noqa: BLE001
+            get_logger().error("dispatch failed for %s: %s", t0.name, e)
+            self._sync_q.put((group, None, None, e))
+
     def _dispatch_single(self, task: ChunkTask):
         task.t_dispatch = self.tracer.now()
+        self.stats["dispatches"] += 1
+        self.stats["chunks"] += 1
         try:
             slot = task.compression
             rollback = None
@@ -500,10 +603,12 @@ class PushPullEngine:
                         slot, wst, sst = rollback
                         slot.wstates = wst
                         slot.sstate = sst
-            for task in tasks:
+            for idx, task in enumerate(tasks):
+                # parts-group dispatches carry one output PER task
+                out_t = out[idx] if isinstance(out, list) else out
                 if err is None and not (task.pending is not None
                                         and task.pending.use_buffer):
-                    self._debug_sample(task, out)
+                    self._debug_sample(task, out_t)
                 self.scheduler.report_finish(task.nbytes)
                 if self.tracer.enabled:
                     t_done = self.tracer.now()
@@ -526,7 +631,7 @@ class PushPullEngine:
                         # Average is applied at assembly granularity: the
                         # reference divides in the done-callback too
                         # (torch/__init__.py task callback output.div_(size)).
-                        task.callback(out, Status.ok())
+                        task.callback(out_t, Status.ok())
 
     # ---------------------------------------------------------- lifecycle
     def shutdown(self, wait: bool = True):
