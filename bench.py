@@ -1094,6 +1094,18 @@ def _couple_overlap_to_projection(line: str) -> str:
     return json.dumps(result)
 
 
+def _merge_async_vs_sync(line: str) -> str:
+    """Async-PS convergence datum (round-4 VERDICT task 7): the same MLP
+    trained sync (barriered grad average) vs async weight-delta workers
+    sharing a KVStore, final-loss gap recorded (tools/async_bench.py).
+    Matches the mode the reference ships as BYTEPS_ENABLE_ASYNC
+    (server.cc:310-314, torch/__init__.py:186-214)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return _merge_tool_section(line, "async_vs_sync", "async_bench.py",
+                               timeout=600.0, env=env)
+
+
 def _merge_aot_memory(line: str) -> str:
     """8B feasibility section (round-3 VERDICT task 6): XLA memory
     analysis of the AOT-compiled (fsdp, tp) Llama-3-8B train step —
@@ -1375,8 +1387,9 @@ def main() -> int:
             if line is not None:
                 print(_finalize(_merge_watch_summary(
                     _couple_overlap_to_projection(_merge_aot_memory(
-                        _merge_overlap(_merge_mechanisms(_merge_scaling(
-                            _merge_dcn_compare(line)))))))))
+                        _merge_async_vs_sync(_merge_overlap(
+                            _merge_mechanisms(_merge_scaling(
+                                _merge_dcn_compare(line))))))))))
                 return 0
             errors.append(f"bench retry failed: {err}")
             break
@@ -1394,8 +1407,8 @@ def main() -> int:
     line, err = _run_inner(extra_env=env, timeout=900.0)
     if line is not None:
         print(_finalize(_merge_watch_summary(_couple_overlap_to_projection(
-            _merge_aot_memory(_merge_overlap(_merge_mechanisms(
-                _merge_scaling(line))))))))
+            _merge_aot_memory(_merge_async_vs_sync(_merge_overlap(
+                _merge_mechanisms(_merge_scaling(line)))))))))
         return 0
     # Terminal failure is the line that needs the watch evidence MOST:
     # nothing else documents that the chip was being probed all round.

@@ -683,3 +683,18 @@ def test_run_inner_echoes_section_stream(monkeypatch, capsys):
     line, err = bench._run_inner()
     assert err is None and json.loads(line) == {"value": 1.0}
     assert sec in capsys.readouterr().out
+
+
+def test_async_bench_tool_emits_convergence_datum(capsys, monkeypatch):
+    # round-5: the async-PS convergence datum (VERDICT r4 task 7) — the
+    # tool runs both modes and reports the final-loss gap with conditions
+    from tools import async_bench as ab
+    monkeypatch.setenv("BYTEPS_BENCH_PIN", "off")  # in-process run must
+    monkeypatch.setattr(ab, "STEPS", 12)           # not shrink pytest's
+    assert ab.main() == 0                          # CPU affinity
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["workers"] == 2 and out["steps_per_worker"] == 12
+    assert {"loss_init", "loss_sync", "loss_async", "final_loss_gap",
+            "async_converged", "conditions"} <= set(out)
+    assert out["loss_sync"] < out["loss_init"]       # sync made progress
+    assert out["delta_pushes_per_key"] == 2 * 12     # no pushes lost
