@@ -1058,8 +1058,11 @@ def _merge_overlap(line: str) -> str:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = _cpu8_flags()
+    # 1800 s (2x the single-pass budget): on a multi-core host the tool
+    # measures TWICE (unpinned + disjoint-pinned, round-5) — a budget
+    # sized for one pass would time out mid-second-pass and lose BOTH
     return _merge_tool_section(line, "overlap", "overlap_bench.py",
-                               timeout=900.0, env=env)
+                               timeout=1800.0, env=env)
 
 
 def _couple_overlap_to_projection(line: str) -> str:
@@ -1076,7 +1079,14 @@ def _couple_overlap_to_projection(line: str) -> str:
         return line
     ov = result.get("overlap") or {}
     an = (result.get("scaling") or {}).get("analytic_v5e256") or {}
-    frac = ov.get("overlap_fraction")
+    # Prefer the disjoint-pinned measurement when the host could run it
+    # (round-5): transport with its own cores is the closest host-side
+    # analog of a TPU's on-chip compute / host dispatch split.
+    pinned = ov.get("pinned_disjoint") or {}
+    frac = pinned.get("overlap_fraction")
+    if frac is None:  # pinned skipped OR measured but undefined (comm
+        frac = ov.get("overlap_fraction")  # share ~0): fall back
+
     step = an.get("measured_step_ms_per_chip")
     comm = an.get("allreduce_ms")
     if frac is None or step is None or comm is None:

@@ -70,6 +70,27 @@ def test_couple_overlap_to_projection():
     an2 = json.loads(bench._couple_overlap_to_projection(line2))[
         "scaling"]["analytic_v5e256"]
     assert an2["efficiency_at_measured_overlap"] == 0.75
+    # the disjoint-pinned measurement, when present, wins over unpinned
+    # (round-5: transport-on-own-cores is the TPU-host-like regime)
+    line3 = json.dumps({
+        "overlap": {"overlap_fraction": -0.1,
+                    "pinned_disjoint": {"overlap_fraction": 0.5}},
+        "scaling": {"analytic_v5e256": {
+            "measured_step_ms_per_chip": 60.0, "allreduce_ms": 20.0}},
+    })
+    an3 = json.loads(bench._couple_overlap_to_projection(line3))[
+        "scaling"]["analytic_v5e256"]
+    assert an3["measured_overlap_fraction"] == 0.5
+    # a SKIPPED pinned section must not mask the unpinned fraction
+    line4 = json.dumps({
+        "overlap": {"overlap_fraction": 0.3,
+                    "pinned_disjoint": {"skipped": "1 core"}},
+        "scaling": {"analytic_v5e256": {
+            "measured_step_ms_per_chip": 60.0, "allreduce_ms": 20.0}},
+    })
+    an4 = json.loads(bench._couple_overlap_to_projection(line4))[
+        "scaling"]["analytic_v5e256"]
+    assert an4["measured_overlap_fraction"] == 0.3
     # missing sections pass through untouched
     assert bench._couple_overlap_to_projection("{}") == "{}"
 

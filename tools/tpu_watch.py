@@ -73,11 +73,11 @@ def probe(timeout=90.0):
 
 # Sized from the sum of bench.py's own internal worst-case budgets
 # (probe 240 + inner 3000 + re-probe 90 + degraded retry 2400 + scaling
-# 3600 + 3x900 tool merges + 600 dcn ≈ 12,630 s) plus slack — an outer
-# timeout below the child's own budget would fire exactly on the runs
-# that took longest and had the most to salvage (round-4 advisor
-# finding).
-_BENCH_TIMEOUT = 14400
+# 3600 + overlap 1800 + 2x900 mech/aot merges + 600 async + 600 dcn
+# ≈ 14,130 s) plus slack — an outer timeout below the child's own budget
+# would fire exactly on the runs that took longest and had the most to
+# salvage (round-4 advisor finding).
+_BENCH_TIMEOUT = 15300
 
 
 def _parse_bench_stdout(text):
