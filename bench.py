@@ -717,6 +717,65 @@ def _bench_bf16_fsdp_tp(on_tpu: bool):
             "canary": "tests/test_three_d.py tracks the related XLA bug"}
 
 
+def _bench_bf16_three_d(devices):
+    """bf16 (dp, pp, tp) composite on the available devices (round-4
+    VERDICT task 8).  On the CPU emitter the bf16 partial-manual psum
+    CHECK-crashes the process (tests/test_three_d.py canary keeps the
+    repro hot), so the 3D path pins f32 there; real Mosaic is expected to
+    be unaffected — this section is the hardware evidence.  Axis sizes
+    adapt to the device count: a pod runs real (dp, pp, tp); a single
+    chip degenerates to (1, 1, 1), where the full 3D program (GPipe scan,
+    auto-tp GSPMD annotations, the psum pattern) still compiles and
+    trains in bf16 with trivial collectives — the note records which
+    regime the losses came from."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from byteps_tpu.models.gpt import gpt_tiny
+    from byteps_tpu.parallel import (init_3d_opt_state, make_3d_mesh,
+                                     make_dp_pp_tp_train_step,
+                                     shard_3d_batch, shard_3d_params,
+                                     synthetic_lm_batch)
+    from byteps_tpu.parallel.pipeline import init_pipeline_params
+
+    n = len(devices)
+    n_pp = 2 if n % 2 == 0 else 1       # gpt_tiny has 2 layers
+    n_tp = 2 if n % (n_pp * 2) == 0 else 1
+    dp = n // (n_pp * n_tp)
+    cfg = dataclasses.replace(gpt_tiny(), dtype=jnp.bfloat16)
+    mesh = make_3d_mesh(devices, n_pp=n_pp, n_tp=n_tp)
+    rng = jax.random.PRNGKey(0)
+    batch = synthetic_lm_batch(rng, cfg, batch=4 * dp, seq_len=16)
+    params = shard_3d_params(
+        mesh, init_pipeline_params(cfg, rng, batch["input_ids"][:1]))
+    tx = optax.sgd(0.1)
+    opt = init_3d_opt_state(tx, params)
+    step = make_dp_pp_tp_train_step(mesh, cfg, tx, num_microbatches=2)
+    b = shard_3d_batch(mesh, batch)
+    losses = []
+    for _ in range(8):
+        params, opt, loss = step(params, opt, b)
+        losses.append(round(float(loss), 4))
+    # the note must describe the axes actually exercised: 2 chips give
+    # (1, 2, 1) — a single non-trivial axis, not "multi-axis" evidence
+    live_axes = [f"{name}={size}" for name, size in
+                 (("dp", dp), ("pp", n_pp), ("tp", n_tp)) if size > 1]
+    return {
+        "dtype": "bfloat16",
+        "mesh": f"dp={dp} x pp={n_pp} x tp={n_tp}",
+        "platform": devices[0].platform,
+        "losses": losses,
+        "decreased": losses[-1] < losses[0],
+        "note": ("collectives trivial at (1,1,1); the multi-axis wire "
+                 "pattern stays covered in f32 by dryrun_multichip"
+                 if not live_axes else
+                 f"bf16 collectives over {', '.join(live_axes)}"),
+    }
+
+
 def _emit_section(key, value):
     """Stream a completed section to stdout immediately (flushed through
     the pipe) so the outer process can salvage it if the tunneled chip
@@ -803,7 +862,7 @@ def _assemble(sections, note="", write_baseline=True):
         "bf16_fsdp_tp": sections.get("bf16_fsdp_tp",
                                      {"skipped": "not reached"}),
     }
-    for opt in ("resnet50", "dcn_compare", "tpu_overlap"):
+    for opt in ("resnet50", "dcn_compare", "tpu_overlap", "bf16_three_d"):
         if sections.get(opt) is not None:
             result[opt] = sections[opt]
     notes = [n for n in (note, train_err and f"train: {train_err}") if n]
@@ -862,10 +921,19 @@ def inner_main() -> int:
         section("train", _bench_train_step, devices)
         section("resnet50", _bench_resnet, devices)
         section("bf16_fsdp_tp", _bench_bf16_fsdp_tp, on_tpu)
+        # bf16 3D runs ONLY where the emitter survives it: real Mosaic
+        # (any chip count) — on CPU the partial-manual psum would kill
+        # the process at multi-device axis sizes (canary test_three_d.py)
+        section("bf16_three_d", _bench_bf16_three_d, devices)
     else:
         for key in ("onebit_pallas", "flash_attention"):
             sections[key] = {"skipped": "cpu run"}
             _emit_section(key, sections[key])
+        sections["bf16_three_d"] = {
+            "skipped": "cpu run: bf16 partial-manual psum CHECK-crashes "
+                       "the CPU emitter (tests/test_three_d.py canary); "
+                       "the 3D composite runs f32 in dryrun_multichip"}
+        _emit_section("bf16_three_d", sections["bf16_three_d"])
         section("train", _bench_train_step, devices)
         push_pull_section()
         section("bf16_fsdp_tp", _bench_bf16_fsdp_tp, on_tpu)
