@@ -1264,12 +1264,25 @@ def _merge_watch_summary(line: str) -> str:
 _COMPACT_BUDGET = 1500
 
 
+def _record_dir():
+    """Where the full-record artifacts are written.  _BPS_BENCH_REPO
+    overrides it so a test-suite bench run cannot clobber the committed
+    BENCH_FULL record; only the WRITES move — tool paths and subprocess
+    cwds stay on the real repo."""
+    return os.environ.get("_BPS_BENCH_REPO") or REPO
+
+
 def _round_number():
     """Best-effort current round index: one past the newest BENCH_r{N}.json
-    (the driver writes those at each round end)."""
+    (the driver writes those at each round end; they live in the real
+    repo even when the artifact WRITES are redirected).  Never raises —
+    a failed stamp must not cost the record itself."""
     import re
-    ns = [int(m.group(1)) for f in os.listdir(REPO)
-          for m in [re.match(r"BENCH_r(\d+)\.json$", f)] if m]
+    try:
+        ns = [int(m.group(1)) for f in os.listdir(REPO)
+              for m in [re.match(r"BENCH_r(\d+)\.json$", f)] if m]
+    except OSError:
+        return None
     return (max(ns) + 1) if ns else None
 
 
@@ -1388,9 +1401,11 @@ def _finalize(line: str) -> str:
     if rnd is not None:
         doc["round"] = rnd
     full = json.dumps(doc)
-    record_path = os.path.join(REPO, "BENCH_FULL.json")
     try:
-        _atomic_write(doc, os.path.join(REPO, "BENCH_FULL_LATEST.json"))
+        rec_dir = _record_dir()
+        record_path = os.path.join(rec_dir, "BENCH_FULL.json")
+        _atomic_write(doc, os.path.join(rec_dir,
+                                        "BENCH_FULL_LATEST.json"))
         try:
             with open(record_path) as f:
                 existing = json.load(f)

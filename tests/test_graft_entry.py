@@ -21,13 +21,16 @@ def test_dryrun_multichip_odd():
     dryrun_multichip(5)  # odd count: falls back to flat 1 x n mesh
 
 
-def test_bench_smoke_cpu():
+def test_bench_smoke_cpu(tmp_path):
     import os
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     # bench.py's outer process probes/benches in subprocesses that only
     # inherit env — an in-process config.update would never reach them
     env["JAX_PLATFORMS"] = "cpu"
+    # redirect the artifact writes: a suite run must not overwrite the
+    # committed BENCH_FULL record
+    env["_BPS_BENCH_REPO"] = str(tmp_path)
     code = (
         "import jax; jax.config.update('jax_platforms','cpu');"
         "import runpy, sys; sys.argv=['bench.py'];"
@@ -36,10 +39,23 @@ def test_bench_smoke_cpu():
     out = subprocess.run([sys.executable, "-c", code], env=env,
                          capture_output=True, text=True, timeout=900,
                          cwd="/root/repo")
-    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
-    assert lines, out.stdout + out.stderr
-    rec = json.loads(lines[-1])
+    lines = out.stdout.strip().splitlines()
+    # full record: the BENCH_FULL stream line + the committed file
+    full = [l for l in lines if l.startswith("BENCH_FULL ")]
+    assert full, out.stdout + out.stderr
+    rec = json.loads(full[-1][len("BENCH_FULL "):])
     assert set(rec) >= {"metric", "value", "unit", "vs_baseline",
                         "push_pull_gbps", "onebit_pallas"}
     assert rec["value"] > 0
     assert any(k.startswith("engine_") for k in rec["push_pull_gbps"])
+    assert (tmp_path / "BENCH_FULL.json").exists()
+    assert (tmp_path / "BENCH_FULL_LATEST.json").exists()
+    # final stdout line: the compact driver summary (rounds 3-4 lost
+    # their records to a ~10 kB final line; this contract prevents that)
+    last = [l for l in lines if l.startswith("{")][-1]
+    compact = json.loads(last)
+    sys.path.insert(0, "/root/repo")
+    import bench
+    assert len(last) <= bench._COMPACT_BUDGET
+    assert compact["full_record"] == "BENCH_FULL.json"
+    assert compact["value"] == rec["value"]
