@@ -47,6 +47,10 @@ from ..common.types import ChunkTask, Status, TensorContext
 
 _SHUTDOWN = object()  # sync-queue sentinel
 
+# One blocking-pop quantum: the dispatcher re-checks its run/pause flags
+# at least this often, and pause_dispatch() sizes its settle wait from it.
+_GET_TASK_TIMEOUT = 0.05
+
 
 def _pow2_split(seq):
     """Split a task run into power-of-two-sized groups.  Drain mode merges
@@ -231,6 +235,8 @@ class PushPullEngine:
         # dispatch amortization accounting: programs launched vs chunk
         # tasks consumed (the bench's engine_grouped_* evidence)
         self.stats = {"dispatches": 0, "chunks": 0}
+        self._dispatch_enabled = threading.Event()
+        self._dispatch_enabled.set()
         self._running = True
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="bps-dispatch", daemon=True)
@@ -469,10 +475,29 @@ class PushPullEngine:
             get_logger().debug("debug sample for %s failed", task.name,
                                exc_info=True)
 
+    def pause_dispatch(self):
+        """Hold the dispatcher: tasks enqueue but nothing pops until
+        :meth:`resume_dispatch`.  Used where the drain/merge width must
+        be deterministic (the multichip dry-run's amortization assertion,
+        tests) — merge width is otherwise a race between enqueue and
+        dispatch.  Waits out one blocking-pop quantum so a get_task call
+        already in flight when the flag flips cannot pop around the
+        pause."""
+        self._dispatch_enabled.clear()
+        import time
+        time.sleep(2 * _GET_TASK_TIMEOUT)
+
+    def resume_dispatch(self):
+        self._dispatch_enabled.set()
+
     # ---------------------------------------------------------- loops
     def _dispatch_loop(self):
         while self._running:
-            task = self.scheduler.get_task(block=True, timeout=0.05)
+            if not self._dispatch_enabled.is_set():
+                self._dispatch_enabled.wait(timeout=_GET_TASK_TIMEOUT)
+                continue
+            task = self.scheduler.get_task(block=True,
+                                           timeout=_GET_TASK_TIMEOUT)
             if task is None:
                 continue
             # Chunk-group batching (reference BYTEPS_NCCL_GROUP_SIZE,

@@ -9,9 +9,6 @@ group batching (nccl_manager.cc:130-134, BYTEPS_NCCL_GROUP_SIZE); here a
 "group" is one jitted program instead of one ncclGroupStart/End bracket.
 """
 
-import threading
-import time
-
 import numpy as np
 import pytest
 
@@ -106,6 +103,18 @@ def test_plan_order_preserved_across_units():
 # ------------------------------------------------------------- end-to-end
 
 
+class _Gate:
+    """Adapter from the old Event-style gate to the engine's first-class
+    pause/resume hook (the one copy of the settle-the-in-flight-pop
+    invariant lives in PushPullEngine.pause_dispatch)."""
+
+    def __init__(self, eng):
+        self._eng = eng
+
+    def set(self):
+        self._eng.resume_dispatch()
+
+
 def _gated_engine(cfg):
     """bps session whose dispatcher is held until every push is enqueued:
     makes the drain width deterministic (everything is in the queue when
@@ -114,23 +123,8 @@ def _gated_engine(cfg):
     bps.init()
     from byteps_tpu.core import api
     eng = api._engine
-    gate = threading.Event()
-    orig = eng.scheduler.get_task
-
-    def gated(block=False, timeout=None):
-        if not gate.is_set():
-            if block:
-                time.sleep(0.002)
-            return None
-        return orig(block=block, timeout=timeout)
-
-    eng.scheduler.get_task = gated
-    # the dispatcher may be INSIDE the original blocking get_task (50 ms
-    # timeout) right now; a push landing in that window would be popped
-    # around the gate.  Wait out one full timeout so every later call
-    # goes through the gate.
-    time.sleep(0.2)
-    return eng, gate
+    eng.pause_dispatch()
+    return eng, _Gate(eng)
 
 
 @pytest.fixture
