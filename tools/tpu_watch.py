@@ -167,6 +167,14 @@ def record(line: dict):
              if k.startswith("engine_") and k.endswith("MB")
              and k[len("engine_"):-2].isdigit()),
             default=(None, None))[1],
+        # round-5: drain-mode dispatch amortization — the hardware answer
+        # to "is per-chunk dispatch the engine's remaining rent?"
+        "engine_grouped_gbps": max(
+            ((int(k[len("engine_grouped_"):-2]), v)
+             for k, v in (line.get("push_pull_gbps") or {}).items()
+             if k.startswith("engine_grouped_") and k.endswith("MB")
+             and k[len("engine_grouped_"):-2].isdigit()),
+            default=(None, None))[1],
         "fused_gbps": next(
             (v for k, v in (line.get("push_pull_gbps") or {}).items()
              if k.startswith("fused") and not k.endswith("_iqr")), None),
