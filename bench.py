@@ -246,6 +246,32 @@ def _bench_push_pull(devices, on_tpu, emit=None):
             times.append(time.perf_counter() - t0)
         return to_gbps(nbytes, times)
 
+    def dispatch_amortization(nchunks=64):
+        """Deterministic dispatch-count datum (VERDICT r4 task 3): the
+        same multi-chunk push through both dispatcher modes with the
+        dispatcher paused until the queue holds every chunk, so the
+        merge width is the mode's property, not a race."""
+        counts = {}
+        chunk_elems = 65536 // 4
+        x = np.zeros(nchunks * chunk_elems, np.float32)
+        for label, gs in (("group4", 4), ("drain", -1)):
+            cfg = Config(telemetry_on=False, trace_on=False,
+                         group_size=gs, partition_bytes=65536)
+            eng = PushPullEngine(comm, cfg)
+            try:
+                eng.pause_dispatch()
+                h = eng.push_pull_local_async(x, "bench.amort")
+                eng.resume_dispatch()
+                # bounded: a chip dying exactly here must cost two
+                # minutes, not the whole inner budget (the sections after
+                # this one are the expensive ones the window exists for)
+                h.wait(timeout=120.0)
+                counts[f"dispatches_{label}"] = eng.stats["dispatches"]
+                counts[f"chunks_{label}"] = eng.stats["chunks"]
+            finally:
+                eng.shutdown(wait=False)
+        return counts
+
     mb = 1024 * 1024
     sizes = [mb, 16 * mb, 256 * mb] if on_tpu else [mb, 8 * mb]
     out = {}
@@ -287,6 +313,13 @@ def _bench_push_pull(devices, on_tpu, emit=None):
         lambda: engine_gbps(big, group_size=-1))
     add(f"engine_device_grouped_{big // mb}MB",
         lambda: engine_device_gbps(big, group_size=-1))
+    if "error" not in out:  # same chip-gone gate as add(): once a drop
+        try:                # is seen, stop touching the device
+            out["dispatch_amortization"] = dispatch_amortization()
+        except Exception as e:  # noqa: BLE001 - must not kill the sweep
+            out["dispatch_amortization"] = {"error": str(e)[:200]}
+        if emit is not None:
+            emit(dict(out))
     # The three ablations are secondary to the headline engine figure; if
     # the hardware engine path is slow enough that each would eat minutes
     # of a possibly-short green window, skip them with the projection
