@@ -1,7 +1,9 @@
 """Gradient compression engine (reference byteps/common/compressor/ —
 SURVEY.md §2.2): onebit / topk / randomk / dithering compressors with
 error-feedback and Nesterov-momentum decorators, re-designed as functional
-jittable JAX transforms with explicit state.
+jittable JAX transforms with explicit state — plus a beyond-parity
+PowerSGD-style low-rank compressor whose transforms are pure MXU matmuls
+(compression/powersgd.py).
 
 Where the reference compresses to shrink NIC bytes between workers and
 parameter servers, this engine shrinks interconnect bytes — most valuable
@@ -13,6 +15,7 @@ from .dithering import DitheringCompressor  # noqa: F401
 from .error_feedback import ErrorFeedback  # noqa: F401
 from .momentum import NesterovMomentum  # noqa: F401
 from .onebit import OnebitCompressor  # noqa: F401
+from .powersgd import PowerSGDCompressor  # noqa: F401
 from .randomk import RandomkCompressor  # noqa: F401
 from .registry import create  # noqa: F401
 from .topk import TopkCompressor  # noqa: F401

@@ -46,6 +46,14 @@ class ErrorFeedback(Compressor):
     def decompress(self, payload):
         return self.inner.decompress(payload)
 
+    def decompress_sum(self, gathered):
+        # Delegate: decorators change state threading, not payloads, so
+        # the inner's FUSED server sum (onebit's Pallas merge, powersgd's
+        # batched einsum) must run under the decorator too — the base
+        # vmap fallback would materialize an (R, numel) intermediate
+        # exactly when compression is in use.
+        return self.inner.decompress_sum(gathered)
+
     def payload_nbytes(self) -> int:
         return self.inner.payload_nbytes()
 

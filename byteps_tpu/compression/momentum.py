@@ -39,6 +39,11 @@ class NesterovMomentum(Compressor):
     def decompress(self, payload):
         return self.inner.decompress(payload)
 
+    def decompress_sum(self, gathered):
+        # Delegate so the inner's fused server sum runs under the
+        # decorator (see ErrorFeedback.decompress_sum).
+        return self.inner.decompress_sum(gathered)
+
     def payload_nbytes(self) -> int:
         return self.inner.payload_nbytes()
 

@@ -1,0 +1,109 @@
+"""PowerSGD-style low-rank gradient compression (Vogels et al., 2019).
+
+Beyond the reference's compressor set (onebit/topk/randomk/dithering —
+compressor/impl/*): the gradient chunk, viewed as a matrix M [n, m], is
+approximated by a rank-``r`` product P Qᵀ obtained from one warm-started
+subspace (power) iteration per step:
+
+    P  = orth(M Q)          (orthonormal columns, QR)
+    Q' = Mᵀ P               (also next step's warm start — the subspace
+                             tracks the gradient's slowly-moving row space)
+
+Wire payload is (P [n,r], Q' [m,r]): (n+m)·r floats instead of n·m — for
+a square chunk at rank 4 that is ~sqrt(numel)/8x fewer bytes, with f32
+fidelity on the captured subspace (contrast onebit: fixed 32x, 1-bit
+fidelity everywhere).  TPU-first by construction: compress, decompress
+and the server sum are plain matmuls — MXU work, no bit manipulation.
+
+Protocol fit: per-worker compression with a server-side
+decompress-and-sum, exactly how the engine treats every nonlinear
+compressor (reference server.cc:87-113).  Each rank runs its own
+warm-started iteration; the merged result is Σᵢ PᵢQᵢᵀ.  This differs
+from the all-reduce-P-then-Q aggregation of the original paper (which
+needs two collective rounds per step and rank-identical Q); error
+feedback (``ef: vanilla``) provides the convergence guarantee for the
+per-worker form, as it does for topk.  ``bidirectional`` is False: the
+merged sum has rank up to R·r, and re-compressing it back to rank r on
+the pull would silently discard exactly the cross-worker components the
+sum just built.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .base import Compressor, Payload, State
+
+
+def _matrix_shape(numel: int):
+    """Near-square [n, m] view of the flat chunk, n >= m.  m is rounded
+    down to a lane multiple (128) when the chunk is big enough so M's
+    rows tile the MXU cleanly; tiny chunks fall back to exact-square."""
+    m = int(np.sqrt(numel))
+    if m >= 256:
+        m -= m % 128
+    m = max(1, m)
+    n = -(-numel // m)
+    return n, m
+
+
+class PowerSGDCompressor(Compressor):
+    name = "powersgd"
+    bidirectional = False
+
+    def __init__(self, numel: int, dtype=jnp.float32, rank: int = 4,
+                 seed: int = 0):
+        super().__init__(numel, dtype)
+        self.n, self.m = _matrix_shape(self.numel)
+        self.rank = max(1, min(int(rank), self.n, self.m))
+        self.seed = int(seed)
+
+    # -- state ------------------------------------------------------------
+    def init_state(self) -> State:
+        # Deterministic gaussian start (house convention: seeded and
+        # reproducible across ranks/restarts); after the first compress
+        # the state is the warm-started Q'.
+        q0 = np.random.RandomState(self.seed).standard_normal(
+            (self.m, self.rank)).astype(np.float32)
+        return {"q": jnp.asarray(q0)}
+
+    # -- transforms --------------------------------------------------------
+    def _as_matrix(self, x):
+        xf = x.astype(jnp.float32)
+        pad = self.n * self.m - self.numel
+        if pad:
+            xf = jnp.pad(xf, (0, pad))
+        return xf.reshape(self.n, self.m)
+
+    def compress(self, x, state: State):
+        M = self._as_matrix(x)
+        P = M @ state["q"]                              # [n, r]
+        # Orthonormalize via reduced QR.  No additive ridge: Householder
+        # QR is finite on zero/rank-deficient input (pinned by
+        # tests/test_powersgd.py), and a constant offset would bias the
+        # captured subspace toward the all-ones direction exactly when
+        # gradients are small — the degenerate columns just span an
+        # arbitrary complement, whose Mᵀ P energy is ~0.
+        P, _ = jnp.linalg.qr(P)
+        Qn = M.T @ P                                    # [m, r]
+        return {"p": P, "q": Qn}, {"q": Qn}
+
+    def decompress(self, payload: Payload):
+        M = payload["p"] @ payload["q"].T
+        return M.reshape(-1)[: self.numel].astype(self.dtype)
+
+    def decompress_sum(self, gathered: Payload):
+        # Σᵢ Pᵢ Qᵢᵀ as ONE batched matmul over the gathered [R, ...]
+        # payloads — the fused "server" pass, all MXU.
+        s = jnp.einsum("bnr,bmr->nm", gathered["p"], gathered["q"],
+                       preferred_element_type=jnp.float32)
+        return s.reshape(-1)[: self.numel]
+
+    # -- accounting --------------------------------------------------------
+    def payload_nbytes(self) -> int:
+        return (self.n + self.m) * self.rank * 4
+
+    def cache_key(self) -> tuple:
+        return super().cache_key() + (self.rank, self.seed)

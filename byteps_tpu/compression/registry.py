@@ -19,6 +19,7 @@ from .dithering import DitheringCompressor
 from .error_feedback import ErrorFeedback
 from .momentum import NesterovMomentum
 from .onebit import OnebitCompressor
+from .powersgd import PowerSGDCompressor
 from .randomk import RandomkCompressor
 from .topk import TopkCompressor
 
@@ -41,6 +42,13 @@ def _make_onebit(numel, dtype, kwargs):
 @register("topk")
 def _make_topk(numel, dtype, kwargs):
     return TopkCompressor(numel, dtype, k=_num(kwargs.get("k", 0.01)))
+
+
+@register("powersgd")
+def _make_powersgd(numel, dtype, kwargs):
+    return PowerSGDCompressor(numel, dtype,
+                              rank=int(kwargs.get("rank", 4)),
+                              seed=int(kwargs.get("seed", 0)))
 
 
 @register("randomk")
@@ -73,12 +81,13 @@ def create(kwargs: Optional[Dict], numel: int, dtype=jnp.float32,
            for_server: bool = False) -> Compressor:
     """Build the compressor chain from a kwargs dict.
 
-    Keys (reference docs/gradient-compression.md naming):
-      compressor: onebit|topk|randomk|dithering
+    Keys (reference docs/gradient-compression.md naming; powersgd is the
+    beyond-parity low-rank addition):
+      compressor: onebit|topk|randomk|dithering|powersgd
       ef: vanilla                     (error feedback decorator)
       momentum: nesterov              (worker-side only)
       + per-compressor params (k, scaling, partition_num, normalize, seed,
-        momentum_mu)
+        momentum_mu, rank)
     """
     if not kwargs or "compressor" not in kwargs:
         return IdentityCompressor(numel, dtype)

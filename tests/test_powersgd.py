@@ -1,0 +1,184 @@
+"""PowerSGD-style low-rank compressor (compression/powersgd.py): shape
+algebra, warm-started subspace capture, fused server sum, EF-chain
+convergence through the real engine, and wire accounting.  Beyond the
+reference's compressor set; follows its per-worker-compress /
+server-sum protocol (reference server.cc:87-113)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import byteps_tpu as bps
+from byteps_tpu.common import Config
+from byteps_tpu.common.config import set_config
+from byteps_tpu.compression import create
+from byteps_tpu.compression.powersgd import (PowerSGDCompressor,
+                                             _matrix_shape)
+
+
+def test_matrix_shape_near_square_and_lane_aligned():
+    n, m = _matrix_shape(1 << 20)            # 1M elems
+    assert n * m >= 1 << 20
+    assert m % 128 == 0                      # MXU lane alignment
+    assert n >= m
+    # tiny chunks: exact-square fallback, no degenerate dims
+    n, m = _matrix_shape(10)
+    assert n * m >= 10 and m >= 1
+
+
+def test_rank_clamped_to_matrix_dims():
+    c = PowerSGDCompressor(numel=12, rank=64)   # 4x3-ish matrix
+    assert c.rank <= min(c.n, c.m)
+
+
+def test_payload_shapes_and_wire_savings():
+    numel = 256 * 256
+    c = PowerSGDCompressor(numel, rank=4)
+    x = jnp.asarray(np.random.RandomState(0).randn(numel), jnp.float32)
+    payload, state = c.compress(x, c.init_state())
+    assert payload["p"].shape == (c.n, c.rank)
+    assert payload["q"].shape == (c.m, c.rank)
+    assert state["q"].shape == (c.m, c.rank)
+    dense = numel * 4
+    assert c.payload_nbytes() < dense / 25    # >25x for 256x256 at r=4
+    # accounting matches the actual payload
+    actual = sum(int(np.prod(v.shape)) * 4 for v in payload.values())
+    assert actual == c.payload_nbytes()
+
+
+def test_exactly_low_rank_input_recovered_after_warm_start():
+    # A rank-2 matrix must be captured ~exactly by rank>=2 power
+    # iteration once the warm-started subspace converges.
+    rng = np.random.RandomState(1)
+    n = m = 64
+    M = (rng.randn(n, 2) @ rng.randn(2, m)).astype(np.float32)
+    x = jnp.asarray(M.reshape(-1))
+    c = PowerSGDCompressor(n * m, rank=2)
+    state = c.init_state()
+    err = []
+    for _ in range(4):
+        payload, state = c.compress(x, state)
+        rec = np.asarray(c.decompress(payload)).reshape(n, m)
+        err.append(np.linalg.norm(rec - M) / np.linalg.norm(M))
+    assert err[-1] < 1e-3, err                # converged onto the subspace
+    assert err[-1] <= err[0] + 1e-6           # warm start never hurts
+
+
+def test_zero_and_rank_deficient_inputs_stay_finite():
+    c = PowerSGDCompressor(1024, rank=4)
+    for x in (jnp.zeros(1024, jnp.float32),
+              jnp.ones(1024, jnp.float32)):   # rank-1: deficient at r=4
+        payload, state = c.compress(x, c.init_state())
+        rec = c.decompress(payload)
+        assert np.isfinite(np.asarray(rec)).all()
+        assert np.isfinite(np.asarray(state["q"])).all()
+
+
+def test_decompress_sum_matches_per_rank_decompression():
+    numel = 48 * 48
+    c = PowerSGDCompressor(numel, rank=3)
+    rng = np.random.RandomState(2)
+    payloads = []
+    for i in range(4):
+        x = jnp.asarray(rng.randn(numel), jnp.float32)
+        p, _ = c.compress(x, c.init_state())
+        payloads.append(p)
+    gathered = {k: jnp.stack([p[k] for p in payloads])
+                for k in payloads[0]}
+    fused = np.asarray(c.decompress_sum(gathered))
+    ref = sum(np.asarray(c.decompress(p)).astype(np.float64)
+              for p in payloads)
+    np.testing.assert_allclose(fused, ref, rtol=2e-5, atol=1e-4)
+
+
+def test_registry_string_kwargs():
+    c = create({"compressor": "powersgd", "rank": "2", "seed": "7"},
+               4096, jnp.float32)
+    assert c.name == "powersgd" and c.rank == 2 and c.seed == 7
+    assert c.cache_key() != create({"compressor": "powersgd", "rank": "3"},
+                                   4096, jnp.float32).cache_key()
+    # EF chain wraps it like any other compressor
+    ef = create({"compressor": "powersgd", "ef": "vanilla"}, 4096,
+                jnp.float32)
+    assert "error" in str(type(ef).__name__).lower() or hasattr(ef, "inner")
+
+
+def test_engine_push_pull_powersgd_end_to_end():
+    # Through the real engine on the 8-rank mesh: compressed push_pull of
+    # a LOW-RANK stacked gradient reproduces the plain average closely
+    # after the warm-start settles (same tensor name -> same slot/state).
+    set_config(Config(telemetry_on=False, trace_on=False,
+                      min_compress_bytes=0))
+    bps.init()
+    try:
+        rng = np.random.RandomState(3)
+        base = (rng.randn(64, 2) @ rng.randn(2, 64)).astype(np.float32)
+        stacked = np.stack([base * (i + 1) for i in range(8)])  # rank 2
+        want = stacked.mean(0).reshape(-1)
+        out = None
+        for _ in range(4):   # warm-start iterations on the same key
+            out = bps.push_pull(
+                jnp.asarray(stacked.reshape(8, -1)), "psgd/g",
+                op="average",
+                compression={"compressor": "powersgd", "rank": "2"})
+        got = np.asarray(out).reshape(-1)
+        rel = (np.linalg.norm(got - want) / np.linalg.norm(want))
+        assert rel < 1e-3, rel
+    finally:
+        bps.shutdown()
+
+
+def test_engine_powersgd_with_error_feedback_converges():
+    # EF accumulates what the rank-1 approximation drops; a full-rank
+    # gradient pushed repeatedly must see its EF-compensated average
+    # approach the true average over steps (the EF contract, same as the
+    # onebit/topk chains).
+    set_config(Config(telemetry_on=False, trace_on=False,
+                      min_compress_bytes=0))
+    bps.init()
+    try:
+        rng = np.random.RandomState(4)
+        stacked = rng.randn(8, 32 * 32).astype(np.float32)  # full rank
+        want = stacked.mean(0)
+        errs = []
+        acc = np.zeros_like(want)
+        for step in range(6):
+            out = bps.push_pull(
+                jnp.asarray(stacked), "psgd/ef", op="average",
+                compression={"compressor": "powersgd", "rank": "2",
+                             "ef": "vanilla"})
+            acc += np.asarray(out)
+            # EF guarantee: the RUNNING SUM of outputs tracks step*want
+            errs.append(np.linalg.norm(acc - (step + 1) * want)
+                        / np.linalg.norm((step + 1) * want))
+        assert errs[-1] < errs[0], errs       # compensation is working
+    finally:
+        bps.shutdown()
+
+
+def test_decorators_delegate_fused_server_sum():
+    # code-review r5: EF/momentum wrap the compressor, and the engine
+    # calls decompress_sum on the WRAPPER — without delegation the
+    # inner's fused kernel (powersgd einsum, onebit Pallas merge) is
+    # silently replaced by the base vmap fallback.
+    calls = []
+
+    class Spy(PowerSGDCompressor):
+        def decompress_sum(self, gathered):
+            calls.append("fused")
+            return super().decompress_sum(gathered)
+
+    from byteps_tpu.compression.error_feedback import ErrorFeedback
+    from byteps_tpu.compression.momentum import NesterovMomentum
+
+    inner = Spy(1024, rank=2)
+    for wrapper in (ErrorFeedback(inner),
+                    NesterovMomentum(ErrorFeedback(inner), mu=0.9)):
+        calls.clear()
+        p, _ = inner.compress(jnp.ones(1024, jnp.float32),
+                              inner.init_state())
+        gathered = {k: jnp.stack([v, v]) for k, v in p.items()}
+        wrapper.decompress_sum(gathered)
+        assert calls == ["fused"], type(wrapper).__name__
