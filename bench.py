@@ -394,7 +394,8 @@ def _bench_dcn_compare():
     from jax.sharding import Mesh, PartitionSpec as P
 
     from byteps_tpu.ops.collective_ops import (hierarchical_push_pull,
-                                               make_onebit_pair)
+                                               make_onebit_pair,
+                                               make_powersgd_pair)
     from byteps_tpu.utils.hlo_wire import dcn_ici_bytes
 
     devs = np.array(jax.devices()[:8]).reshape(2, 4)
@@ -404,8 +405,8 @@ def _bench_dcn_compare():
     # CPU-mesh run inside the smoke-test budget on a loaded host.
     n = 1 << 20
 
-    def build(compressed):
-        c, d = make_onebit_pair() if compressed else (None, None)
+    def build(pair):
+        c, d = pair() if pair else (None, None)
 
         def body(x):
             # compress_min_bytes=0: this section's point IS the compressed
@@ -421,8 +422,9 @@ def _bench_dcn_compare():
         return f, x, f.lower(x).compile().as_text()
 
     out = {}
-    for tag, compressed in (("plain", False), ("onebit_dcn", True)):
-        f, x, hlo = build(compressed)
+    for tag, pair in (("plain", None), ("onebit_dcn", make_onebit_pair),
+                      ("powersgd_dcn", make_powersgd_pair)):
+        f, x, hlo = build(pair)
         f(x).block_until_ready()
         reps = 3
         t0 = time.perf_counter()
@@ -437,6 +439,9 @@ def _bench_dcn_compare():
     p, c = out["plain"], out["onebit_dcn"]
     out["dcn_wire_ratio"] = round(
         p["dcn_bytes_per_rank"] / max(1, c["dcn_bytes_per_rank"]), 1)
+    out["dcn_wire_ratio_powersgd"] = round(
+        p["dcn_bytes_per_rank"]
+        / max(1, out["powersgd_dcn"]["dcn_bytes_per_rank"]), 1)
     return out
 
 

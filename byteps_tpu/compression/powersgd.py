@@ -54,11 +54,17 @@ class PowerSGDCompressor(Compressor):
     bidirectional = False
 
     def __init__(self, numel: int, dtype=jnp.float32, rank: int = 4,
-                 seed: int = 0):
+                 seed: int = 0, iters: int = 1):
+        """``iters``: power iterations per compress.  1 (default) relies
+        on the warm-started state for subspace quality — right for the
+        engine path, where the state persists across steps.  Stateless
+        call sites (the DCN-hop pair, which cold-starts every trace)
+        want 2-3: each extra iteration is two matmuls and one QR."""
         super().__init__(numel, dtype)
         self.n, self.m = _matrix_shape(self.numel)
         self.rank = max(1, min(int(rank), self.n, self.m))
         self.seed = int(seed)
+        self.iters = max(1, int(iters))
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> State:
@@ -79,16 +85,17 @@ class PowerSGDCompressor(Compressor):
 
     def compress(self, x, state: State):
         M = self._as_matrix(x)
-        P = M @ state["q"]                              # [n, r]
+        Q = state["q"]
         # Orthonormalize via reduced QR.  No additive ridge: Householder
         # QR is finite on zero/rank-deficient input (pinned by
         # tests/test_powersgd.py), and a constant offset would bias the
         # captured subspace toward the all-ones direction exactly when
         # gradients are small — the degenerate columns just span an
         # arbitrary complement, whose Mᵀ P energy is ~0.
-        P, _ = jnp.linalg.qr(P)
-        Qn = M.T @ P                                    # [m, r]
-        return {"p": P, "q": Qn}, {"q": Qn}
+        for _ in range(self.iters):
+            P, _ = jnp.linalg.qr(M @ Q)                 # [n, r]
+            Q = M.T @ P                                 # [m, r]
+        return {"p": P, "q": Q}, {"q": Q}
 
     def decompress(self, payload: Payload):
         M = payload["p"] @ payload["q"].T
@@ -106,4 +113,4 @@ class PowerSGDCompressor(Compressor):
         return (self.n + self.m) * self.rank * 4
 
     def cache_key(self) -> tuple:
-        return super().cache_key() + (self.rank, self.seed)
+        return super().cache_key() + (self.rank, self.seed, self.iters)

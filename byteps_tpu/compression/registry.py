@@ -48,7 +48,8 @@ def _make_topk(numel, dtype, kwargs):
 def _make_powersgd(numel, dtype, kwargs):
     return PowerSGDCompressor(numel, dtype,
                               rank=int(kwargs.get("rank", 4)),
-                              seed=int(kwargs.get("seed", 0)))
+                              seed=int(kwargs.get("seed", 0)),
+                              iters=int(kwargs.get("iters", 1)))
 
 
 @register("randomk")

@@ -14,5 +14,6 @@ from .collective_ops import (  # noqa: F401
     broadcast_tree,
     hierarchical_push_pull,
     make_onebit_pair,
+    make_powersgd_pair,
 )
 from .flash_attention import flash_attention  # noqa: F401
