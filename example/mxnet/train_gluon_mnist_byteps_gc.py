@@ -32,7 +32,8 @@ def main():
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--compressor", default="onebit",
-                    choices=["onebit", "topk", "randomk", "dithering"])
+                    choices=["onebit", "topk", "randomk", "dithering",
+                             "powersgd"])
     args = ap.parse_args()
 
     import mxnet as mx
