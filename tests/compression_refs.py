@@ -113,3 +113,35 @@ def nesterov_compress(x, m, mu):
     x = x.astype(np.float32)
     m2 = mu * m + x
     return x + mu * m2, m2
+
+
+def powersgd_matrix_shape(numel):
+    """Mirror of compression.powersgd._matrix_shape."""
+    m = int(np.sqrt(numel))
+    if m >= 256:
+        m -= m % 128
+    m = max(1, m)
+    n = -(-numel // m)
+    return n, m
+
+
+def powersgd_compress(x, rank, seed=0, iters=1, q=None):
+    """Pure-numpy mirror of PowerSGDCompressor.compress: returns
+    (P, Q') with the same warm-start semantics (pass the previous call's
+    Q' as ``q``)."""
+    x = np.asarray(x, np.float32)
+    numel = x.size
+    n, m = powersgd_matrix_shape(numel)
+    r = max(1, min(int(rank), n, m))
+    M = np.pad(x, (0, n * m - numel)).reshape(n, m)
+    if q is None:
+        q = np.random.RandomState(seed).standard_normal(
+            (m, r)).astype(np.float32)
+    for _ in range(max(1, iters)):
+        p, _ = np.linalg.qr(M @ q)
+        q = M.T @ p
+    return p.astype(np.float32), q.astype(np.float32)
+
+
+def powersgd_decompress(p, q, numel, dtype=np.float32):
+    return (p @ q.T).reshape(-1)[:numel].astype(dtype)

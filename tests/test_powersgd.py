@@ -247,3 +247,35 @@ def test_dcn_pair_wire_bytes_and_exactness_on_low_rank_shards():
     # 16x at this deliberately small test shard (128x128, r=4); the
     # ratio grows as sqrt(numel) — the bench's 1 MiB shard shows 64x
     assert dcn_b <= (n // 4) * 4 / 16
+
+
+def test_matches_numpy_reference():
+    # House convention (tests/compression_refs.py): every compressor has
+    # a portable numpy mirror.  The comparison must use a SEPARATED
+    # spectrum: on a flat (random gaussian) spectrum the top-r subspace
+    # is ill-conditioned and f32 rounding legitimately rotates it between
+    # backends — with decaying singular values the captured subspace, and
+    # therefore the reconstruction, is numerically pinned.
+    from tests import compression_refs as refs
+
+    rng = np.random.RandomState(6)
+    nm = 80
+    numel = nm * nm
+    U, _ = np.linalg.qr(rng.randn(nm, nm).astype(np.float64))
+    V, _ = np.linalg.qr(rng.randn(nm, nm).astype(np.float64))
+    x = ((U * 0.5 ** np.arange(nm)) @ V.T).astype(np.float32).reshape(-1)
+    c = PowerSGDCompressor(numel, rank=3, iters=2)
+    payload, _ = c.compress(jnp.asarray(x), c.init_state())
+    rec = np.asarray(c.decompress(payload))
+
+    p_ref, q_ref = refs.powersgd_compress(x, rank=3, iters=2)
+    rec_ref = refs.powersgd_decompress(p_ref, q_ref, numel)
+    np.testing.assert_allclose(rec, rec_ref, rtol=1e-4, atol=1e-5)
+    # warm-start parity: second step with each side's own state — looser,
+    # because the states themselves have accumulated one step of f32
+    # rounding differences between LAPACK and XLA
+    payload2, _ = c.compress(jnp.asarray(x), {"q": payload["q"]})
+    p2, q2 = refs.powersgd_compress(x, rank=3, q=q_ref)
+    np.testing.assert_allclose(
+        np.asarray(c.decompress(payload2)),
+        refs.powersgd_decompress(p2, q2, numel), rtol=1e-2, atol=2e-3)
