@@ -48,6 +48,16 @@ def _cached(comm: CommContext, key, builder):
     return fn
 
 
+def _cached_scalar(comm: CommContext, value, dtype):
+    """Device scalar cache: chunk offsets and fused scales come from a
+    small static set but were being device_put on EVERY dispatch —
+    profiling showed the per-call jnp.asarray (host->device transfer +
+    dtype convert) costing ~20% of the engine's host-side dispatch time.
+    One transfer per distinct value instead."""
+    return _cached(comm, ("scalar", value, str(dtype)),
+                   lambda: jnp.asarray(value, dtype))
+
+
 def _acc(x):
     """Accumulation cast: f16/bf16 summands accumulate in f32, like the
     reference's CpuReducer (f16 -> f32 convert-sum-convert,
@@ -286,14 +296,15 @@ def push_pull_array_scaled(comm: CommContext, stacked, scale: float,
         hierarchical = comm.n_dcn > 1
     acc_dtype = (jnp.float64 if stacked.dtype == jnp.float64
                  else jnp.float32)
+    scale_a = _cached_scalar(comm, float(scale), acc_dtype)
     if local:
         fn = (_hierarchical_fn(comm, False, scaled=True, local=True)
               if hierarchical
               else _all_reduce_fn(comm, False, scaled=True, local=True))
-        return fn(stacked, jnp.asarray(scale, acc_dtype))
+        return fn(stacked, scale_a)
     fn = (_hierarchical_fn(comm, False, scaled=True) if hierarchical
           else _all_reduce_fn(comm, False, scaled=True))
-    return fn(_as_stacked(comm, stacked), jnp.asarray(scale, acc_dtype))
+    return fn(_as_stacked(comm, stacked), scale_a)
 
 
 # ---------------------------------------------------------------------------
@@ -418,7 +429,7 @@ def push_pull_chunk_scatter(comm: CommContext, flat, buf, col_off: int,
     (:func:`stage_local_replicated`).  Returns (buf, token)."""
     fn = _chunk_scatter_program(comm, w, k, C, init=buf is None,
                                 local=flat.ndim == 1)
-    offa = jnp.asarray(col_off, jnp.int32)
+    offa = _cached_scalar(comm, int(col_off), jnp.int32)
     if buf is None:
         return fn(flat, offa)
     return fn(flat, offa, buf)
@@ -496,7 +507,7 @@ def push_pull_arrays_batched(comm: CommContext, xs, scale=None,
                                 scale is not None, local)
     if scale is not None:
         acc = jnp.float64 if xs[0].dtype == jnp.float64 else jnp.float32
-        return list(fn(*xs, jnp.asarray(scale, acc)))
+        return list(fn(*xs, _cached_scalar(comm, float(scale), acc)))
     return list(fn(*xs))
 
 
@@ -557,5 +568,5 @@ def assemble_scatter(comm: CommContext, buf, n: int, C: int, out_shape,
                            scale is not None, denom)
     if scale is not None:
         acc = jnp.float64 if buf.dtype == jnp.float64 else jnp.float32
-        return fn(buf, jnp.asarray(scale, acc))
+        return fn(buf, _cached_scalar(comm, float(scale), acc))
     return fn(buf)
