@@ -53,9 +53,15 @@ def _cached_scalar(comm: CommContext, value, dtype):
     small static set but were being device_put on EVERY dispatch —
     profiling showed the per-call jnp.asarray (host->device transfer +
     dtype convert) costing ~20% of the engine's host-side dispatch time.
-    One transfer per distinct value instead."""
-    return _cached(comm, ("scalar", value, str(dtype)),
-                   lambda: jnp.asarray(value, dtype))
+    One transfer per distinct value instead.  Placed with the replicated
+    mesh sharding at cache time: an uncommitted single-device scalar
+    would be re-sharded by EVERY pjit call consuming it (shard_args ->
+    batched_device_put per dispatch — visible in the profile), which
+    would hand back much of the caching win."""
+    return _cached(
+        comm, ("scalar", value, str(dtype)),
+        lambda: jax.device_put(jnp.asarray(value, dtype),
+                               comm.replicated_sharding()))
 
 
 def _acc(x):
