@@ -26,6 +26,9 @@ from .sequence import (  # noqa: F401
     make_sp_attention,
     make_sp_mesh,
     ring_attention,
+    stripe_batch,
+    striped_attention,
+    unstripe_batch,
     sp_mesh_from_comm,
     ulysses_attention,
 )

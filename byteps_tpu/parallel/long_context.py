@@ -28,8 +28,18 @@ from ..ops import push_pull_tree
 from .sequence import DP_AXIS, SP_AXIS
 
 
-def shard_lm_batch(mesh: Mesh, batch):
-    """Place {input_ids, labels} [B, T] with batch over dp, seq over sp."""
+def shard_lm_batch(mesh: Mesh, batch, striped: bool = False):
+    """Place {input_ids, labels} [B, T] with batch over dp, seq over sp.
+
+    ``striped=True`` round-robins the sequence axis first
+    (:func:`sequence.stripe_batch`), the layout
+    ``make_dp_sp_train_step(attention="striped")`` requires — ids and
+    labels permute together, so the shifted-label alignment is
+    preserved token-for-token."""
+    if striped:
+        from .sequence import stripe_batch
+        n = mesh.shape[SP_AXIS]
+        batch = {k: stripe_batch(v, n) for k, v in batch.items()}
     sh = NamedSharding(mesh, P(DP_AXIS, SP_AXIS))
     return jax.device_put(batch, sh)
 
@@ -59,20 +69,30 @@ def make_dp_sp_train_step(mesh: Mesh, cfg,
     ``cfg`` is a :class:`GPTConfig` or :class:`LlamaConfig` (family picked
     by type).  ``batch`` holds ``input_ids`` and ``labels`` (both [B, T],
     labels already shifted, -1 = ignore), sharded via
-    :func:`shard_lm_batch`.  ``attention`` is "ring", "ring_flash" (ring
-    rotation with Pallas flash block kernels), "ulysses",
-    "ulysses_flash", or "flash" (local flash kernels, sp=1 only).
+    :func:`shard_lm_batch`.  ``attention`` is "ring", "striped"
+    (load-balanced causal ring; pass the batch through
+    ``shard_lm_batch(..., striped=True)`` — the step computes positions
+    for the striped layout, so RoPE and the causal mask stay exact with
+    NO per-layer repermutes), "ring_flash" (ring rotation with Pallas
+    flash block kernels), "ulysses", "ulysses_flash", or "flash" (local
+    flash kernels, sp=1 only).
     """
     from .sequence import resolve_sp_attention
     attn = resolve_sp_attention(attention, mesh=mesh)
     model = _model_for(cfg, attn)
     axes = (DP_AXIS, SP_AXIS)
+    n_sp = mesh.shape[SP_AXIS]
 
     def step(params, opt_state, batch):
         ids, labels = batch["input_ids"], batch["labels"]
         t_local = ids.shape[1]
-        pos = (lax.axis_index(SP_AXIS) * t_local
-               + jnp.arange(t_local))[None]
+        if attention == "striped":
+            # striped layout: local slot ℓ holds global token ℓ·n + my
+            pos = (jnp.arange(t_local) * n_sp
+                   + lax.axis_index(SP_AXIS))[None]
+        else:
+            pos = (lax.axis_index(SP_AXIS) * t_local
+                   + jnp.arange(t_local))[None]
 
         def loss_fn(p):
             logits = model.apply(p, ids, positions=pos)

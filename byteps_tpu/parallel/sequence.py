@@ -57,30 +57,25 @@ def full_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out.astype(q.dtype)
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str = SP_AXIS, *,
-                   causal: bool = False,
-                   sm_scale: Optional[float] = None) -> jax.Array:
-    """Ring attention over sequence shards.  Call inside shard_map.
+def _ring_core(q, k, v, axis_name, scale, mask_fn, skip_fn):
+    """Shared K/V-rotation + online-softmax accumulator behind
+    :func:`ring_attention` and :func:`striped_attention` — ONE copy of
+    the numerically delicate fold (running max / normalizer / _NEG
+    handling / trailing fold outside the loop).
 
-    Every device holds [B, T/sp, H, D] shards of q/k/v (sequence axis 1
-    sharded over ``axis_name`` in ring order).  The K/V block circulates the
-    ring; each of the sp steps does one blockwise attention against the
-    resident block and folds it into the online-softmax accumulators.
-
-    Returns the attention output for the local q shard, same shape/dtype
-    as q.  Differentiable (pure lax ops — JAX transposes the ppermutes).
-    """
+    ``mask_fn(my, src) -> [tq, tk] bool`` gives the visible set for the
+    block that started on rank ``src`` (None = unmasked);
+    ``skip_fn(my, src) -> traced bool`` says whether the block has ANY
+    visible entry (None = always attend).  The skip predicate diverges
+    across devices, which is safe — the attend body contains no
+    collectives (the ppermute lives outside the cond)."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     b, tq, h, d = q.shape
-    tk = k.shape[1]
 
     m = jnp.full((b, h, tq), _NEG, dtype=jnp.float32)
     l = jnp.zeros((b, h, tq), dtype=jnp.float32)
     o = jnp.zeros((b, h, tq, d), dtype=jnp.float32)
-    q_pos = my * tq + jnp.arange(tq)
 
     def fold(m, l, o, k, v, step):
         # The resident block started at rank (my - step) mod n.
@@ -89,9 +84,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         def attend(m, l, o):
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                            preferred_element_type=jnp.float32) * scale
-            if causal:
-                k_pos = src * tk + jnp.arange(tk)
-                s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG)
+            if mask_fn is not None:
+                s = jnp.where(mask_fn(my, src), s, _NEG)
             m_new = jnp.maximum(m, s.max(axis=-1))
             p = jnp.exp(s - m_new[..., None])
             alpha = jnp.exp(m - m_new)
@@ -100,15 +94,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
             return m_new, l, o
 
-        if not causal:
+        if skip_fn is None:
             return attend(m, l, o)
-        # Skip blocks that are entirely in the future (all masked): without
-        # this ~half the ring's QK^T/PV FLOPs compute _NEG blocks only to be
-        # underflowed away.  The predicate diverges across devices, which is
-        # safe — attend() contains no collectives (the ppermute lives in the
-        # caller, outside the cond).
-        visible = src * tk <= my * tq + (tq - 1)
-        return lax.cond(visible, attend, lambda m, l, o: (m, l, o), m, l, o)
+        return lax.cond(skip_fn(my, src), attend,
+                        lambda m, l, o: (m, l, o), m, l, o)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -125,6 +114,105 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m, l, o = fold(m, l, o, k, v, n - 1)
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = SP_AXIS, *,
+                   causal: bool = False,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over sequence shards.  Call inside shard_map.
+
+    Every device holds [B, T/sp, H, D] shards of q/k/v (sequence axis 1
+    sharded over ``axis_name`` in ring order).  The K/V block circulates the
+    ring; each of the sp steps does one blockwise attention against the
+    resident block and folds it into the online-softmax accumulators.
+
+    Returns the attention output for the local q shard, same shape/dtype
+    as q.  Differentiable (pure lax ops — JAX transposes the ppermutes).
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    tq, tk = q.shape[1], k.shape[1]
+
+    if not causal:
+        return _ring_core(q, k, v, axis_name, scale, None, None)
+
+    def mask(my, src):
+        q_pos = my * tq + jnp.arange(tq)
+        k_pos = src * tk + jnp.arange(tk)
+        return q_pos[:, None] >= k_pos[None, :]
+
+    def skip(my, src):
+        # Blocks entirely in the future are all masked: without the skip
+        # ~half the ring's QK^T/PV FLOPs compute _NEG blocks only to be
+        # underflowed away.
+        return src * tk <= my * tq + (tq - 1)
+
+    return _ring_core(q, k, v, axis_name, scale, mask, skip)
+
+
+def stripe_batch(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Round-robin permutation of a sequence axis: token t moves to
+    position (t % n) * (T/n) + t // n, so a CONTIGUOUS n-way sharding of
+    the result gives rank r the stripe {r, r+n, r+2n, ...} — the layout
+    :func:`striped_attention` balances causal work over."""
+    t = x.shape[axis]
+    if t % n:
+        raise ValueError(f"sequence length {t} not divisible by sp={n}")
+    xm = jnp.moveaxis(x, axis, 0)
+    xm = xm.reshape(t // n, n, *xm.shape[1:]).swapaxes(0, 1)
+    return jnp.moveaxis(xm.reshape(t, *xm.shape[2:]), 0, axis)
+
+
+def unstripe_batch(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`stripe_batch`."""
+    t = x.shape[axis]
+    if t % n:
+        raise ValueError(f"sequence length {t} not divisible by sp={n}")
+    xm = jnp.moveaxis(x, axis, 0)
+    xm = xm.reshape(n, t // n, *xm.shape[1:]).swapaxes(0, 1)
+    return jnp.moveaxis(xm.reshape(t, *xm.shape[2:]), 0, axis)
+
+
+def striped_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = SP_AXIS, *,
+                      causal: bool = True,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """Striped ring attention — load-balanced causal rings (Brandon et
+    al. 2023, "Striped Attention: Faster Ring Attention for Causal
+    Transformers"; public technique, original implementation).
+
+    Same K/V rotation and online softmax as :func:`ring_attention`, but
+    the sequence is distributed round-robin: local slot ℓ on rank r
+    holds global token ℓ·n + r (:func:`stripe_batch` produces the
+    layout).  With CONTIGUOUS shards, causal masking leaves rank 0
+    almost idle in early ring steps while the last rank computes
+    everything — each step runs at the slowest rank's workload, wasting
+    ~2x FLOPs ring-wide.  With stripes, every (rank, step) pair sees
+    the same near-triangular visible set — strictly-lower ℓq > ℓk plus
+    the diagonal when my >= src — so every step is balanced and the
+    causal ring approaches the 2x theoretical speedup over its
+    unbalanced form.  tests/test_sequence_parallel.py pins both the
+    oracle equivalence and the balance property.
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    tq, tk = q.shape[1], k.shape[1]
+    if causal and tq != tk:
+        raise ValueError("striped causal attention needs equal q/k shards")
+    if not causal:
+        # permutation-invariant: identical to an unmasked ring
+        return _ring_core(q, k, v, axis_name, scale, None, None)
+
+    lq = jnp.arange(tq)
+    lk = jnp.arange(tk)
+
+    def mask(my, src):
+        # global positions: q at ℓq·n + my, k at ℓk·n + src
+        return (lq[:, None] > lk[None, :]) | (
+            (lq[:, None] == lk[None, :]) & (my >= src))
+
+    # No skip predicate (contrast ring_attention): balance is the point —
+    # every block is partially visible by construction.
+    return _ring_core(q, k, v, axis_name, scale, mask, None)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -196,12 +284,22 @@ def sp_mesh_from_comm(comm, n_sp: Optional[int] = None) -> Mesh:
 def resolve_sp_attention(kind: str, *, mesh: Optional[Mesh] = None,
                          axis_name: str = SP_AXIS, **bound) -> Callable:
     """The one attention-kind switch, shared by make_sp_attention and the
-    (dp, sp) train step: "ring", "ring_flash", "ulysses",
+    (dp, sp) train step: "ring", "striped", "ring_flash", "ulysses",
     "ulysses_flash", or "flash" (local kernels; needs sp=1, checked when
     ``mesh`` is given).  ``bound`` kwargs (causal, sm_scale) are bound
-    onto the callable; unbound ones are forwarded by the caller."""
+    onto the callable; unbound ones are forwarded by the caller.
+
+    LAYOUT CONTRACT for "striped": the local shards must hold the
+    round-robin token layout (:func:`stripe_batch`), and positional
+    information (RoPE/embedding ``positions``) must be computed striped —
+    feeding contiguously-sharded data would silently apply a wrong
+    causal mask.  make_sp_attention repermutes around the call for
+    plain-layout callers; make_dp_sp_train_step handles both the batch
+    layout requirement and the positions."""
     if kind == "ring":
         fn = ring_attention
+    elif kind == "striped":
+        fn = striped_attention
     elif kind == "ring_flash":
         from .ring_flash import ring_flash_attention as fn
     elif kind == "ulysses":
@@ -230,15 +328,33 @@ def make_sp_attention(mesh: Mesh, kind: str = "ring", *,
 
     Returns ``attn(q, k, v)`` taking [B, T, H, D] arrays (batch sharded
     over dp, sequence over sp) and returning the same.  ``kind`` is
-    "ring", "ring_flash" (flash block kernels riding the ring,
-    parallel/ring_flash.py), "ulysses", or "ulysses_flash" (flash as
-    the local attention after the head reshard).
+    "ring", "striped" (load-balanced causal ring — tokens are re-striped
+    around the sharded attention here; a training loop that keeps its
+    batch striped end-to-end calls striped_attention inside its own
+    shard_map and skips the two repermutes), "ring_flash" (flash block
+    kernels riding the ring, parallel/ring_flash.py), "ulysses", or
+    "ulysses_flash" (flash as the local attention after the head
+    reshard).
     """
     inner = resolve_sp_attention(kind, mesh=mesh, causal=causal,
                                  sm_scale=sm_scale)
 
     spec = P(DP_AXIS, SP_AXIS, None, None)
-    return jax.shard_map(
+    mapped = jax.shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
+    if kind != "striped" or not causal:
+        # non-causal striping would buy nothing (the load is already
+        # balanced) while paying four global repermutes; the inner
+        # striped_attention already degrades to the unmasked ring, so
+        # plain contiguous sharding is correct and cheaper
+        return mapped
+
+    n = mesh.shape[SP_AXIS]
+
+    def attn(q, k, v):
+        qs, ks, vs = (stripe_batch(x, n) for x in (q, k, v))
+        return unstripe_batch(mapped(qs, ks, vs), n)
+
+    return attn

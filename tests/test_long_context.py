@@ -24,10 +24,14 @@ def setup():
     return cfg, batch, model, params
 
 
-@pytest.mark.parametrize("attention", ["ring", "ulysses"])
+@pytest.mark.parametrize("attention", ["ring", "ulysses", "striped"])
 def test_dp_sp_step_loss_matches_single_device(setup, attention):
     """First-step loss on the (2, 4) mesh equals the unsharded model's
-    loss on the same batch/params (same math, different layout)."""
+    loss on the same batch/params (same math, different layout).  For
+    "striped" the batch rides the round-robin layout end-to-end
+    (shard_lm_batch(striped=True) + striped positions inside the step) —
+    the loss is a sum over tokens, so it is layout-invariant and the
+    same oracle applies."""
     cfg, batch, model, params = setup
     logits = model.apply(params, batch["input_ids"])
     ref_loss = float(lm_loss(logits, batch["labels"]))
@@ -38,7 +42,7 @@ def test_dp_sp_step_loss_matches_single_device(setup, attention):
                                  donate=False)
     p = replicate(mesh, params)
     o = replicate(mesh, tx.init(params))
-    b = shard_lm_batch(mesh, batch)
+    b = shard_lm_batch(mesh, batch, striped=attention == "striped")
     _, _, loss = step(p, o, b)
     np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-3)
 
