@@ -27,6 +27,13 @@ never sees the ppermutes.
 No reference analog (SURVEY.md §5: long-context absent in the
 reference); pinned against ring_attention/full_attention in
 tests/test_ring_flash.py.
+
+Scoping: the striped token layout (sequence.striped_attention — balanced
+causal rings) is implemented for the exact blockwise path only.  It
+composes with this module conceptually (the kernel's causal offset would
+become a per-(my, src) diagonal-ownership rule), but the flash kernels'
+block masks are contiguous-layout today; use kind="striped" for balance
+or kind="ring_flash" for VMEM-resident block math, not both.
 """
 
 from __future__ import annotations
