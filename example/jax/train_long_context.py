@@ -42,7 +42,7 @@ def main():
     ap.add_argument("--sp", type=int, default=None,
                     help="sequence-parallel degree (default: all devices)")
     ap.add_argument("--attention",
-                choices=("ring", "ring_flash", "ulysses",
+                choices=("ring", "striped", "ring_flash", "ulysses",
                          "ulysses_flash"),
                     default="ring")
     ap.add_argument("--tiny", action="store_true")
@@ -63,7 +63,7 @@ def main():
 
     p = replicate(mesh, params)
     o = replicate(mesh, tx.init(params))
-    b = shard_lm_batch(mesh, batch)
+    b = shard_lm_batch(mesh, batch, striped=args.attention == "striped")
 
     p, o, loss = step(p, o, b)  # compile
     jax.block_until_ready(loss)
