@@ -32,6 +32,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .mesh import CommContext, DCN_AXIS, ICI_AXIS
+from ..common import jax_compat as _jax_compat
+from ..fault import injector as _fault
 
 
 def _rank_index(n_ici: int):
@@ -44,7 +46,12 @@ def _cached(comm: CommContext, key, builder):
     # accumulate dead meshes in a module-level cache).
     fn = comm.jit_cache.get(key)
     if fn is None:
-        fn = comm.jit_cache[key] = builder()
+        built = builder()
+        # legacy-runtime serial mode (jax_compat): executions of compiled
+        # programs hold the process lock; identity on modern runtimes.
+        # Scalar cache entries are arrays, not programs — left bare.
+        fn = comm.jit_cache[key] = (
+            _jax_compat.serialize(built) if callable(built) else built)
     return fn
 
 
@@ -277,6 +284,8 @@ def push_pull_array(comm: CommContext, stacked, op: str = "average",
     """The collective behind bps.push_pull: picks the strategy by topology.
     ``local=True``: ``stacked`` is a replicated [n] local contribution
     (see :func:`stage_local_replicated`), engine-internal SUM only."""
+    if _fault.ENABLED:
+        _fault.fire("dcn")
     if hierarchical is None:
         hierarchical = comm.n_dcn > 1
     if local:
@@ -298,6 +307,8 @@ def push_pull_array_scaled(comm: CommContext, stacked, scale: float,
     passed in the *accumulation* dtype of the input (f64 stays f64; every
     other float accumulates in f32), so fusing never costs precision over
     the assembly-time division it replaces."""
+    if _fault.ENABLED:
+        _fault.fire("dcn")
     if hierarchical is None:
         hierarchical = comm.n_dcn > 1
     acc_dtype = (jnp.float64 if stacked.dtype == jnp.float64
@@ -433,6 +444,8 @@ def push_pull_chunk_scatter(comm: CommContext, flat, buf, col_off: int,
     ``col_off`` into the block-sharded accumulator.  ``buf=None`` creates
     the accumulator.  A 1-D ``flat`` is a replicated local contribution
     (:func:`stage_local_replicated`).  Returns (buf, token)."""
+    if _fault.ENABLED:
+        _fault.fire("dcn")
     fn = _chunk_scatter_program(comm, w, k, C, init=buf is None,
                                 local=flat.ndim == 1)
     offa = _cached_scalar(comm, int(col_off), jnp.int32)
@@ -508,6 +521,8 @@ def push_pull_arrays_batched(comm: CommContext, xs, scale=None,
     a list of per-chunk results.  ``scale=None`` keeps the accumulation
     dtype (engine keep_acc semantics); a float fuses sum*scale.  With
     ``local=True`` each x is a replicated [n] contribution."""
+    if _fault.ENABLED:
+        _fault.fire("dcn")
     k = len(xs)
     fn = _batched_all_reduce_fn(comm, k, xs[0].shape, xs[0].dtype,
                                 scale is not None, local)

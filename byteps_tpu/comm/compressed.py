@@ -85,5 +85,8 @@ def compressed_all_reduce(comm: CommContext, stacked,
     key = ("compressed", worker_comp.cache_key(), server_comp.cache_key())
     fn = comm.jit_cache.get(key)
     if fn is None:
-        fn = comm.jit_cache[key] = build()
+        # legacy-runtime serial mode (common/jax_compat.py): no-op wrap
+        # on modern runtimes
+        from ..common import jax_compat
+        fn = comm.jit_cache[key] = jax_compat.serialize(build())
     return fn(stacked, worker_states, server_state)

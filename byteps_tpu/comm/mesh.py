@@ -101,11 +101,23 @@ def bootstrap(cfg: Optional[Config] = None,
             if cfg.coordinator_address is None:
                 raise RuntimeError(
                     "multi-host run needs DMLC_PS_ROOT_URI/PORT (coordinator)")
-            jax.distributed.initialize(
-                coordinator_address=cfg.coordinator_address,
-                num_processes=cfg.num_hosts,
-                process_id=cfg.host_id,
-            )
+
+            def _rendezvous():
+                # idempotence guard: a retry after a partially-completed
+                # attempt must not double-initialize
+                if not jax.distributed.is_initialized():
+                    jax.distributed.initialize(
+                        coordinator_address=cfg.coordinator_address,
+                        num_processes=cfg.num_hosts,
+                        process_id=cfg.host_id,
+                    )
+
+            # rendezvous races launcher fan-out: workers reaching the
+            # coordinator before it listens fail transiently — retried
+            # with full-jitter backoff (BYTEPS_RETRY_* knobs)
+            from ..common.retry import RetryPolicy
+            RetryPolicy.from_config(cfg).call(
+                _rendezvous, describe="jax.distributed.initialize")
         if devices is None:
             devices = jax.devices()
         n_dcn = int(os.environ.get("BYTEPS_DCN_SIZE", "0")) or (

@@ -116,6 +116,30 @@ class Config:
     heartbeat_on: bool = False       # BYTEPS_HEARTBEAT_ON: auto-arm at init
     heartbeat_interval_s: float = 1.0   # BYTEPS_HEARTBEAT_INTERVAL
     heartbeat_timeout_s: float = 30.0   # BYTEPS_HEARTBEAT_TIMEOUT
+    failure_exit_code: int = 17      # BYTEPS_FAILURE_EXIT_CODE: the
+    #                                  detector's "restartable" exit; the
+    #                                  launchers' --restart supervision
+    #                                  treats exactly this code as worth
+    #                                  restarting (a crash exits 1)
+
+    # --- fault injection (fault/injector.py) ---
+    fault_spec: str = ""             # BYTEPS_FAULT_SPEC: chaos schedule
+    #                                  (kill:rank=1:step=40, delay:site=dcn:
+    #                                  p=0.01:ms=200, ...); validated
+    #                                  eagerly at init(); empty = disabled
+    #                                  (zero-overhead fast path)
+    fault_seed: int = 0              # BYTEPS_FAULT_SEED: same spec + seed
+    #                                  => identical injection schedule
+
+    # --- retry/backoff (common/retry.py) ---
+    restart_limit: int = 0           # BYTEPS_RESTART_LIMIT: launcher
+    #                                  restarts per worker (0 = none)
+    retry_max_attempts: int = 3      # BYTEPS_RETRY_MAX_ATTEMPTS
+    retry_base_delay_s: float = 0.1  # BYTEPS_RETRY_BASE_DELAY (seconds;
+    #                                  doubles per attempt, full jitter)
+    retry_max_delay_s: float = 2.0   # BYTEPS_RETRY_MAX_DELAY (backoff cap)
+    retry_deadline_s: float = 60.0   # BYTEPS_RETRY_DEADLINE (total budget
+    #                                  across attempts)
 
     # --- observability ---
     log_level: str = "WARNING"       # BYTEPS_LOG_LEVEL
@@ -135,6 +159,11 @@ class Config:
             self.partition_bytes += ALIGN_BYTES - r
         if self.num_hosts < 1:
             raise ValueError("num_hosts must be >= 1")
+        if not 0 < self.failure_exit_code < 256:
+            raise ValueError("failure_exit_code must be in 1..255 "
+                             "(it travels through a process exit status)")
+        if self.restart_limit < 0:
+            raise ValueError("restart_limit must be >= 0")
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -168,6 +197,14 @@ class Config:
                                             1.0),
             heartbeat_timeout_s=_env_float("BYTEPS_HEARTBEAT_TIMEOUT",
                                            30.0),
+            failure_exit_code=_env_int("BYTEPS_FAILURE_EXIT_CODE", 17),
+            fault_spec=_env_str("BYTEPS_FAULT_SPEC", ""),
+            fault_seed=_env_int("BYTEPS_FAULT_SEED", 0),
+            restart_limit=_env_int("BYTEPS_RESTART_LIMIT", 0),
+            retry_max_attempts=_env_int("BYTEPS_RETRY_MAX_ATTEMPTS", 3),
+            retry_base_delay_s=_env_float("BYTEPS_RETRY_BASE_DELAY", 0.1),
+            retry_max_delay_s=_env_float("BYTEPS_RETRY_MAX_DELAY", 2.0),
+            retry_deadline_s=_env_float("BYTEPS_RETRY_DEADLINE", 60.0),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             trace_on=_env_bool("BYTEPS_TRACE_ON", False),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),

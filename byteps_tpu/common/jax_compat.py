@@ -1,0 +1,141 @@
+"""JAX version-compatibility shims + legacy-runtime serial mode.
+
+The codebase targets the current ``jax.shard_map`` API; older runtimes
+(<= 0.4.x) only ship it as ``jax.experimental.shard_map.shard_map`` with
+the pre-rename ``check_rep`` keyword (today's ``check_vma``).  A
+production fleet never runs one JAX version — the robustness posture is
+to degrade gracefully, not to crash at the first collective.
+
+:func:`install` bridges the gap once per process:
+
+- when ``jax.shard_map`` is missing it publishes an adapter for the
+  experimental entry point that translates the renamed keyword;
+- it additionally flips the process into **legacy serial mode**
+  (:data:`LEGACY_RUNTIME`): the old CPU runtime intermittently
+  deadlocks inside XLA when several Python threads drive executions
+  concurrently (engine dispatcher executing a collective program while
+  a user thread sits in ``block_until_ready`` — reproduced at ~40% per
+  run by ``tests/test_engine.py::test_concurrent_pushes_from_many_
+  threads`` on jax 0.4.37).  The mitigation is two-fold and verified to
+  take the repro to 0/10: CPU executions are made synchronous
+  (``jax_cpu_enable_async_dispatch=False``) and every XLA entry point
+  the engine's threads use — compiled collectives (via
+  :func:`serialize`), ``jax.device_put``, ``jax.block_until_ready``,
+  and the syncer's completion section (via :func:`runtime_lock`) — is
+  funneled through one process-wide re-entrant lock.  Communication/
+  compute overlap is lost, correctness is kept.
+
+On current JAX all of this is a no-op: :data:`LEGACY_RUNTIME` stays
+False, :func:`serialize` returns its argument, and :func:`runtime_lock`
+hands back a null context manager.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+
+LEGACY_RUNTIME = False
+_LOCK = threading.RLock()
+_NULL = contextlib.nullcontext()
+
+
+def runtime_lock():
+    """The XLA serialization lock in legacy mode; a null context
+    otherwise (zero overhead beyond one module-flag check)."""
+    return _LOCK if LEGACY_RUNTIME else _NULL
+
+
+def serialize(fn):
+    """Wrap a compiled function so its executions hold the runtime lock
+    — identity on modern runtimes.  Applied at *cache-fill* time (one
+    decision per program, nothing on the per-call path when modern)."""
+    if not LEGACY_RUNTIME:
+        return fn
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        with _LOCK:
+            return fn(*args, **kwargs)
+
+    return call
+
+
+def _locked(orig):
+    @functools.wraps(orig)
+    def call(*args, **kwargs):
+        with _LOCK:
+            return orig(*args, **kwargs)
+
+    return call
+
+
+def install() -> None:
+    """Idempotently install the shims (called from byteps_tpu/__init__)."""
+    global LEGACY_RUNTIME
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+    except ImportError:  # neither spelling: let call sites raise naturally
+        return
+
+    @functools.wraps(_exp_shard_map)
+    def shard_map(f, **kwargs):
+        # check_vma/check_rep is a purely static replication check with
+        # no numerical effect; the legacy checker's inference is weaker
+        # (it rejects out_specs current JAX proves fine), so it is forced
+        # off rather than translated
+        kwargs.pop("check_vma", None)
+        kwargs["check_rep"] = False
+        # current axis_names= (the axes that ARE manual) is the
+        # complement of the legacy auto= (the axes that are NOT)
+        axis_names = kwargs.pop("axis_names", None)
+        if axis_names is not None:
+            mesh = kwargs.get("mesh")
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        return _exp_shard_map(f, **kwargs)
+
+    jax.shard_map = shard_map
+    if not hasattr(jax.distributed, "is_initialized"):
+        # the legacy surface is just initialize/shutdown; the bootstrap
+        # guard (comm/mesh.py) and retry idempotence need the predicate
+        def is_initialized():
+            try:
+                from jax._src import distributed as _dist
+                return _dist.global_state.client is not None
+            except Exception:  # noqa: BLE001 — conservatively "no"
+                return False
+
+        jax.distributed.is_initialized = is_initialized
+    if not hasattr(jax.lax, "axis_size"):
+        # pre-axis_size spelling: a psum of the literal 1 over the axis
+        # is folded to the (static) axis size at trace time
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+    if not hasattr(jax.lax, "pcast"):
+        # pcast only moves an array between VMA (varying-manual-axes)
+        # types; the legacy runtime has no VMA type system and the
+        # replication checker is disabled above, so value-identity is
+        # the faithful translation
+        def pcast(x, axes=None, to=None, **_kw):
+            return x
+
+        jax.lax.pcast = pcast
+    LEGACY_RUNTIME = True
+    # synchronous CPU execution: an async completion finishing on a
+    # runtime thread is half of the legacy deadlock (the lock below can
+    # only serialize work that runs inline in the calling thread)
+    try:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    except Exception:  # noqa: BLE001 — flag unknown: keep the shim alone
+        pass
+    # serialize the two jax entry points engine/user threads hit outside
+    # the compiled-program cache
+    jax.device_put = _locked(jax.device_put)
+    jax.block_until_ready = _locked(jax.block_until_ready)

@@ -1,8 +1,16 @@
-"""PushPull speed telemetry.
+"""PushPull speed telemetry + process-wide event counters.
 
 Reference: a rolling MB/s gauge updated every 10s, surfaced as
 ``bps.get_pushpull_speed()`` (reference global.cc:697-752,
 common/__init__.py:130-139); off switch BYTEPS_TELEMETRY_ON.
+
+:class:`Counters` is the observability sink for the fault-tolerance
+subsystem: injected faults (``fault.kill`` / ``fault.delay`` /
+``fault.bitflip`` / ``fault.straggler`` / ``fault.drop``), retry
+attempts (``retry.attempt`` / ``retry.gave_up``), and recovery stages
+(``recovery.attempt`` / ``recovery.completed`` / ``recovery.failed``)
+all increment the module singleton :data:`counters`, so a chaos run is
+inspectable after the fact.
 """
 
 from __future__ import annotations
@@ -10,7 +18,34 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Tuple
+from typing import Dict, Tuple
+
+
+class Counters:
+    """Thread-safe named monotonic counters (process-wide singleton below)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._c.clear()
+
+
+counters = Counters()
 
 
 class SpeedMonitor:

@@ -137,6 +137,26 @@ class Tracer:
                 "args": {"key": key, "step": step, "bytes": nbytes},
             })
 
+    def record_span(self, name: str, t_begin: float, t_end: float,
+                    **args) -> None:
+        """One lifecycle span outside the step window (fault/recovery
+        events): unlike :meth:`record`, these are not gated on
+        START/END_STEP — a recovery at step 300 must land in the timeline
+        even when the comm window closed at step 20."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name,
+                "cat": "fault",
+                "ph": "X",
+                "ts": t_begin * 1e6,
+                "dur": max(0.0, (t_end - t_begin) * 1e6),
+                "pid": os.getpid(),
+                "tid": name,
+                "args": dict(args),
+            })
+
     # -- emission -----------------------------------------------------------
     def flush(self, path: Optional[str] = None) -> Optional[str]:
         if self.jax_trace:

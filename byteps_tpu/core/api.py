@@ -45,6 +45,15 @@ def init(config: Optional[Config] = None,
         if config is not None:
             set_config(config)
         cfg = get_config()
+        from ..fault import injector as fault_injector
+        if cfg.fault_spec:
+            # Eager validation: a chaos-spec typo must fail init() with
+            # the valid kind/site lists, not silently inject nothing.
+            # Armed before bootstrap so rendezvous-time sites are live.
+            fault_injector.arm(cfg.fault_spec, seed=cfg.fault_seed,
+                               rank=cfg.host_id)
+        else:
+            fault_injector.disarm()
         comm = mesh_mod.bootstrap(cfg, devices=devices)
         engine = PushPullEngine(comm, cfg)
         if cfg.heartbeat_on and jax.process_count() > 1:
@@ -55,13 +64,25 @@ def init(config: Optional[Config] = None,
             # use), init() raises cleanly and a retry re-runs everything
             # — never a running engine that silently believes liveness
             # is on.
+            from ..common.retry import RetryPolicy
             from ..utils.failure_detector import HeartbeatMonitor
-            try:
-                _heartbeat = HeartbeatMonitor(
+
+            def _arm_heartbeat():
+                # fresh monitor per attempt: a failed bind leaves the old
+                # instance's socket state unusable
+                return HeartbeatMonitor(
                     rank=jax.process_index(),
                     num_ranks=jax.process_count(),
                     interval=cfg.heartbeat_interval_s,
                     timeout=cfg.heartbeat_timeout_s).start()
+
+            try:
+                # the UDP bind races the previous incarnation's socket
+                # teardown after an elastic restart (TIME_WAIT, port still
+                # held) — exactly the transient the backoff layer is for
+                _heartbeat = RetryPolicy.from_config(
+                    cfg, retry_on=(OSError,)).call(
+                        _arm_heartbeat, describe="heartbeat UDP bind")
             except Exception:
                 engine.shutdown(wait=False)
                 mesh_mod.shutdown_comm()
@@ -88,6 +109,10 @@ def shutdown(wait: bool = True) -> None:
         _engine.shutdown(wait=wait)
         _engine = None
         mesh_mod.shutdown_comm()
+        # chaos disarms with the engine; a subsequent init()/resume()
+        # re-arms from config (fresh step counter, same seeded schedule)
+        from ..fault import injector as fault_injector
+        fault_injector.disarm()
 
 
 def suspend() -> None:

@@ -35,6 +35,7 @@ from ..comm.collectives import (_as_stacked, assemble_scatter, pad_stacked,
 from ..comm.compressed import compressed_all_reduce
 from ..comm.mesh import CommContext
 from ..compression import registry as compression_registry
+from ..common import jax_compat
 from ..common.config import Config
 from ..common.handles import Handle, HandleManager
 from ..common.logging import get_logger
@@ -43,6 +44,7 @@ from ..common.scheduler import ChunkScheduler
 from ..common.telemetry import SpeedMonitor
 from ..common.tracing import Tracer
 from ..common.types import ChunkTask, Status, TensorContext
+from ..fault import injector as _fault
 
 
 _SHUTDOWN = object()  # sync-queue sentinel
@@ -283,6 +285,9 @@ class PushPullEngine:
         """
         if not self._running:
             raise RuntimeError("engine is shut down")
+        if _fault.ENABLED:
+            # one "step" per enqueued tensor: kill:step=N counts these
+            _fault.on_step()
         if local:
             if compression:
                 raise ValueError("local fast path excludes compression")
@@ -500,6 +505,9 @@ class PushPullEngine:
                                            timeout=_GET_TASK_TIMEOUT)
             if task is None:
                 continue
+            if _fault.ENABLED:
+                # chaos site "dispatch": delay/straggler stalls issue order
+                _fault.fire("dispatch")
             # Chunk-group batching (reference BYTEPS_NCCL_GROUP_SIZE,
             # nccl_manager.cc:130-134): opportunistically pop whatever else
             # is already eligible, then merge neighbors into the fewest
@@ -616,6 +624,9 @@ class PushPullEngine:
             if item is _SHUTDOWN:
                 break
             tasks, out, rollback, err = item
+            if _fault.ENABLED:
+                # chaos site "sync": delay between completion and callback
+                _fault.fire("sync")
             if err is None:
                 try:
                     # For buffer runs ``out`` is the completion token, not
@@ -628,35 +639,44 @@ class PushPullEngine:
                         slot, wst, sst = rollback
                         slot.wstates = wst
                         slot.sstate = sst
-            for idx, task in enumerate(tasks):
-                # parts-group dispatches carry one output PER task
-                out_t = out[idx] if isinstance(out, list) else out
-                if err is None and not (task.pending is not None
-                                        and task.pending.use_buffer):
-                    self._debug_sample(task, out_t)
-                self.scheduler.report_finish(task.nbytes)
-                if self.tracer.enabled:
-                    t_done = self.tracer.now()
-                    self.tracer.record(task.name, task.key, "queued",
-                                       task.t_enqueue, task.t_dispatch,
-                                       task.step, task.nbytes)
-                    self.tracer.record(task.name, task.key, "push_pull",
-                                       task.t_dispatch, t_done, task.step,
-                                       task.nbytes)
-                if self.cfg.telemetry_on:
-                    # push + pull wire bytes; compressed chunks report
-                    # payload size, which is the point of the feature
-                    wire = (task.compression.worker.payload_nbytes()
-                            if task.compression is not None else task.nbytes)
-                    self.speed.record(wire * 2)
-                if task.callback is not None:
-                    if err is not None:
-                        task.callback(None, Status.error(str(err)))
-                    else:
-                        # Average is applied at assembly granularity: the
-                        # reference divides in the done-callback too
-                        # (torch/__init__.py task callback output.div_(size)).
-                        task.callback(out_t, Status.ok())
+            # Legacy-runtime serial mode (common/jax_compat.py): the
+            # callbacks below run eager assembly ops on this thread while
+            # the dispatcher executes programs on its own — the exact
+            # concurrency the old CPU runtime deadlocks on.  Null context
+            # on modern runtimes.
+            with jax_compat.runtime_lock():
+                self._finish_batch(tasks, out, err)
+
+    def _finish_batch(self, tasks, out, err):
+        for idx, task in enumerate(tasks):
+            # parts-group dispatches carry one output PER task
+            out_t = out[idx] if isinstance(out, list) else out
+            if err is None and not (task.pending is not None
+                                    and task.pending.use_buffer):
+                self._debug_sample(task, out_t)
+            self.scheduler.report_finish(task.nbytes)
+            if self.tracer.enabled:
+                t_done = self.tracer.now()
+                self.tracer.record(task.name, task.key, "queued",
+                                   task.t_enqueue, task.t_dispatch,
+                                   task.step, task.nbytes)
+                self.tracer.record(task.name, task.key, "push_pull",
+                                   task.t_dispatch, t_done, task.step,
+                                   task.nbytes)
+            if self.cfg.telemetry_on:
+                # push + pull wire bytes; compressed chunks report
+                # payload size, which is the point of the feature
+                wire = (task.compression.worker.payload_nbytes()
+                        if task.compression is not None else task.nbytes)
+                self.speed.record(wire * 2)
+            if task.callback is not None:
+                if err is not None:
+                    task.callback(None, Status.error(str(err)))
+                else:
+                    # Average is applied at assembly granularity: the
+                    # reference divides in the done-callback too
+                    # (torch/__init__.py task callback output.div_(size)).
+                    task.callback(out_t, Status.ok())
 
     # ---------------------------------------------------------- lifecycle
     def shutdown(self, wait: bool = True):

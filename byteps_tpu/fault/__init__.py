@@ -1,0 +1,13 @@
+"""Fault tolerance: deterministic chaos injection + supervised recovery.
+
+The reference delegates liveness to ps-lite scheduler heartbeats and
+treats every failure as "restart the role" (SURVEY.md §5).  Here the
+failure path is a *tested code path*: :mod:`injector` plants seeded,
+deterministic faults (kill/delay/bitflip/straggler/drop) at named sites
+across the stack, and :mod:`recovery` turns a detected failure into an
+automated drain → suspend → resume(survivors) → checkpoint-restore
+sequence instead of a bare ``os._exit``.
+"""
+
+from .injector import FaultInjector, arm, disarm  # noqa: F401
+from .recovery import RecoveryCoordinator, RecoveryResult  # noqa: F401

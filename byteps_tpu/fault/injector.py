@@ -1,0 +1,325 @@
+"""Deterministic, seeded fault injection at named sites.
+
+The subsystem exists so the recovery path (detector → suspend → resume →
+restore, :mod:`byteps_tpu.fault.recovery`) can be *proved* to work: a
+chaos run configures ``BYTEPS_FAULT_SPEC`` and the injector fires
+scripted faults at well-known points of the stack.  Adaptive runtimes
+treat degraded/late/lost participants as first-class states (PAPERS:
+arxiv 2105.07829, 2412.14374); this is the harness that manufactures
+those states on demand.
+
+Spec grammar (``BYTEPS_FAULT_SPEC``, ``;``- or ``,``-separated faults)::
+
+    kill:rank=1:step=40            die (os._exit) when this process's
+                                   push_pull counter reaches step 40
+    delay:site=dcn:p=0.01:ms=200   sleep 200ms with prob 0.01 per visit
+    bitflip:site=server_push:p=0.001   flip one random bit of the pushed
+                                   value with prob 0.001
+    straggler:rank=2:ms=50         rank 2 sleeps 50ms at every dispatch
+    drop:site=heartbeat:p=0.2      drop 20% of heartbeat sends
+
+Fields: ``rank`` (int, default: every rank), ``step`` (int, kill only),
+``site`` (one of :data:`VALID_SITES`), ``p`` (probability in (0, 1],
+default 1), ``ms`` (sleep milliseconds), ``code`` (kill exit code,
+default 1 — a *crash*, distinct from the detector's restartable
+``BYTEPS_FAILURE_EXIT_CODE``).
+
+Sites (where the hooks are woven):
+
+- ``dispatch`` / ``sync`` — engine dispatcher pop / syncer completion
+  (core/engine.py)
+- ``dcn``    — collective dispatch (comm/collectives.py)
+- ``server_push`` / ``server_pull`` — ServerEngine entry points
+  (server/engine.py); ``bitflip`` corrupts the pushed value here
+- ``heartbeat`` — the heartbeat client's UDP send
+  (utils/failure_detector.py); ``drop`` suppresses the datagram
+
+Determinism: every rule owns a :class:`random.Random` seeded from
+``(BYTEPS_FAULT_SEED, rule index, kind, site)`` as a *string* — string
+seeding is hash-randomization-free, so the same spec + seed produces the
+identical injection schedule across processes and runs (pinned by
+tests/test_fault_injector.py).
+
+Disabled fast path: when no spec is armed, :data:`ENABLED` is ``False``
+and every woven site is a single module-attribute check — nothing else
+runs, no injector object exists, and the compiled collective programs
+are byte-identical to a build without the hooks (the hooks live host-side,
+never in-graph).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common.logging import get_logger
+from ..common.telemetry import counters
+
+# Module-level fast path: hot call sites guard with `if injector.ENABLED:`
+# — one attribute load + truth test when chaos is off.
+ENABLED = False
+_active: Optional["FaultInjector"] = None
+
+# monkeypatch point for tests (a real os._exit would take pytest with it)
+_exit = os._exit
+
+VALID_KINDS = ("bitflip", "delay", "drop", "kill", "straggler")
+VALID_SITES = ("dcn", "dispatch", "heartbeat", "server_pull",
+               "server_push", "sync")
+# sites where corrupt() is actually woven; a bitflip elsewhere would
+# silently never fire, so validation rejects it
+CORRUPT_SITES = ("server_push",)
+_FIELDS = ("rank", "step", "site", "p", "ms", "code")
+# fields each kind actually reads — anything else is rejected, not
+# silently ignored (kill:p=0.1 must fail loudly, not kill
+# deterministically while the operator believes it is probabilistic)
+_KIND_FIELDS = {
+    "kill": ("rank", "step", "code"),
+    "delay": ("rank", "site", "p", "ms"),
+    "straggler": ("rank", "site", "ms"),
+    "drop": ("rank", "site", "p"),
+    "bitflip": ("rank", "site", "p"),
+}
+
+
+class FaultRule:
+    """One parsed fault clause plus its private deterministic RNG."""
+
+    __slots__ = ("kind", "site", "rank", "step", "p", "ms", "code", "rng")
+
+    def __init__(self, kind: str, site: Optional[str], rank: Optional[int],
+                 step: Optional[int], p: float, ms: float, code: int):
+        self.kind = kind
+        self.site = site
+        self.rank = rank
+        self.step = step
+        self.p = p
+        self.ms = ms
+        self.code = code
+        self.rng: Optional[random.Random] = None  # bound by FaultInjector
+
+    def __repr__(self) -> str:  # actionable in logs and error messages
+        parts = [self.kind]
+        for f in ("site", "rank", "step", "p", "ms"):
+            v = getattr(self, f)
+            if v is not None:
+                parts.append(f"{f}={v}")
+        return ":".join(parts)
+
+
+def _fail(spec: str, clause: str, msg: str) -> ValueError:
+    return ValueError(
+        f"BYTEPS_FAULT_SPEC: bad clause {clause!r} in {spec!r}: {msg}")
+
+
+def parse_spec(spec: str) -> List[FaultRule]:
+    """Parse and *validate* a fault spec; raises ValueError with the list
+    of valid kinds/sites on any unknown token (eager validation is the
+    init()-time contract — a typo must fail the run, not silently inject
+    nothing)."""
+    rules: List[FaultRule] = []
+    for clause in spec.replace(";", ",").split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        kind, _, rest = clause.partition(":")
+        kind = kind.strip()
+        if kind not in VALID_KINDS:
+            raise _fail(spec, clause,
+                        f"unknown fault kind {kind!r}; valid kinds: "
+                        f"{', '.join(VALID_KINDS)}")
+        fields: Dict[str, str] = {}
+        if rest:
+            for item in rest.split(":"):
+                key, sep, val = item.partition("=")
+                key = key.strip()
+                if not sep or key not in _FIELDS:
+                    raise _fail(spec, clause,
+                                f"unknown field {key!r}; valid fields: "
+                                f"{', '.join(_FIELDS)}")
+                if key not in _KIND_FIELDS[kind]:
+                    raise _fail(spec, clause,
+                                f"field {key!r} has no effect on "
+                                f"{kind!r}; {kind} reads: "
+                                f"{', '.join(_KIND_FIELDS[kind])}")
+                fields[key] = val.strip()
+        site = fields.get("site")
+        if site is not None and site not in VALID_SITES:
+            raise _fail(spec, clause,
+                        f"unknown site {site!r}; valid sites: "
+                        f"{', '.join(VALID_SITES)}")
+        try:
+            rank = int(fields["rank"]) if "rank" in fields else None
+            step = int(fields["step"]) if "step" in fields else None
+            p = float(fields.get("p", "1"))
+            ms = float(fields.get("ms", "0"))
+            code = int(fields.get("code", "1"))
+        except ValueError:
+            raise _fail(spec, clause, "rank/step/code must be integers, "
+                                      "p/ms numbers") from None
+        if not 0.0 < p <= 1.0:
+            raise _fail(spec, clause, f"p={p} must be in (0, 1]")
+        # per-kind requirements, checked here so a broken spec fails at
+        # init() with an actionable message instead of never firing
+        if kind == "kill" and step is None:
+            raise _fail(spec, clause, "kill needs step=N (the push_pull "
+                                      "count at which the process dies)")
+        if kind in ("delay", "drop") and site is None:
+            raise _fail(spec, clause,
+                        f"{kind} needs site=S; valid sites: "
+                        f"{', '.join(VALID_SITES)}")
+        if kind == "bitflip":
+            if site is None or site not in CORRUPT_SITES:
+                raise _fail(spec, clause,
+                            "bitflip needs site=S with S in "
+                            f"{', '.join(CORRUPT_SITES)} (the sites where "
+                            "value corruption is woven)")
+        if kind == "straggler":
+            if ms <= 0:
+                raise _fail(spec, clause, "straggler needs ms=N > 0")
+            site = site or "dispatch"
+        rules.append(FaultRule(kind, site, rank, step, p, ms, code))
+    if not rules:
+        raise ValueError(
+            f"BYTEPS_FAULT_SPEC={spec!r} contains no fault clauses")
+    return rules
+
+
+class FaultInjector:
+    """Deterministic fault schedule for one process.
+
+    ``rank`` is the process identity faults match against (the launcher's
+    DMLC_WORKER_ID / config.host_id — a per-process number that exists
+    before any JAX state).  ``seed`` namespaces every rule's RNG; the
+    schedule is a pure function of (spec, seed) and the visit sequence.
+    """
+
+    def __init__(self, spec: str, seed: int = 0, rank: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self.rank = rank
+        self.rules = parse_spec(spec)
+        for i, r in enumerate(self.rules):
+            # string seeding: stable across processes (no hash salt)
+            r.rng = random.Random(f"{seed}/{i}/{r.kind}/{r.site}")
+        self._by_site: Dict[str, List[FaultRule]] = {}
+        for r in self.rules:
+            if r.site is not None:
+                self._by_site.setdefault(r.site, []).append(r)
+        self._kills = [r for r in self.rules if r.kind == "kill"]
+        self._step = 0
+        self._lock = threading.Lock()
+
+    # -- site hooks --------------------------------------------------------
+
+    def on_step(self) -> None:
+        """Advance the step counter (one per push_pull enqueue) and honor
+        any matching kill rule — the simulated hard crash."""
+        with self._lock:
+            self._step += 1
+            step = self._step
+        for r in self._kills:
+            if (r.rank is None or r.rank == self.rank) and step == r.step:
+                counters.inc("fault.kill")
+                get_logger().error(
+                    "fault injector: kill at step %d (rank %d) — exiting %d",
+                    step, self.rank, r.code)
+                _exit(r.code)
+
+    def fire(self, site: str) -> None:
+        """Visit a site: apply delay/straggler sleeps scheduled there."""
+        for r in self._by_site.get(site, ()):
+            if r.kind == "delay":
+                if r.rank is not None and r.rank != self.rank:
+                    continue
+                if r.p >= 1.0 or r.rng.random() < r.p:
+                    counters.inc("fault.delay")
+                    time.sleep(r.ms / 1000.0)
+            elif r.kind == "straggler":
+                if r.rank is None or r.rank == self.rank:
+                    counters.inc("fault.straggler")
+                    time.sleep(r.ms / 1000.0)
+
+    def should_drop(self, site: str) -> bool:
+        """True when a drop rule says to suppress this message."""
+        for r in self._by_site.get(site, ()):
+            if r.kind == "drop" and (r.rank is None or r.rank == self.rank):
+                if r.p >= 1.0 or r.rng.random() < r.p:
+                    counters.inc("fault.drop")
+                    return True
+        return False
+
+    def corrupt(self, site: str, arr):
+        """Return ``arr`` with one random bit flipped when a bitflip rule
+        fires here; otherwise the input, untouched (no copy)."""
+        import numpy as np
+        for r in self._by_site.get(site, ()):
+            if r.kind != "bitflip":
+                continue
+            if r.rank is not None and r.rank != self.rank:
+                continue
+            if r.p < 1.0 and r.rng.random() >= r.p:
+                continue
+            counters.inc("fault.bitflip")
+            a = np.array(arr, copy=True)
+            raw = a.view(np.uint8).reshape(-1)
+            byte = r.rng.randrange(raw.size)
+            raw[byte] ^= np.uint8(1 << r.rng.randrange(8))
+            get_logger().warning(
+                "fault injector: bit flipped at %s (byte %d)", site, byte)
+            return a
+        return arr
+
+    @property
+    def step_count(self) -> int:
+        with self._lock:
+            return self._step
+
+
+# -- module-level arm/disarm (the init()/shutdown() contract) ---------------
+
+
+def arm(spec: str, seed: int = 0, rank: int = 0) -> FaultInjector:
+    """Validate ``spec`` and install the process-wide injector.  Raises
+    ValueError (with the valid kind/site lists) on a malformed spec —
+    called eagerly by ``bps.init()`` so chaos-run typos fail fast."""
+    global ENABLED, _active
+    _active = FaultInjector(spec, seed=seed, rank=rank)
+    ENABLED = True
+    get_logger().warning("fault injection ARMED (rank %d, seed %d): %s",
+                         rank, seed, "; ".join(map(repr, _active.rules)))
+    return _active
+
+
+def disarm() -> None:
+    global ENABLED, _active
+    ENABLED = False
+    _active = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+# Hot-path delegates: sites call these only behind `if injector.ENABLED:`
+# so the disarmed cost is the guard alone.
+
+def on_step() -> None:
+    if _active is not None:
+        _active.on_step()
+
+
+def fire(site: str) -> None:
+    if _active is not None:
+        _active.fire(site)
+
+
+def should_drop(site: str) -> bool:
+    return _active is not None and _active.should_drop(site)
+
+
+def corrupt(site: str, arr):
+    return arr if _active is None else _active.corrupt(site, arr)

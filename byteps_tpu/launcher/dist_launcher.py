@@ -16,7 +16,13 @@ Differences by design:
   side.
 
 Usage:
-    bpslaunch-dist -H hostfile [--port 9100] [--env K:V]... CMD [ARGS...]
+    bpslaunch-dist -H hostfile [--port 9100] [--env K:V]...
+                   [--restart N] CMD [ARGS...]
+
+Supervision: ``--restart N`` (or ``BYTEPS_RESTART_LIMIT``) restarts a
+worker whose exit code equals the failure detector's restartable code
+(``BYTEPS_FAILURE_EXIT_CODE``, default 17) with full-jitter backoff; a
+per-host exit-code summary is printed at the end either way.
 """
 
 from __future__ import annotations
@@ -27,7 +33,12 @@ import shlex
 import subprocess
 import sys
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+import traceback
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..common.config import _env_int
+from ..common.retry import RetryPolicy
 
 # env vars forwarded from the launcher's own environment when set
 _FORWARD_KEYS = ("OMP_NUM_THREADS", "KMP_AFFINITY", "BYTEPS_LOG_LEVEL")
@@ -88,29 +99,125 @@ def ssh_argv(host: str, port: str, env: Dict[str, str], cmd: Sequence[str],
     return argv
 
 
+class LaunchReport(List[int]):
+    """Per-host final exit codes (list) plus supervision detail.
+
+    ``restarts[i]``: restarts consumed by worker i; ``errors[i]``: the
+    launcher-side exception (string traceback) that prevented a clean
+    exit code, or None.  Being a list keeps every existing
+    ``launch(...) == [0, 0, 3]`` caller working unchanged.
+    """
+
+    def __init__(self, codes, restarts, errors):
+        super().__init__(codes)
+        self.restarts: List[int] = restarts
+        self.errors: List[Optional[str]] = errors
+
+
+def format_exit_summary(hosts: List[Tuple[str, str]],
+                        report: "LaunchReport", log_dir: str) -> str:
+    """Human-grade per-host exit summary (what the reference never had:
+    its dist launcher just joined the ssh threads and exited)."""
+    lines = ["worker exit summary:"]
+    for i, (host, _) in enumerate(hosts):
+        code = report[i]
+        if report.errors[i] is not None:
+            status = "launcher error (ssh never completed)"
+        elif code == 0:
+            status = "ok"
+        elif code < 0:
+            status = f"killed by signal {-code}"
+        else:
+            status = f"exit {code}"
+        line = f"  worker{i} [{host}]: {status}"
+        if report.restarts[i]:
+            line += f" after {report.restarts[i]} restart(s)"
+        if report.errors[i] is not None:
+            first = report.errors[i].strip().splitlines()[-1]
+            line += f" — {first} (full traceback in {log_dir}/worker{i}.stderr)"
+        elif code != 0:
+            line += f" (see {log_dir}/worker{i}.stderr)"
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
            coordinator_port: int = 9100,
            extra_env: Optional[Dict[str, str]] = None,
            username: Optional[str] = None,
            log_dir: str = "sshlog",
-           ssh_runner=None) -> List[int]:
+           ssh_runner=None,
+           restart_limit: Optional[int] = None,
+           restartable_codes: Optional[Set[int]] = None,
+           backoff: Optional[RetryPolicy] = None) -> "LaunchReport":
     """Fan the command out to every host; block until all exit.  Returns
-    per-host exit codes.  ``ssh_runner(argv, stdout, stderr) -> int`` is
-    injectable (tests use a local stub instead of real ssh)."""
+    per-host exit codes (a :class:`LaunchReport`).
+    ``ssh_runner(argv, stdout, stderr) -> int`` is injectable (tests use
+    a local stub instead of real ssh).
+
+    Supervision: a worker exiting with a code in ``restartable_codes``
+    (default: the failure detector's ``BYTEPS_FAILURE_EXIT_CODE``, 17) is
+    restarted up to ``restart_limit`` times (default
+    ``BYTEPS_RESTART_LIMIT``) with per-worker full-jitter backoff —
+    detector-triggered exits are *expected* under faults and worth
+    retrying; a crash (exit 1) or signal death is not.  A raised
+    ``ssh_runner`` (connection refused, DNS) is retried by the same
+    policy before counting as a launcher error.
+    """
     os.makedirs(log_dir, exist_ok=True)
     if ssh_runner is None:
         def ssh_runner(argv, stdout, stderr):
             return subprocess.call(argv, stdout=stdout, stderr=stderr)
+    if restart_limit is None:
+        restart_limit = _env_int("BYTEPS_RESTART_LIMIT", 0)
+    if restartable_codes is None:
+        restartable_codes = {_env_int("BYTEPS_FAILURE_EXIT_CODE", 17)}
+    if backoff is None:
+        from ..common.config import Config
+        backoff = RetryPolicy.from_config(Config.from_env())
 
     codes: List[Optional[int]] = [None] * len(hosts)
+    restarts: List[int] = [0] * len(hosts)
+    errors: List[Optional[str]] = [None] * len(hosts)
 
     def run(i: int, host: str, port: str) -> None:
         env = build_env(hosts, i, coordinator_port, extra_env or {})
         argv = ssh_argv(host, port, env, cmd, username)
         base = os.path.join(log_dir, f"worker{i}")
-        with open(base + ".stdout", "wb") as out, \
-                open(base + ".stderr", "wb") as err:
-            codes[i] = ssh_runner(argv, out, err)
+        try:
+            attempt = 0
+            while True:
+                # restarts append — the first incarnation's logs are the
+                # evidence of WHY the restart happened
+                mode = "wb" if attempt == 0 else "ab"
+                with open(base + ".stdout", mode) as out, \
+                        open(base + ".stderr", mode) as err:
+                    codes[i] = backoff.call(
+                        ssh_runner, argv, out, err,
+                        describe=f"ssh dispatch worker{i} [{host}]")
+                if (codes[i] in restartable_codes
+                        and attempt < restart_limit):
+                    attempt += 1
+                    restarts[i] = attempt
+                    delay = backoff.backoff(attempt)
+                    print(f"worker{i} [{host}] exited {codes[i]} "
+                          f"(restartable); restart {attempt}/"
+                          f"{restart_limit} in {delay:.2f}s",
+                          file=sys.stderr)
+                    time.sleep(delay)
+                    continue
+                return
+        except Exception:  # noqa: BLE001 — a dead thread must not map to
+            # a silent exit-1: record the traceback where the operator
+            # will look (the worker's .stderr log) and in the summary
+            tb = traceback.format_exc()
+            errors[i] = tb
+            try:
+                with open(base + ".stderr", "ab") as err:
+                    err.write(b"\n[bpslaunch-dist] launcher-side error:\n")
+                    err.write(tb.encode())
+            except OSError:
+                pass  # the log path itself may be what failed
 
     threads = [threading.Thread(target=run, args=(i, h, p), daemon=True)
                for i, (h, p) in enumerate(hosts)]
@@ -118,7 +225,8 @@ def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
         t.start()
     for t in threads:
         t.join()
-    return [c if c is not None else 1 for c in codes]
+    return LaunchReport([c if c is not None else 1 for c in codes],
+                        restarts, errors)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -137,6 +245,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="KEY:VALUE exported on every host (repeatable)")
     ap.add_argument("--username", default=None, help="ssh username")
     ap.add_argument("--log-dir", default="sshlog")
+    ap.add_argument("--restart", type=int, default=None, metavar="N",
+                    help="restart a worker up to N times when it exits "
+                         "with the restartable failure code "
+                         "(BYTEPS_FAILURE_EXIT_CODE, default 17); "
+                         "default from BYTEPS_RESTART_LIMIT")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run on every host")
     args = ap.parse_args(argv)
@@ -155,11 +268,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
           f"(coordinator {hosts[0][0]}:{args.port})")
     codes = launch(hosts, cmd, coordinator_port=args.port,
                    extra_env=parse_envs(args.env), username=args.username,
-                   log_dir=args.log_dir)
-    for i, c in enumerate(codes):
-        if c != 0:
-            print(f"worker{i} exited with {c} (see "
-                  f"{args.log_dir}/worker{i}.stderr)", file=sys.stderr)
+                   log_dir=args.log_dir, restart_limit=args.restart)
+    print(format_exit_summary(hosts, codes, args.log_dir), file=sys.stderr)
     # signal deaths are negative return codes; max() would mask them
     # behind any worker that exited 0
     return next((abs(c) for c in codes if c != 0), 0)

@@ -9,13 +9,22 @@ execs the command once with topology env prepared, and server/scheduler
 roles are accepted-and-ignored for drop-in compatibility with reference
 launch scripts (they exit 0 with a notice).
 
+Supervision: ``--restart N`` (or ``BYTEPS_RESTART_LIMIT``) re-runs the
+worker with full-jitter backoff when it exits with the failure detector's
+restartable code (``BYTEPS_FAILURE_EXIT_CODE``, default 17) — the outer
+half of the recovery story whose inner half is
+:class:`byteps_tpu.fault.RecoveryCoordinator`.  Any other exit code (a
+real crash, a signal) passes through unretried.
+
 Usage:
-    bpslaunch python train.py ...
+    bpslaunch [--restart N] python train.py ...
 Env (DMLC-compatible, reference docs/env.md:7-45):
     DMLC_ROLE                worker|server|scheduler (default worker)
     DMLC_NUM_WORKER          number of hosts (default 1)
     DMLC_WORKER_ID           this host's index (default 0)
     DMLC_PS_ROOT_URI/PORT    coordinator address for multi-host rendezvous
+    BYTEPS_RESTART_LIMIT     restarts on the restartable exit code
+    BYTEPS_FAILURE_EXIT_CODE the restartable code itself (default 17)
 """
 
 from __future__ import annotations
@@ -23,9 +32,19 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
+from typing import Optional
+
+from ..common.config import _env_int
 
 
-def launch_worker(cmd: list) -> int:
+def _run_once(cmd: list, env: dict) -> int:
+    proc = subprocess.Popen(cmd, env=env)
+    proc.wait()
+    return proc.returncode
+
+
+def launch_worker(cmd: list, restart_limit: Optional[int] = None) -> int:
     env = dict(os.environ)
     # One controller per host: local rank is always 0, local size is the
     # host's chip count (resolved lazily by bps.init()).
@@ -41,13 +60,39 @@ def launch_worker(cmd: list) -> int:
         # the engine's timeline writer never races on mkdir
         trace_dir = env.get("BYTEPS_TRACE_DIR", ".")
         os.makedirs(trace_dir, exist_ok=True)
-    proc = subprocess.Popen(cmd, env=env)
-    proc.wait()
-    return proc.returncode
+    if restart_limit is None:
+        restart_limit = _env_int("BYTEPS_RESTART_LIMIT", 0)
+    restartable = _env_int("BYTEPS_FAILURE_EXIT_CODE", 17)
+    from ..common.retry import RetryPolicy
+    from ..common.config import Config
+    backoff = RetryPolicy.from_config(Config.from_env())
+    attempt = 0
+    while True:
+        rc = _run_once(cmd, env)
+        if rc != restartable or attempt >= restart_limit:
+            if attempt and rc != 0:
+                print(f"bpslaunch: worker still failing (exit {rc}) after "
+                      f"{attempt} restart(s); giving up", file=sys.stderr)
+            return rc
+        attempt += 1
+        delay = backoff.backoff(attempt)
+        print(f"bpslaunch: worker exited {rc} (restartable); restart "
+              f"{attempt}/{restart_limit} in {delay:.2f}s", file=sys.stderr)
+        time.sleep(delay)
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    restart_limit = None
+    # only a LEADING --restart N belongs to bpslaunch; anything after the
+    # command is the command's own business
+    if argv[:1] == ["--restart"]:
+        if len(argv) < 2 or not argv[1].isdigit():
+            print("usage: bpslaunch [--restart N] COMMAND [ARGS...]",
+                  file=sys.stderr)
+            return 2
+        restart_limit = int(argv[1])
+        argv = argv[2:]
     role = os.environ.get("DMLC_ROLE", "worker").lower()
     if role in ("server", "scheduler"):
         # The reference runs `python3 -c 'import byteps.server'` here
@@ -59,9 +104,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 0
     if not argv:
-        print("usage: bpslaunch COMMAND [ARGS...]", file=sys.stderr)
+        print("usage: bpslaunch [--restart N] COMMAND [ARGS...]",
+              file=sys.stderr)
         return 2
-    return launch_worker(argv)
+    return launch_worker(argv, restart_limit=restart_limit)
 
 
 if __name__ == "__main__":

@@ -34,6 +34,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..common.logging import get_logger
+from ..common.retry import RetryPolicy
+from ..fault import injector as _fault
 from ..native import inplace_add
 
 
@@ -45,6 +47,8 @@ class _Msg:
     num_workers: int = 1
     kind: str = "push"  # push | stop
     seq: int = 0        # arrival order, stamped by PriorityQueue.push
+    epoch: int = 0      # key epoch at push time; bumped by reset_key so
+    #                     pre-reset residue in the queues is dropped
 
 
 class PriorityQueue:
@@ -131,7 +135,7 @@ class _Codec:
 
 class _KeyState:
     __slots__ = ("merged", "count", "version", "parked", "lock",
-                 "submitted", "shape", "dtype", "poisoned")
+                 "submitted", "shape", "dtype", "poisoned", "epoch")
 
     def __init__(self):
         self.merged: Optional[np.ndarray] = None
@@ -140,7 +144,8 @@ class _KeyState:
         self.submitted = 0      # pushes enqueued (caller side)
         self.shape = None       # established by the first push (caller side)
         self.dtype = None
-        self.poisoned = False   # terminal: an engine-side merge failed
+        self.poisoned = False   # poisoned until reset_key(): merge failed
+        self.epoch = 0          # bumped by reset_key()
         self.parked: List[Callable[[Optional[np.ndarray]], None]] = []
         self.lock = threading.Lock()
 
@@ -205,6 +210,11 @@ class ServerEngine:
         mismatched push must never reach COPY_FIRST/SUM_RECV on the
         engine thread (where it would poison the round)."""
         arr = np.asarray(value)
+        if _fault.ENABLED:
+            # chaos sites: bitflip corrupts this worker's contribution
+            # (simulated wire corruption); delay stalls the push
+            arr = np.asarray(_fault.corrupt("server_push", arr))
+            _fault.fire("server_push")
         st = self._state(key)
         with st.lock:
             if st.poisoned:
@@ -217,14 +227,29 @@ class ServerEngine:
                     f"push({key!r}): {arr.shape}/{arr.dtype} != "
                     f"established {st.shape}/{st.dtype}")
             st.submitted += 1
+            epoch = st.epoch
         q = self.queues[self.thread_id(key, arr.nbytes)]
-        q.push(_Msg(key=key, value=arr,
-                    worker_id=worker_id, num_workers=num_workers))
+        q.push(_Msg(key=key, value=arr, worker_id=worker_id,
+                    num_workers=num_workers, epoch=epoch))
 
-    def pull(self, key: str, timeout: Optional[float] = None) -> np.ndarray:
+    def pull(self, key: str, timeout: Optional[float] = None,
+             retry: Optional[RetryPolicy] = None) -> np.ndarray:
         """Blocks until the current round's merge completes (parked-pull
-        semantics, server.cc:371-404)."""
-        return self._pull_versioned(key, timeout)[0]
+        semantics, server.cc:371-404).  ``retry`` re-parks a timed-out
+        pull with the policy's backoff/deadline — under chaos-injected
+        delay a merge can land just after a too-tight timeout, and
+        re-parking is cheap while raising tears down the caller."""
+        if _fault.ENABLED:
+            _fault.fire("server_pull")
+        if retry is None:
+            return self._pull_versioned(key, timeout)[0]
+        import dataclasses
+        # only the timeout is transient: a poisoned key raises
+        # RuntimeError and re-parking it would just burn the backoff
+        retry = dataclasses.replace(retry, retry_on=(TimeoutError,))
+        return retry.call(
+            lambda: self._pull_versioned(key, timeout)[0],
+            describe=f"pull({key!r})")
 
     def _pull_versioned(self, key: str, timeout: Optional[float] = None
                         ) -> tuple:
@@ -324,6 +349,33 @@ class ServerEngine:
     def version(self, key: str) -> int:
         return self._state(key).version
 
+    def reset_key(self, key: str) -> None:
+        """Clear a key poisoned by a merge failure so a recovery pass can
+        reuse it (poisoning was terminal by design — a partial round is
+        unrepairable *within* the round; a supervised recovery that
+        re-pushes everything from scratch IS the cross-round accounting).
+
+        Drops the merged buffer, the round counters, and the established
+        shape/dtype (the recovering workers may legitimately re-declare a
+        different geometry); completed-round ``version`` survives so pull
+        caches keyed on it never see a version regress.  Parked pulls
+        from the poisoned era are flushed with the poison error — their
+        callers predate the reset and must re-pull."""
+        st = self._state(key)
+        with st.lock:
+            st.poisoned = False
+            st.merged = None
+            st.count = 0
+            st.submitted = 0
+            st.shape = None
+            st.dtype = None
+            st.epoch += 1   # queued pre-reset messages become droppable
+            parked, st.parked = st.parked, []
+        for fulfill in parked:
+            fulfill(None)
+        get_logger().warning("server engine: key %r reset for recovery",
+                             key)
+
     def shutdown(self) -> None:
         for q in self.queues:
             q.push(_Msg(key="", kind="stop"))
@@ -362,6 +414,11 @@ class ServerEngine:
     def _process(self, msg: _Msg, q: PriorityQueue) -> None:
         st = self._state(msg.key)
         with st.lock:
+            if msg.epoch != st.epoch:
+                # pre-reset residue: reset_key zeroed the round accounting
+                # this message was counted under — merging it would seed
+                # the fresh round with a dead worker's contribution
+                return
             st.submitted -= 1
             if st.poisoned:
                 return  # drop: messages queued before the poison landed

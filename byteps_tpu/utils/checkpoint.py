@@ -26,6 +26,20 @@ import numpy as np
 from ..core import api as _api
 
 
+def _saveable_tree(state: Any):
+    """Coerce scalar leaves (python numbers, numpy generics) to 0-d
+    ndarrays: current orbax accepts scalars, older releases reject them
+    with "Unsupported type" — and a checkpoint layer that dies on
+    ``{"step": 5}`` depending on the storage backend's version is
+    exactly the brittleness the fault-tolerance work removes.  Restore
+    is already scalar-tolerant (see :func:`_abstract_tree`)."""
+    def one(x):
+        if isinstance(x, (bool, int, float, complex, np.generic)):
+            return np.asarray(x)
+        return x
+    return jax.tree.map(one, state)
+
+
 def _abstract_tree(template: Any):
     """ShapeDtypeStruct pytree for orbax restore, accepting arrays and
     plain scalars alike."""
@@ -129,7 +143,7 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True,
         return PendingSave() if asynchronous else False
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.abspath(path), state, force=force)
+    ckptr.save(os.path.abspath(path), _saveable_tree(state), force=force)
     if asynchronous:
         return PendingSave(ckptr, owned=jax.process_index() == 0)
     ckptr.close()  # waits, then releases the worker pool (see PendingSave)
@@ -173,7 +187,8 @@ class CheckpointManager:
         if not _save_collectively() and not _is_root(self.root_rank):
             return False
         import orbax.checkpoint as ocp
-        ok = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        ok = self._mgr.save(step,
+                            args=ocp.args.StandardSave(_saveable_tree(state)))
         if not self.async_save:
             self._mgr.wait_until_finished()
         # async mode: orbax snapshots the arrays before returning, so the
@@ -185,6 +200,14 @@ class CheckpointManager:
     def wait_until_finished(self) -> None:
         """Block until all in-flight async saves are durable."""
         self._mgr.wait_until_finished()
+
+    def reload(self) -> None:
+        """Re-scan the directory for steps this instance didn't write
+        (orbax caches its step list at construction/save time, so a
+        recovery manager reading a trainer's directory — another process
+        or another manager instance — must reload before restore)."""
+        if hasattr(self._mgr, "reload"):
+            self._mgr.reload()
 
     def latest_step(self) -> Optional[int]:
         if self.async_save:

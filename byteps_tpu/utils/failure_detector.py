@@ -20,8 +20,14 @@ Two cooperating pieces:
   that stops replying is itself reported as rank 0 down.
 - :class:`StepWatchdog` — in-process: ``feed()`` every training step; a
   step that exceeds ``timeout`` fires ``on_stall`` (default: log and
-  ``os._exit(17)``) — the escape hatch for the wedged-collective case
-  the heartbeat layer cannot see (process alive, thread stuck).
+  ``os._exit(BYTEPS_FAILURE_EXIT_CODE)``, default 17) — the escape hatch
+  for the wedged-collective case the heartbeat layer cannot see (process
+  alive, thread stuck).
+
+The default ``on_failure``/``on_stall`` exit code is restartable: the
+launchers' ``--restart`` supervision recognizes exactly it.  For
+in-process recovery instead of exit, pass a
+:class:`byteps_tpu.fault.RecoveryCoordinator`'s ``on_failure``.
 
 Both are pure host-side Python (sockets + threads), independent of the
 JAX runtime, so they keep working exactly when the runtime doesn't.
@@ -37,16 +43,29 @@ import time
 from typing import Callable, Optional, Set
 
 from ..common.logging import get_logger
+from ..fault import injector as _fault
 
 _MAGIC = b"bpshb1 "
 
 
+def _failure_exit_code() -> int:
+    """BYTEPS_FAILURE_EXIT_CODE (default 17): the code the launchers'
+    --restart supervision treats as restartable.  Read leniently — the
+    escape hatch must never die on a config error."""
+    try:
+        from ..common.config import get_config
+        return get_config().failure_exit_code
+    except Exception:  # noqa: BLE001
+        return int(os.environ.get("BYTEPS_FAILURE_EXIT_CODE", "17") or 17)
+
+
 def _default_on_failure(stale: Set[int]) -> None:
+    code = _failure_exit_code()
     get_logger().error(
-        "failure detector: rank(s) %s missed heartbeats — exiting so the "
-        "launcher can restart/resume (a wedged collective cannot be "
-        "cancelled in-process)", sorted(stale))
-    os._exit(17)
+        "failure detector: rank(s) %s missed heartbeats — exiting %d so "
+        "the launcher can restart/resume (a wedged collective cannot be "
+        "cancelled in-process)", sorted(stale), code)
+    os._exit(code)
 
 
 class HeartbeatMonitor:
@@ -65,7 +84,7 @@ class HeartbeatMonitor:
         already shares (reference docs/env.md:7-45).
     interval / timeout: beat period and staleness threshold (seconds).
     on_failure: called ONCE with the set of stale ranks; defaults to
-        log + os._exit(17).
+        log + os._exit(BYTEPS_FAILURE_EXIT_CODE) (default 17).
     """
 
     def __init__(self, rank: int, num_ranks: int,
@@ -121,7 +140,11 @@ class HeartbeatMonitor:
     def stop(self) -> None:
         self._stop.set()
         for t in self._threads:
-            t.join(timeout=2)
+            # an on_failure action (RecoveryCoordinator) that suspends the
+            # engine stops this monitor FROM the beat thread — joining
+            # oneself would raise and abort the recovery mid-flight
+            if t is not threading.current_thread():
+                t.join(timeout=2)
         if self._sock is not None:
             self._sock.close()
 
@@ -187,6 +210,11 @@ class HeartbeatMonitor:
         self._last_reply = time.monotonic()
         while not self._stop.is_set():
             try:
+                # chaos site: drop:site=heartbeat:p=... suppresses the
+                # send, simulating a lossy/partitioned control network —
+                # the reply read then times out like a real loss would
+                if _fault.ENABLED and _fault.should_drop("heartbeat"):
+                    raise socket.timeout()
                 sock.sendto(_MAGIC + str(self.rank).encode(), self.addr)
                 data, _ = sock.recvfrom(bufsize)
                 if data.startswith(_MAGIC):
@@ -233,10 +261,11 @@ class StepWatchdog:
 
     @staticmethod
     def _default(gap: float) -> None:
+        code = _failure_exit_code()
         get_logger().error(
-            "step watchdog: no progress for %.1fs — exiting so the "
-            "launcher can restart", gap)
-        os._exit(17)
+            "step watchdog: no progress for %.1fs — exiting %d so the "
+            "launcher can restart", gap, code)
+        os._exit(code)
 
     def start(self) -> "StepWatchdog":
         self._last = time.monotonic()
@@ -249,7 +278,8 @@ class StepWatchdog:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread.is_alive():
+        if (self._thread.is_alive()
+                and self._thread is not threading.current_thread()):
             self._thread.join(timeout=2)
 
     def __enter__(self):

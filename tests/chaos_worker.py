@@ -1,0 +1,135 @@
+"""Worker body for the 2→1 kill-and-recover chaos test.
+
+Launched twice by tests/test_recovery.py (pattern of
+tests/test_failure_detector.py's mp kill test): two real processes, each
+with its own engine on the virtual CPU mesh, share one heartbeat
+endpoint and one checkpoint directory.  The victim (rank 1) is killed
+mid-run by the fault injector (``BYTEPS_FAULT_SPEC=kill:rank=1:step=N``
+— the injector counts push_pull enqueues); the survivor's
+HeartbeatMonitor detects the silence and its RecoveryCoordinator runs
+the full automated path: drain → suspend → resume(num_workers=1) →
+restore from the last CheckpointManager step — then the training loop
+verifies the restored step/state and keeps stepping on the recovered
+engine.
+
+Deliberately NOT a jax.distributed run: the JAX runtime cannot drop a
+dead peer's devices from an initialized backend in-process (the cached
+backend keeps advertising them), so cross-host wedges end in the
+detector's process exit + launcher restart (tested by
+test_failure_detector / the launchers' --restart path).  What this test
+pins is the *supervised recovery machinery itself* — detection wiring,
+drain/suspend, elastic resume on the shrunk worker count, checkpoint
+restore, and post-recovery engine health.
+
+Env (set by the test): BYTEPS_CHAOS_RANK, BYTEPS_CHAOS_HB_PORT,
+BYTEPS_CHAOS_CKPT, plus BYTEPS_FAULT_SPEC for the victim.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    rank = int(os.environ["BYTEPS_CHAOS_RANK"])
+    hb_port = os.environ["BYTEPS_CHAOS_HB_PORT"]
+    ckdir = os.environ["BYTEPS_CHAOS_CKPT"]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import byteps_tpu.core.api as api
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.fault.recovery import RecoveryCoordinator
+    from byteps_tpu.utils.checkpoint import CheckpointManager
+    from byteps_tpu.utils.failure_detector import HeartbeatMonitor
+
+    template = {"w": np.zeros(8, np.float32), "step": np.array(0)}
+    api.init()  # arms the injector from BYTEPS_FAULT_SPEC (victim only)
+    eng = api._require()
+
+    # Two managers over ONE directory: the training loop saves through
+    # its own; the coordinator restores through its own on the detector
+    # thread (orbax finalizes step dirs atomically, so directory-level
+    # concurrency is safe where object-level sharing would not be).
+    mgr = CheckpointManager(ckdir, max_to_keep=3) if rank == 0 else None
+    coordinator = RecoveryCoordinator(
+        checkpoint_manager=(CheckpointManager(ckdir, max_to_keep=3)
+                            if rank == 0 else None),
+        template=template)
+    # Manual monitors, one per process (the auto-armed path needs
+    # jax.process_count() > 1).  Sub-second staleness timeout; generous
+    # grace covers the peer's interpreter/jax startup skew.
+    mon = HeartbeatMonitor(
+        rank, 2, "127.0.0.1:" + hb_port, interval=0.08, timeout=0.7,
+        grace=60.0,
+        on_failure=(coordinator.on_failure if rank == 0 else
+                    lambda stale: None)).start()
+    print("START", rank, flush=True)
+
+    # Each step's push_pull adds exactly 1.0 to every element (single
+    # process: sum over processes == the local ones-contribution), so
+    # the invariant "w == full(step)" makes restored state checkable
+    # against the restored step number.
+    w = np.zeros(8, np.float32)
+    for step in range(1, 400):
+        if coordinator.triggered:
+            break
+        try:
+            # bounded wait, not push_pull's bare wait(): a push racing the
+            # recovery teardown can miss the drain snapshot and would
+            # otherwise park this thread forever on a dead engine
+            h = eng.push_pull_local_async(np.ones(8, np.float32), "grad",
+                                          op="sum")
+            w = w + np.asarray(h.wait(timeout=10))
+        except Exception:  # noqa: BLE001 — engine torn down mid-step
+            if coordinator.triggered:
+                break
+            raise
+        if rank == 0 and not coordinator.triggered:
+            mgr.save(step, {"w": w, "step": np.array(step)})
+        time.sleep(0.1)
+    else:
+        print("NO-FAILURE-DETECTED", flush=True)
+        return 3
+
+    # survivor side: the coordinator (running on the detector thread)
+    # completes suspend -> resume(1) -> restore
+    res = coordinator.wait(timeout=60)
+    if res is None:
+        print("RECOVERY-TIMEOUT", flush=True)
+        return 4
+    assert res.failed_ranks == {1}, res.failed_ranks
+    assert res.num_workers == 1, res.num_workers
+    # training step value preserved: the restored tensors are exactly the
+    # ones saved at the restored step (the w == full(step) invariant)
+    assert res.step is not None and res.step >= 1, res.step
+    assert int(res.state["step"]) == res.step, (res.state["step"], res.step)
+    np.testing.assert_allclose(res.state["w"],
+                               np.full(8, float(res.step)), rtol=1e-6)
+    assert counters.get("recovery.completed") == 1
+
+    # the recovered engine is live: keep training where the ckpt left off
+    eng2 = api._require()
+    assert eng2 is not eng
+    w = np.asarray(res.state["w"])
+    for _ in range(2):
+        out = eng2.push_pull_local(np.ones(8, np.float32), "grad", op="sum")
+        w = w + np.asarray(out)
+    np.testing.assert_allclose(w, np.full(8, float(res.step + 2)),
+                               rtol=1e-6)
+    mon.stop()
+    api.shutdown()
+    print("RECOVERED", res.step, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,41 @@ def test_config_validation():
         Config(partition_bytes=0)
     with pytest.raises(ValueError):
         Config(num_hosts=0)
+    with pytest.raises(ValueError):
+        Config(failure_exit_code=0)     # must survive a process exit status
+    with pytest.raises(ValueError):
+        Config(failure_exit_code=256)
+    with pytest.raises(ValueError):
+        Config(restart_limit=-1)
+
+
+def test_config_fault_tolerance_knobs_from_env(monkeypatch):
+    """Satellite: BYTEPS_FAULT_SPEC / RESTART_LIMIT / FAILURE_EXIT_CODE /
+    retry knobs ride Config.from_env like every other knob."""
+    monkeypatch.setenv("BYTEPS_FAULT_SPEC", "delay:site=dcn:p=0.5:ms=10")
+    monkeypatch.setenv("BYTEPS_FAULT_SEED", "99")
+    monkeypatch.setenv("BYTEPS_RESTART_LIMIT", "4")
+    monkeypatch.setenv("BYTEPS_FAILURE_EXIT_CODE", "42")
+    monkeypatch.setenv("BYTEPS_RETRY_MAX_ATTEMPTS", "6")
+    monkeypatch.setenv("BYTEPS_RETRY_BASE_DELAY", "0.25")
+    monkeypatch.setenv("BYTEPS_RETRY_MAX_DELAY", "3.5")
+    monkeypatch.setenv("BYTEPS_RETRY_DEADLINE", "45")
+    cfg = Config.from_env()
+    assert cfg.fault_spec == "delay:site=dcn:p=0.5:ms=10"
+    assert cfg.fault_seed == 99
+    assert cfg.restart_limit == 4
+    assert cfg.failure_exit_code == 42
+    assert cfg.retry_max_attempts == 6
+    assert cfg.retry_base_delay_s == 0.25
+    assert cfg.retry_max_delay_s == 3.5
+    assert cfg.retry_deadline_s == 45.0
+
+
+def test_config_fault_tolerance_defaults():
+    cfg = Config()
+    assert cfg.fault_spec == ""          # chaos off: zero-overhead path
+    assert cfg.failure_exit_code == 17   # the historical detector exit
+    assert cfg.restart_limit == 0        # supervision is opt-in
 
 
 # --- keys ------------------------------------------------------------------

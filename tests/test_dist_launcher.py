@@ -1,14 +1,22 @@
-"""dist_launcher: hostfile parsing, env construction, ssh fan-out
-(reference launcher/dist_launcher.py — SURVEY.md §2.5).  ssh is stubbed
-with a local runner so the fan-out, env injection, and exit-code paths are
-exercised without a network."""
+"""dist_launcher: hostfile parsing, env construction, ssh fan-out,
+restart supervision, and exit-code surfacing (reference
+launcher/dist_launcher.py — SURVEY.md §2.5).  ssh is stubbed with a
+local runner so the fan-out, env injection, retry/restart, and
+exit-code paths are exercised without a network."""
 
 import os
 import subprocess
+import sys
 
 import pytest
 
+from byteps_tpu.common.retry import RetryPolicy
 from byteps_tpu.launcher import dist_launcher as dl
+from byteps_tpu.launcher import launch as bl
+
+
+def _fast_backoff():
+    return RetryPolicy(max_attempts=2, base_delay_s=0.0, max_delay_s=0.0)
 
 
 def test_parse_hostfile(tmp_path):
@@ -112,6 +120,164 @@ def test_inner_double_dash_survives(tmp_path):
     assert rc == 0
     # leading separator stripped, inner "--" preserved
     assert seen["remote"].endswith("git log -- path")
+
+
+def test_restart_on_restartable_code_only(tmp_path):
+    """A worker exiting with the failure detector's restartable code is
+    restarted with backoff; a crash (exit 1) is not."""
+    hosts = [("h0", "22"), ("h1", "22")]
+    attempts = {"h0": 0, "h1": 0}
+
+    def fake_ssh(argv, stdout, stderr):
+        host = argv[argv.index("-p") + 2]
+        attempts[host] += 1
+        if host == "h0":
+            return 17 if attempts[host] == 1 else 0   # detector exit, once
+        return 1                                       # crash: never retried
+
+    report = dl.launch(hosts, ["x"], log_dir=str(tmp_path / "l"),
+                       ssh_runner=fake_ssh, restart_limit=3,
+                       backoff=_fast_backoff())
+    assert report == [0, 1]
+    assert report.restarts == [1, 0]
+    assert attempts == {"h0": 2, "h1": 1}
+
+
+def test_restart_limit_exhausted_keeps_last_code(tmp_path):
+    hosts = [("h0", "22")]
+    calls = []
+
+    def fake_ssh(argv, stdout, stderr):
+        calls.append(1)
+        stderr.write(b"detector fired\n")
+        return 17
+
+    report = dl.launch(hosts, ["x"], log_dir=str(tmp_path / "l"),
+                       ssh_runner=fake_ssh, restart_limit=2,
+                       backoff=_fast_backoff())
+    assert report == [17] and report.restarts == [2]
+    assert len(calls) == 3
+    # restart logs APPEND: all three incarnations' evidence survives
+    log = (tmp_path / "l" / "worker0.stderr").read_bytes()
+    assert log.count(b"detector fired") == 3
+
+
+def test_custom_failure_exit_code_honored(tmp_path, monkeypatch):
+    monkeypatch.setenv("BYTEPS_FAILURE_EXIT_CODE", "23")
+    monkeypatch.setenv("BYTEPS_RESTART_LIMIT", "1")
+    seen = []
+
+    def fake_ssh(argv, stdout, stderr):
+        seen.append(1)
+        return 23 if len(seen) == 1 else 0
+
+    report = dl.launch([("h0", "22")], ["x"], log_dir=str(tmp_path / "l"),
+                       ssh_runner=fake_ssh, backoff=_fast_backoff())
+    assert report == [0] and report.restarts == [1]
+
+
+def test_ssh_dispatch_retry_on_raised_runner(tmp_path):
+    """A raising ssh_runner (connection refused) is retried by the
+    backoff policy before the launch counts it as a launcher error."""
+    calls = []
+
+    def flaky_ssh(argv, stdout, stderr):
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("connect to host h0: Connection refused")
+        return 0
+
+    report = dl.launch([("h0", "22")], ["x"], log_dir=str(tmp_path / "l"),
+                       ssh_runner=flaky_ssh, backoff=_fast_backoff())
+    assert report == [0] and report.errors == [None]
+    assert len(calls) == 2
+
+
+def test_worker_thread_exception_logged_and_surfaced(tmp_path):
+    """Satellite: an exception raised before ssh_runner returns must not
+    collapse into a silent exit-1 — it lands in the worker's .stderr log
+    and in the exit summary."""
+    hosts = [("h0", "22"), ("h1", "22")]
+
+    def fake_ssh(argv, stdout, stderr):
+        host = argv[argv.index("-p") + 2]
+        if host == "h1":
+            raise ValueError("hostfile entry resolved to garbage")
+        return 0
+
+    report = dl.launch(hosts, ["x"], log_dir=str(tmp_path / "l"),
+                       ssh_runner=fake_ssh,
+                       backoff=RetryPolicy(max_attempts=1,
+                                           base_delay_s=0.0))
+    assert report == [0, 1]           # failed thread still maps to exit 1
+    assert report.errors[0] is None
+    assert "hostfile entry resolved to garbage" in report.errors[1]
+    log = (tmp_path / "l" / "worker1.stderr").read_text()
+    assert "launcher-side error" in log and "ValueError" in log
+    summary = dl.format_exit_summary(hosts, report, str(tmp_path / "l"))
+    assert "worker1 [h1]: launcher error" in summary
+    assert "ValueError" in summary and "worker1.stderr" in summary
+
+
+def test_exit_summary_formats_all_outcomes(tmp_path):
+    hosts = [("a", "22"), ("b", "22"), ("c", "22")]
+    report = dl.LaunchReport([0, -9, 17], [0, 0, 2], [None, None, None])
+    s = dl.format_exit_summary(hosts, report, "sshlog")
+    assert "worker0 [a]: ok" in s
+    assert "worker1 [b]: killed by signal 9" in s
+    assert "worker2 [c]: exit 17 after 2 restart(s)" in s
+
+
+def test_main_prints_exit_summary(tmp_path, monkeypatch, capsys):
+    hf = tmp_path / "hosts"
+    hf.write_text("h0\nh1\n")
+
+    def fake_ssh(argv, stdout, stderr):
+        host = argv[argv.index("-p") + 2]
+        return 0 if host == "h0" else 5
+
+    monkeypatch.setattr(subprocess, "call",
+                        lambda argv, **kw: fake_ssh(argv, None, None))
+    rc = dl.main(["-H", str(hf), "--log-dir", str(tmp_path / "l"),
+                  "--restart", "0", "--", "true"])
+    assert rc == 5
+    err = capsys.readouterr().err
+    assert "worker exit summary:" in err
+    assert "worker0 [h0]: ok" in err
+    assert "worker1 [h1]: exit 5" in err
+
+
+# --- bpslaunch (single-host launcher) supervision ---------------------------
+
+
+def test_bpslaunch_restarts_on_failure_code(tmp_path, monkeypatch):
+    """bpslaunch --restart N re-runs the worker while it exits with the
+    restartable code; the sentinel file makes the second run clean."""
+    monkeypatch.setenv("BYTEPS_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("BYTEPS_RETRY_MAX_DELAY", "0.001")
+    sentinel = tmp_path / "came_back"
+    code = (f"import os, sys; p = {str(sentinel)!r}\n"
+            "if not os.path.exists(p):\n"
+            "    open(p, 'w').close(); sys.exit(17)\n"
+            "sys.exit(0)\n")
+    rc = bl.main(["--restart", "1", sys.executable, "-c", code])
+    assert rc == 0 and sentinel.exists()
+
+
+def test_bpslaunch_does_not_restart_crashes(tmp_path, monkeypatch):
+    monkeypatch.setenv("BYTEPS_RETRY_BASE_DELAY", "0.001")
+    runs = tmp_path / "runs"
+    code = (f"import sys; f = open({str(runs)!r}, 'a'); f.write('x'); "
+            "f.close(); sys.exit(3)")
+    rc = bl.main(["--restart", "5", sys.executable, "-c", code])
+    assert rc == 3
+    assert runs.read_text() == "x"  # exactly one run: 3 is not restartable
+
+
+def test_bpslaunch_restart_flag_parsing():
+    assert bl.main(["--restart"]) == 2          # missing N
+    assert bl.main(["--restart", "nope"]) == 2  # non-numeric N
+    assert bl.main([]) == 2                     # no command at all
 
 
 def test_main_end_to_end_with_local_sh(tmp_path, monkeypatch):

@@ -7,6 +7,8 @@ import sys
 import pytest
 import runpy
 
+from .conftest import legacy_skip
+
 
 def _run(path, *argv):
     old = sys.argv
@@ -35,9 +37,11 @@ def _run(path, *argv):
      ("--mode", "zero", "--steps", "2", "--batch", "8", "--seq", "16")),
     ("example/jax/train_parallel_axes.py",
      ("--mode", "fsdp", "--steps", "2", "--batch", "8", "--seq", "16")),
-    ("example/jax/train_parallel_axes.py",
-     ("--mode", "3d", "--steps", "2", "--batch", "8", "--seq", "16",
-      "--microbatches", "2")),
+    pytest.param(
+        "example/jax/train_parallel_axes.py",
+        ("--mode", "3d", "--steps", "2", "--batch", "8", "--seq", "16",
+         "--microbatches", "2"),
+        marks=legacy_skip),  # 3d composite diverges on pre-VMA shard_map
     ("example/jax/train_long_context.py",
      ("--steps", "2", "--seq", "128", "--sp", "4", "--tiny",
       "--batch", "4")),

@@ -1,0 +1,263 @@
+"""Fault injector: spec grammar, eager validation, deterministic
+schedules, site behavior, and the zero-overhead disabled fast path
+(fault/injector.py — the chaos half of the fault-tolerance subsystem)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.telemetry import counters
+from byteps_tpu.fault import injector as inj_mod
+from byteps_tpu.fault.injector import (CORRUPT_SITES, FaultInjector,
+                                       VALID_KINDS, VALID_SITES, parse_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with chaos off (module-global state)."""
+    inj_mod.disarm()
+    yield
+    inj_mod.disarm()
+
+
+# --- grammar / validation ---------------------------------------------------
+
+
+def test_parse_full_grammar():
+    rules = parse_spec("kill:rank=1:step=40; delay:site=dcn:p=0.01:ms=200,"
+                       "bitflip:site=server_push:p=0.001;"
+                       "straggler:rank=2:ms=50;drop:site=heartbeat:p=0.2")
+    kinds = [r.kind for r in rules]
+    assert kinds == ["kill", "delay", "bitflip", "straggler", "drop"]
+    assert rules[0].rank == 1 and rules[0].step == 40
+    assert rules[1].site == "dcn" and rules[1].ms == 200.0
+    assert rules[3].site == "dispatch"  # straggler default site
+    assert rules[4].p == 0.2
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ("explode:site=dcn", "valid kinds"),
+    ("delay:site=mars:p=1", "valid sites"),
+    ("delay:ms=5", "needs site"),
+    ("drop:p=0.5", "needs site"),
+    ("kill:rank=1", "needs step"),
+    ("bitflip:site=dcn:p=1", "bitflip needs site"),
+    ("straggler:rank=0", "ms=N > 0"),
+    ("delay:site=dcn:p=2", "must be in (0, 1]"),
+    ("delay:site=dcn:frequency=2", "unknown field"),
+    ("kill:rank=1:step=40:p=0.1", "no effect on 'kill'"),
+    ("delay:site=dcn:step=10:ms=5", "no effect on 'delay'"),
+    ("drop:site=heartbeat:ms=5", "no effect on 'drop'"),
+    ("kill:rank=x:step=3", "must be integers"),
+    ("  ; , ", "no fault clauses"),
+])
+def test_validation_is_actionable(bad, needle):
+    with pytest.raises(ValueError) as ei:
+        parse_spec(bad)
+    assert needle in str(ei.value)
+
+
+def test_error_lists_every_valid_kind_and_site():
+    with pytest.raises(ValueError) as ei:
+        parse_spec("bogus")
+    for k in VALID_KINDS:
+        assert k in str(ei.value)
+    with pytest.raises(ValueError) as ei:
+        parse_spec("delay:site=bogus")
+    for s in VALID_SITES:
+        assert s in str(ei.value)
+
+
+# --- determinism ------------------------------------------------------------
+
+
+SPEC = ("drop:site=heartbeat:p=0.5;delay:site=dcn:p=0.3:ms=0;"
+        "bitflip:site=server_push:p=1")
+
+
+def _schedule(inj: FaultInjector, n: int = 200):
+    drops = [inj.should_drop("heartbeat") for _ in range(n)]
+    base = np.zeros(16, np.float32)
+    flips = [np.asarray(inj.corrupt("server_push", base)).tobytes()
+             for _ in range(8)]
+    return drops, flips
+
+
+def test_same_spec_and_seed_identical_schedule():
+    a = _schedule(FaultInjector(SPEC, seed=11, rank=0))
+    b = _schedule(FaultInjector(SPEC, seed=11, rank=0))
+    assert a == b
+
+
+def test_different_seed_different_schedule():
+    a = _schedule(FaultInjector(SPEC, seed=11, rank=0))
+    b = _schedule(FaultInjector(SPEC, seed=12, rank=0))
+    assert a != b
+
+
+def test_schedule_identical_across_two_runs():
+    """The acceptance pin: two fresh interpreter runs, same spec + seed,
+    byte-identical schedule (string seeding is hash-salt-free)."""
+    code = (
+        "from byteps_tpu.fault.injector import FaultInjector\n"
+        f"inj = FaultInjector({SPEC!r}, seed=7, rank=0)\n"
+        "print([inj.should_drop('heartbeat') for _ in range(100)])\n"
+    )
+    outs = set()
+    for seed in ("1", "2"):  # different PYTHONHASHSEED on purpose
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={**os.environ, "PYTHONHASHSEED": seed, "PYTHONPATH": REPO},
+            check=True)
+        outs.add(r.stdout)
+    assert len(outs) == 1
+    assert "True" in outs.pop()  # p=0.5 over 100 draws: some must fire
+
+
+# --- site behavior ----------------------------------------------------------
+
+
+def test_kill_fires_at_exact_step(monkeypatch):
+    exits = []
+    monkeypatch.setattr(inj_mod, "_exit", exits.append)
+    inj = FaultInjector("kill:rank=0:step=3:code=9", rank=0)
+    for _ in range(2):
+        inj.on_step()
+    assert not exits
+    inj.on_step()
+    assert exits == [9]
+
+
+def test_kill_other_rank_never_fires(monkeypatch):
+    exits = []
+    monkeypatch.setattr(inj_mod, "_exit", exits.append)
+    inj = FaultInjector("kill:rank=1:step=2", rank=0)
+    for _ in range(10):
+        inj.on_step()
+    assert not exits and inj.step_count == 10
+
+
+def test_delay_and_straggler_sleep(monkeypatch):
+    slept = []
+    monkeypatch.setattr(inj_mod.time, "sleep", slept.append)
+    inj = FaultInjector("delay:site=dcn:p=1:ms=200;straggler:rank=0:ms=50",
+                        rank=0)
+    inj.fire("dcn")
+    assert slept == [0.2]
+    inj.fire("dispatch")
+    assert slept == [0.2, 0.05]
+    # straggler targets rank 0 only: a rank-1 injector never stalls
+    inj1 = FaultInjector("straggler:rank=0:ms=50", rank=1)
+    inj1.fire("dispatch")
+    assert slept == [0.2, 0.05]
+
+
+def test_bitflip_flips_exactly_one_bit():
+    inj = FaultInjector("bitflip:site=server_push:p=1", seed=5, rank=0)
+    x = np.arange(32, dtype=np.float32)
+    y = inj.corrupt("server_push", x)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    np.testing.assert_array_equal(x, np.arange(32, dtype=np.float32))  # copy
+    diff = np.bitwise_xor(x.view(np.uint8), y.view(np.uint8))
+    assert int(np.unpackbits(diff).sum()) == 1
+    # corruption is woven only where corrupt() is called
+    assert set(CORRUPT_SITES) <= set(VALID_SITES)
+
+
+def test_drop_rate_roughly_matches_p():
+    inj = FaultInjector("drop:site=heartbeat:p=0.25", seed=3, rank=0)
+    n = sum(inj.should_drop("heartbeat") for _ in range(1000))
+    assert 150 < n < 350  # deterministic given the seed; sanity band
+
+
+# --- disabled fast path -----------------------------------------------------
+
+
+def test_module_fast_path_disabled_by_default():
+    assert inj_mod.ENABLED is False
+    assert inj_mod.active() is None
+    # delegates are no-ops, not errors, even when called unguarded
+    inj_mod.on_step()
+    inj_mod.fire("dcn")
+    assert inj_mod.should_drop("heartbeat") is False
+    x = np.ones(4)
+    assert inj_mod.corrupt("server_push", x) is x
+
+
+def test_arm_disarm_cycle():
+    inj_mod.arm("delay:site=dcn:p=1:ms=0", seed=1, rank=0)
+    assert inj_mod.ENABLED and inj_mod.active() is not None
+    inj_mod.disarm()
+    assert not inj_mod.ENABLED and inj_mod.active() is None
+
+
+# --- engine integration -----------------------------------------------------
+
+
+def test_init_validates_spec_eagerly_and_leaves_nothing_half_up():
+    import byteps_tpu.core.api as api
+    from byteps_tpu.common.config import Config
+    with pytest.raises(ValueError) as ei:
+        api.init(Config(fault_spec="delay:site=nowhere:p=1"))
+    assert "valid sites" in str(ei.value)
+    assert not api.initialized()
+    assert not inj_mod.ENABLED
+
+
+def test_engine_run_under_delay_injection_and_counters():
+    import byteps_tpu as bps
+    import byteps_tpu.core.api as api
+    from byteps_tpu.common.config import Config
+    counters.reset()
+    api.init(Config(fault_spec="delay:site=dcn:p=1:ms=1", fault_seed=7))
+    try:
+        assert inj_mod.ENABLED
+        x = np.ones((bps.size(), 64), np.float32)
+        out = bps.push_pull(x, "chaos.delay")
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+        assert counters.get("fault.delay") >= 1
+        assert inj_mod.active().step_count == 1
+    finally:
+        bps.shutdown()
+    # shutdown disarms: the next clean init pays only the ENABLED check
+    assert not inj_mod.ENABLED
+
+
+def test_heartbeat_drop_site_detected_as_loss():
+    """drop:site=heartbeat:p=1 starves the coordinator of beats: a
+    non-root rank must conclude the coordinator is unreachable — the
+    woven send-site is what makes the loss real."""
+    import threading
+    import time
+    from byteps_tpu.utils.failure_detector import HeartbeatMonitor
+    from .conftest import free_port
+
+    counters.reset()
+    inj_mod.arm("drop:site=heartbeat:p=1", rank=1)
+    fired = []
+    done = threading.Event()
+    port = free_port()
+    m0 = HeartbeatMonitor(0, 2, f"127.0.0.1:{port}", interval=0.05,
+                          timeout=10.0, grace=10.0,
+                          on_failure=lambda s: None)
+    m1 = HeartbeatMonitor(1, 2, f"127.0.0.1:{port}", interval=0.05,
+                          timeout=0.5, grace=0.5,
+                          on_failure=lambda s: (fired.append(s), done.set()))
+    m0.start()
+    m1.start()
+    try:
+        assert done.wait(5.0), "dropped heartbeats were not detected"
+        assert fired == [{0}]
+        assert counters.get("fault.drop") > 0
+    finally:
+        inj_mod.disarm()
+        m1.stop()
+        m0.stop()
+        time.sleep(0.05)

@@ -14,7 +14,11 @@ from byteps_tpu.parallel import (make_dp_sp_train_step, make_sp_mesh,
 from byteps_tpu.parallel.long_context import replicate
 
 
+
+pytestmark = pytest.mark.slow  # multi-device attention integration: minutes of XLA compile on small CPU hosts (tier-1 budget)
 @pytest.fixture(scope="module")
+
+
 def setup():
     cfg = gpt_tiny()
     rng = jax.random.PRNGKey(0)

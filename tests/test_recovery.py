@@ -1,0 +1,219 @@
+"""Supervised recovery (fault/recovery.py): the detector-to-resumed-engine
+path, elastic key-order preservation, escalation, and the real 2→1
+kill-and-recover chaos run (chaos_worker.py)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import byteps_tpu.core.api as api
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.telemetry import counters
+from byteps_tpu.fault import injector as inj_mod
+from byteps_tpu.fault import recovery as rec_mod
+from byteps_tpu.fault.recovery import RecoveryCoordinator
+
+from .conftest import free_port as _free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_api():
+    inj_mod.disarm()
+    yield
+    if api.initialized():
+        api.shutdown()
+    # suspend() snapshots declared-tensor order into module state so
+    # resume can re-declare; between tests it is pollution
+    api._declared_order = []
+    inj_mod.disarm()
+
+
+def _template():
+    return {"w": np.zeros(8, np.float32), "step": np.array(0)}
+
+
+@pytest.mark.chaos
+def test_recovery_coordinator_full_flow(tmp_path):
+    """Detection action → drain/suspend → resume → restore, in-process:
+    the engine is replaced, tensor keys survive in declaration order, and
+    the restored state is the last checkpoint."""
+    from byteps_tpu.utils.checkpoint import CheckpointManager
+
+    counters.reset()
+    api.init(Config())
+    eng = api._require()
+    for name in ("a", "b", "c"):
+        eng.push_pull(np.ones((eng.comm.num_ranks, 16), np.float32), name)
+    keys_before = [(n, eng.registry.get(n).declared_key)
+                   for n in eng.registry.names_in_declaration_order()]
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    w = np.arange(8, dtype=np.float32)
+    mgr.save(5, {"w": w, "step": np.array(5)})
+
+    rc = RecoveryCoordinator(checkpoint_manager=mgr, template=_template())
+    res = rc.recover({1})
+
+    assert res.failed_ranks == {1} and res.num_workers >= 1
+    assert res.step == 5
+    np.testing.assert_allclose(res.state["w"], w)
+    assert rc.done() and rc.wait(0) is res
+    eng2 = api._require()
+    assert eng2 is not eng
+    keys_after = [(n, eng2.registry.get(n).declared_key)
+                  for n in eng2.registry.names_in_declaration_order()]
+    assert keys_after == keys_before
+    # the resumed engine is live
+    out = eng2.push_pull(np.ones((eng2.comm.num_ranks, 16), np.float32),
+                         "a")
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    assert counters.get("recovery.attempt") == 1
+    assert counters.get("recovery.completed") == 1
+
+
+@pytest.mark.chaos
+def test_recovery_is_idempotent_across_concurrent_detections(tmp_path):
+    """Two detections (e.g. heartbeat + watchdog) run ONE recovery; the
+    second caller gets the first result."""
+    api.init(Config())
+    rc = RecoveryCoordinator(template=_template())
+    r1 = rc.recover({1})
+    r2 = rc.recover({2})     # late duplicate detection
+    assert r2 is r1
+    assert counters.get("recovery.attempt") >= 1
+
+
+def test_suspend_resume_shrink_preserves_key_order():
+    """Satellite: suspend() → resume(num_workers=k-1) re-declares tensors
+    in original declaration order with identical keys (previously pinned
+    only by a docstring)."""
+    api.init(Config())
+    eng = api._require()
+    names = ["t.out", "t.mid", "t.in", "t.embed"]
+    for n in names:
+        eng.push_pull(np.ones((eng.comm.num_ranks, 8), np.float32), n)
+    before = [(n, eng.registry.get(n).declared_key)
+              for n in eng.registry.names_in_declaration_order()]
+    assert [n for n, _ in before] == names  # declaration order, not sorted
+
+    api.suspend()
+    assert not api.initialized()
+    api.resume(num_workers=1)
+
+    eng2 = api._require()
+    after = [(n, eng2.registry.get(n).declared_key)
+             for n in eng2.registry.names_in_declaration_order()]
+    assert after == before
+    # a fresh tensor keys AFTER the re-declared block, like the reference
+    eng2.push_pull(np.ones((eng2.comm.num_ranks, 8), np.float32), "t.new")
+    assert eng2.registry.get("t.new").declared_key == len(names)
+
+
+@pytest.mark.chaos
+def test_failed_recovery_escalates_to_restartable_exit(monkeypatch):
+    """When in-process recovery itself dies, on_failure falls back to the
+    configurable restartable exit so the launcher supervision takes
+    over."""
+    monkeypatch.setenv("BYTEPS_FAILURE_EXIT_CODE", "23")
+    exits = []
+    monkeypatch.setattr(rec_mod, "_exit", exits.append)
+
+    class BrokenManager:
+        def restore_latest(self, template):
+            raise IOError("checkpoint store unreachable")
+
+    rc = RecoveryCoordinator(checkpoint_manager=BrokenManager(),
+                             template=_template())
+    rc.on_failure({1})
+    assert exits == [23]
+    assert counters.get("recovery.failed") >= 1
+
+
+@pytest.mark.chaos
+def test_failed_recovery_releases_waiters_and_escalates(monkeypatch):
+    """A recovery that dies must not wedge later detections: the first
+    caller sees the original error, later callers raise promptly (and
+    their on_failure escalation path still runs)."""
+    class BrokenManager:
+        def restore_latest(self, template):
+            raise IOError("checkpoint store unreachable")
+
+    rc = RecoveryCoordinator(checkpoint_manager=BrokenManager(),
+                             template=_template())
+    with pytest.raises(IOError):
+        rc.recover({1})
+    with pytest.raises(RuntimeError, match="failed"):
+        rc.recover({2})         # must raise, not block forever
+    assert rc.done() and rc.wait(0) is None
+
+
+@pytest.mark.chaos
+def test_on_recovered_callback_error_does_not_kill_survivor(monkeypatch):
+    """A broken user callback after a SUCCESSFUL recovery logs; it must
+    not reach on_failure's escalation exit."""
+    exits = []
+    monkeypatch.setattr(rec_mod, "_exit", exits.append)
+
+    def bad_callback(result):
+        raise ValueError("user callback bug")
+
+    rc = RecoveryCoordinator(template=_template(),
+                             on_recovered=bad_callback)
+    rc.on_failure({1})
+    assert exits == []          # healthy survivor stays up
+    assert rc.wait(0) is not None
+
+
+@pytest.mark.chaos
+def test_kill_and_recover_two_process(tmp_path):
+    """The acceptance pin: two real processes; BYTEPS_FAULT_SPEC kills
+    rank 1 at push step 3; rank 0's detector fires within its sub-second
+    staleness timeout and the RecoveryCoordinator completes suspend →
+    resume(1 worker) → checkpoint restore with the training step value
+    preserved — no hang, no restartable exit."""
+    port = str(_free_port())
+    ckdir = str(tmp_path / "ckpts")
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["DMLC_NUM_WORKER"] = "1"       # single-host engines; the 2-ness
+        env["DMLC_WORKER_ID"] = str(rank)  # lives in the heartbeat layer
+        env["BYTEPS_CHAOS_RANK"] = str(rank)
+        env["BYTEPS_CHAOS_HB_PORT"] = port
+        env["BYTEPS_CHAOS_CKPT"] = ckdir
+        env["BYTEPS_LOG_LEVEL"] = "ERROR"
+        if rank == 1:
+            env["BYTEPS_FAULT_SPEC"] = "kill:rank=1:step=3"
+            env["BYTEPS_FAULT_SEED"] = "7"
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "chaos_worker.py")],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = ["", ""]
+    try:
+        # victim first (it dies early); survivor needs detection+recovery
+        outs[1], _ = procs[1].communicate(timeout=120)
+        outs[0], _ = procs[0].communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("chaos workers hung (recovery did not complete); "
+                    "partial output: " + "".join(o[-1500:] for o in outs))
+    # the victim really was killed by the injector (exit code 1, no
+    # restartable 17: a kill is a crash)
+    assert procs[1].returncode == 1, outs[1][-3000:]
+    assert "START 1" in outs[1]
+    assert "RECOVERED" not in outs[1]
+    # the survivor detected, recovered, verified the restored step, and
+    # kept training on the resumed engine
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert "RECOVERED" in outs[0], outs[0][-3000:]

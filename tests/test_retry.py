@@ -1,0 +1,141 @@
+"""Retry/backoff layer (common/retry.py) + fault-tolerance counters
+(common/telemetry.py Counters)."""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from byteps_tpu.common.retry import RetryPolicy
+from byteps_tpu.common.telemetry import Counters, counters
+
+
+def _policy(**kw):
+    kw.setdefault("base_delay_s", 0.0)
+    kw.setdefault("max_delay_s", 0.0)
+    return RetryPolicy(**kw)
+
+
+def test_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert _policy(max_attempts=5).call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_attempt_budget_exhausted_reraises_last():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ValueError(f"attempt {len(calls)}")
+
+    with pytest.raises(ValueError, match="attempt 3"):
+        _policy(max_attempts=3).call(always)
+    assert len(calls) == 3
+
+
+def test_non_matching_exception_propagates_immediately():
+    calls = []
+
+    def wrong_kind():
+        calls.append(1)
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):
+        _policy(max_attempts=5, retry_on=(OSError,)).call(wrong_kind)
+    assert len(calls) == 1
+
+
+def test_deadline_cuts_attempt_budget_short():
+    calls = []
+
+    def slow_fail():
+        calls.append(1)
+        time.sleep(0.05)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        _policy(max_attempts=50, deadline_s=0.01).call(slow_fail)
+    assert len(calls) == 1  # elapsed >= deadline after the first attempt
+
+
+def test_full_jitter_bounded_and_seeded():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.8,
+                    rng=random.Random(42))
+    q = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.8,
+                    rng=random.Random(42))
+    for attempt in range(1, 8):
+        cap = min(0.8, 0.1 * 2 ** (attempt - 1))
+        d = p.backoff(attempt)
+        assert 0.0 <= d <= cap
+        assert d == q.backoff(attempt)  # same seed, same schedule
+
+
+def test_sleep_injectable_and_called_between_attempts():
+    slept = []
+
+    def flaky():
+        if len(slept) < 2:
+            raise OSError("x")
+        return 1
+
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.25, max_delay_s=0.25,
+                    rng=random.Random(0), sleep=slept.append)
+    assert p.call(flaky) == 1
+    assert len(slept) == 2 and all(0.0 <= s <= 0.25 for s in slept)
+
+
+def test_from_config_reads_env_knobs(monkeypatch):
+    from byteps_tpu.common.config import Config
+    monkeypatch.setenv("BYTEPS_RETRY_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("BYTEPS_RETRY_BASE_DELAY", "0.5")
+    monkeypatch.setenv("BYTEPS_RETRY_MAX_DELAY", "9")
+    monkeypatch.setenv("BYTEPS_RETRY_DEADLINE", "123")
+    p = RetryPolicy.from_config(Config.from_env(), retry_on=(OSError,))
+    assert p.max_attempts == 7
+    assert p.base_delay_s == 0.5
+    assert p.max_delay_s == 9.0
+    assert p.deadline_s == 123.0
+    assert p.retry_on == (OSError,)
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(base_delay_s=-1)
+
+
+def test_retry_counters_flow():
+    counters.reset()
+
+    def flaky(state=[]):  # noqa: B006
+        state.append(1)
+        if len(state) < 2:
+            raise OSError("x")
+
+    _policy(max_attempts=3).call(flaky)
+    assert counters.get("retry.attempt") == 1
+    with pytest.raises(OSError):
+        _policy(max_attempts=2).call(
+            lambda: (_ for _ in ()).throw(OSError("y")))
+    assert counters.get("retry.gave_up") == 1
+
+
+def test_counters_unit():
+    c = Counters()
+    c.inc("a")
+    c.inc("a", 2)
+    assert c.get("a") == 3 and c.get("missing") == 0
+    assert c.snapshot() == {"a": 3}
+    c.reset()
+    assert c.snapshot() == {}

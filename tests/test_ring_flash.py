@@ -17,6 +17,10 @@ from byteps_tpu.parallel.ring_flash import ring_flash_attention
 from byteps_tpu.parallel.sequence import DP_AXIS, SP_AXIS
 
 
+
+
+
+pytestmark = pytest.mark.slow  # multi-device attention integration: minutes of XLA compile on small CPU hosts (tier-1 budget)
 def _qkv(b, t, h, d, dtype=jnp.float32, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
     return tuple(jax.random.normal(k, (b, t, h, d), jnp.float32
@@ -74,6 +78,7 @@ def test_ragged_t_and_d():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # long-context training loop: tier-1 budget
 def test_long_context_ring_flash_training():
     """attention='ring_flash' trains the (dp, sp) GPT step and matches
     the plain-ring trajectory."""

@@ -37,6 +37,7 @@ def test_sp_attention_matches_full(kind, causal):
 
 
 @pytest.mark.parametrize("kind", ["ring", "ulysses", "striped"])
+@pytest.mark.slow  # heaviest grads-match pair: tier-1 budget on small CPU hosts
 def test_sp_attention_grads_match(kind):
     q, k, v = _qkv(jax.random.PRNGKey(1), t=16, h=8, d=4)
     mesh = make_sp_mesh(n_sp=4)

@@ -166,6 +166,133 @@ def test_engine_merge_failure_poisons_key_not_thread(monkeypatch):
         eng.shutdown()
 
 
+def _poison(eng, monkeypatch, eng_mod, key="bad"):
+    """Poison ``key`` via one injected merge failure (the engine-thread
+    path the chaos bitflip also exercises)."""
+    calls = {"n": 0}
+    real = eng_mod.inplace_add
+
+    def flaky(dst, src, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected merge failure")
+        return real(dst, src, *a, **kw)
+
+    monkeypatch.setattr(eng_mod, "inplace_add", flaky)
+    eng.push(key, np.ones(2), worker_id=0, num_workers=2)
+    eng.push(key, np.ones(2), worker_id=1, num_workers=2)  # fails
+    with pytest.raises(RuntimeError):
+        eng.pull(key, timeout=5)
+
+
+@pytest.mark.chaos
+def test_reset_key_restores_service_after_poison(monkeypatch):
+    """Satellite: a recovery pass clears a poisoned key with reset_key()
+    and push/pull works again — poisoning is no longer terminal."""
+    import byteps_tpu.server.engine as eng_mod
+
+    eng = ServerEngine(num_threads=1)
+    try:
+        _poison(eng, monkeypatch, eng_mod)
+        with pytest.raises(RuntimeError):
+            eng.push("bad", np.ones(2), worker_id=0, num_workers=2)
+
+        eng.reset_key("bad")
+        # the key serves full rounds again — and with a fresh geometry,
+        # since reset also clears the established shape/dtype
+        for r in range(2):
+            eng.push("bad", np.full(3, 2.0), worker_id=r, num_workers=2)
+        np.testing.assert_allclose(eng.pull("bad", timeout=5), 4.0)
+        assert eng.version("bad") >= 1
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.chaos
+def test_reset_key_fails_parked_pulls_and_drops_stale_pushes():
+    """A pull parked on a round that reset_key sweeps away belongs to the
+    dead era: it fails loudly (never silently re-parks into the fresh
+    epoch), and the half-round's push cannot leak into the next round."""
+    import threading
+
+    eng = ServerEngine(num_threads=1)
+    try:
+        eng.push("k", np.full(2, 9.0), worker_id=0, num_workers=2)
+        res = {}
+
+        def parked():
+            try:
+                eng.pull("k", timeout=5)
+            except RuntimeError as e:
+                res["err"] = str(e)
+
+        t = threading.Thread(target=parked)
+        t.start()
+        time.sleep(0.2)           # pull is parked: 1/2 pushes in
+        eng.reset_key("k")
+        t.join(5)
+        assert "poisoned while this pull was parked" in res["err"]
+        # fresh epoch: a full round merges cleanly, the pre-reset 9.0
+        # contribution is gone
+        for r in range(2):
+            eng.push("k", np.ones(2), worker_id=r, num_workers=2)
+        np.testing.assert_allclose(eng.pull("k", timeout=5), 2.0)
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.chaos
+def test_fault_injected_bitflip_poison_then_reset_recovers(monkeypatch):
+    """End-to-end chaos loop on the server path: a bitflip-corrupted push
+    merges into a wrong sum (detected by value), and reset_key gives the
+    recovery pass a clean slate."""
+    from byteps_tpu.fault import injector as inj_mod
+
+    inj_mod.arm("bitflip:site=server_push:p=1", seed=5, rank=0)
+    eng = ServerEngine(num_threads=1)
+    try:
+        for r in range(2):
+            eng.push("k", np.ones(4, np.float32), worker_id=r,
+                     num_workers=2)
+        corrupted = eng.pull("k", timeout=5)
+        assert not np.allclose(corrupted, 2.0)  # the flip really landed
+        inj_mod.disarm()
+        eng.reset_key("k")
+        for r in range(2):
+            eng.push("k", np.ones(4, np.float32), worker_id=r,
+                     num_workers=2)
+        np.testing.assert_allclose(eng.pull("k", timeout=5), 2.0)
+    finally:
+        inj_mod.disarm()
+        eng.shutdown()
+
+
+def test_pull_retry_survives_transient_timeout():
+    """RetryPolicy on pull: the first wait times out (round incomplete),
+    the straggler lands during the backoff, the retried pull succeeds."""
+    import threading
+    import time as _time
+    from byteps_tpu.common.retry import RetryPolicy
+
+    eng = ServerEngine(num_threads=1)
+    try:
+        eng.push("r", np.ones(2), worker_id=0, num_workers=2)
+
+        def straggler():
+            _time.sleep(0.4)
+            eng.push("r", np.ones(2), worker_id=1, num_workers=2)
+
+        t = threading.Thread(target=straggler)
+        t.start()
+        out = eng.pull("r", timeout=0.15,
+                       retry=RetryPolicy(max_attempts=10, base_delay_s=0.05,
+                                         max_delay_s=0.1))
+        t.join(5)
+        np.testing.assert_allclose(out, 2.0)
+    finally:
+        eng.shutdown()
+
+
 def test_built_in_hash_deterministic_across_processes():
     """hash_built_in must not depend on Python's salted hash()."""
     import os
