@@ -37,4 +37,5 @@ from byteps_tpu.core.api import (  # noqa: F401
     synchronize,
     declare,
     get_pushpull_speed,
+    membership_epoch,
 )

@@ -46,6 +46,9 @@ class CommContext:
     # Compiled collective cache; lives and dies with this context so elastic
     # shutdown/resume cycles don't accumulate executables for dead meshes.
     jit_cache: dict = dataclasses.field(default_factory=dict)
+    # Membership epoch this mesh was built under (fault/membership.py):
+    # engine pendings stamped with another epoch never dispatch into it.
+    membership_epoch: int = 0
 
     @property
     def num_ranks(self) -> int:
@@ -122,11 +125,13 @@ def bootstrap(cfg: Optional[Config] = None,
             devices = jax.devices()
         n_dcn = int(os.environ.get("BYTEPS_DCN_SIZE", "0")) or (
             jax.process_count() if jax.process_count() > 1 else 1)
+        from ..fault import membership as _membership
         _comm = CommContext(mesh=_build_mesh(devices, n_dcn), n_dcn=n_dcn,
-                            n_ici=len(devices) // n_dcn)
+                            n_ici=len(devices) // n_dcn,
+                            membership_epoch=_membership.current_epoch())
         get_logger().info(
-            "mesh up: %d device(s) as (dcn=%d, ici=%d)",
-            len(devices), _comm.n_dcn, _comm.n_ici)
+            "mesh up: %d device(s) as (dcn=%d, ici=%d, epoch=%d)",
+            len(devices), _comm.n_dcn, _comm.n_ici, _comm.membership_epoch)
         return _comm
 
 
@@ -143,4 +148,11 @@ def comm_initialized() -> bool:
 def shutdown_comm() -> None:
     global _comm
     with _lock:
+        if _comm is not None:
+            # Dead-mesh executable cleanup: compiled collectives hold
+            # device buffers and executables for a mesh that is going
+            # away; clearing eagerly (instead of waiting for GC of the
+            # context) keeps an elastic shrink/rejoin cycle from holding
+            # two meshes' worth of executables at once.
+            _comm.jit_cache.clear()
         _comm = None

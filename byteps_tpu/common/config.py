@@ -110,6 +110,12 @@ class Config:
     server_enable_schedule: bool = False  # BYTEPS_SERVER_ENABLE_SCHEDULE
     server_debug_key: str = ""       # BYTEPS_SERVER_DEBUG_KEY
     key_hash_fn: str = "djb2"        # BYTEPS_KEY_HASH_FN
+    enable_mixed_mode: bool = False  # BYTEPS_ENABLE_MIXED_MODE: split key
+    #                                  space between non-colocated and
+    #                                  colocated servers (ServerAssigner,
+    #                                  reference global.cc:566-596)
+    mixed_mode_bound: int = 101      # BYTEPS_MIXED_MODE_BOUND (must be
+    #                                  >= the server count)
     debug_sample_tensor: str = ""    # BYTEPS_DEBUG_SAMPLE_TENSOR substring
 
     # --- failure detection (utils/failure_detector.py) ---
@@ -121,6 +127,25 @@ class Config:
     #                                  launchers' --restart supervision
     #                                  treats exactly this code as worth
     #                                  restarting (a crash exits 1)
+
+    # --- elastic membership (fault/membership.py) ---
+    elastic: bool = False            # BYTEPS_ELASTIC: elastic-membership
+    #                                  mode — survivors shrink in place and
+    #                                  the launcher restarts only the dead
+    #                                  rank (with BYTEPS_ELASTIC_REJOIN=1)
+    membership_port: int = 0         # BYTEPS_MEMBERSHIP_PORT: membership
+    #                                  bus TCP port on the coordinator host
+    #                                  (0 = DMLC_PS_ROOT_PORT + 2)
+    membership_rendezvous_timeout_s: float = 10.0
+    #                                  BYTEPS_MEMBERSHIP_RENDEZVOUS_TIMEOUT:
+    #                                  how long the shrink rendezvous waits
+    #                                  for every proposed survivor before
+    #                                  dropping non-responders (the
+    #                                  double-failure window)
+    membership_sync_timeout_s: float = 60.0
+    #                                  BYTEPS_MEMBERSHIP_SYNC_TIMEOUT: step
+    #                                  barrier quorum window; a member
+    #                                  missing past it is failure evidence
 
     # --- fault injection (fault/injector.py) ---
     fault_spec: str = ""             # BYTEPS_FAULT_SPEC: chaos schedule
@@ -160,10 +185,26 @@ class Config:
         if self.num_hosts < 1:
             raise ValueError("num_hosts must be >= 1")
         if not 0 < self.failure_exit_code < 256:
-            raise ValueError("failure_exit_code must be in 1..255 "
-                             "(it travels through a process exit status)")
+            raise ValueError(
+                f"failure_exit_code {self.failure_exit_code} is not "
+                "restartable: it must survive a process exit status "
+                "(1..255)")
+        if self.failure_exit_code == 1:
+            # 1 is the generic Python-crash code: supervision could not
+            # tell a detector-requested restart from an ordinary crash,
+            # so the "restartable" contract would silently break
+            raise ValueError(
+                "failure_exit_code 1 is not restartable: it is "
+                "indistinguishable from a generic crash to the "
+                "launcher's --restart supervision; pick a code in "
+                "2..255")
         if self.restart_limit < 0:
             raise ValueError("restart_limit must be >= 0")
+        if (self.membership_rendezvous_timeout_s <= 0
+                or self.membership_sync_timeout_s <= 0):
+            raise ValueError("membership timeouts must be positive")
+        if not 0 <= self.membership_port < 65536:
+            raise ValueError("membership_port must be in 0..65535")
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -191,7 +232,15 @@ class Config:
                                              False),
             server_debug_key=_env_str("BYTEPS_SERVER_DEBUG_KEY", ""),
             key_hash_fn=_env_str("BYTEPS_KEY_HASH_FN", "djb2"),
+            enable_mixed_mode=_env_bool("BYTEPS_ENABLE_MIXED_MODE", False),
+            mixed_mode_bound=_env_int("BYTEPS_MIXED_MODE_BOUND", 101),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            elastic=_env_bool("BYTEPS_ELASTIC", False),
+            membership_port=_env_int("BYTEPS_MEMBERSHIP_PORT", 0),
+            membership_rendezvous_timeout_s=_env_float(
+                "BYTEPS_MEMBERSHIP_RENDEZVOUS_TIMEOUT", 10.0),
+            membership_sync_timeout_s=_env_float(
+                "BYTEPS_MEMBERSHIP_SYNC_TIMEOUT", 60.0),
             heartbeat_on=_env_bool("BYTEPS_HEARTBEAT_ON", False),
             heartbeat_interval_s=_env_float("BYTEPS_HEARTBEAT_INTERVAL",
                                             1.0),

@@ -7,10 +7,14 @@ common/__init__.py:130-139); off switch BYTEPS_TELEMETRY_ON.
 :class:`Counters` is the observability sink for the fault-tolerance
 subsystem: injected faults (``fault.kill`` / ``fault.delay`` /
 ``fault.bitflip`` / ``fault.straggler`` / ``fault.drop``), retry
-attempts (``retry.attempt`` / ``retry.gave_up``), and recovery stages
-(``recovery.attempt`` / ``recovery.completed`` / ``recovery.failed``)
-all increment the module singleton :data:`counters`, so a chaos run is
-inspectable after the fact.
+attempts (``retry.attempt`` / ``retry.gave_up``), recovery stages
+(``recovery.attempt`` / ``recovery.completed`` / ``recovery.failed``),
+and elastic-membership transitions (``membership.shrink_started`` /
+``shrink_agreed`` / ``shrink`` / ``grow`` / ``rejoin_requested`` /
+``rejoin_admitted`` / ``rejoined`` / ``shrink_failed`` plus the epoch
+guards ``membership.stale_chunks_dropped`` /
+``membership.stale_pushes_dropped``) all increment the module singleton
+:data:`counters`, so a chaos run is inspectable after the fact.
 """
 
 from __future__ import annotations

@@ -115,10 +115,21 @@ def shutdown(wait: bool = True) -> None:
         fault_injector.disarm()
 
 
+def membership_epoch() -> int:
+    """The current elastic-membership epoch (fault/membership.py): 0 for
+    the static world every non-elastic run lives in; advanced by each
+    shrink/rejoin.  Work stamped with a dead epoch is dropped, not
+    delivered."""
+    from ..fault import membership as _membership
+    return _membership.current_epoch()
+
+
 def suspend() -> None:
     """Elastic-training pause: drain and stop (reference byteps_suspend,
     operations.cc:96-105).  Declared tensor order is retained so resume()
-    reproduces identical key assignment."""
+    reproduces identical key assignment.  Under elastic membership this
+    is the drain half of a shrink/rejoin transition
+    (fault/membership.py)."""
     global _declared_order
     eng = _require()
     _declared_order = eng.registry.names_in_declaration_order()

@@ -41,13 +41,26 @@ from ..common.handles import Handle, HandleManager
 from ..common.logging import get_logger
 from ..common.registry import TensorRegistry
 from ..common.scheduler import ChunkScheduler
-from ..common.telemetry import SpeedMonitor
+from ..common.telemetry import SpeedMonitor, counters
 from ..common.tracing import Tracer
-from ..common.types import ChunkTask, Status, TensorContext
+from ..common.types import ChunkTask, Status, StatusCode, TensorContext
 from ..fault import injector as _fault
+from ..fault import membership as _membership
 
 
 _SHUTDOWN = object()  # sync-queue sentinel
+
+
+class StaleEpochError(RuntimeError):
+    """A chunk from a dead membership epoch was dropped (not delivered):
+    the world changed between enqueue and completion."""
+
+
+def _stale_epoch_error(task, epoch: int) -> StaleEpochError:
+    return StaleEpochError(
+        f"stale membership epoch: chunk {task.name!r} key={task.key} was "
+        f"enqueued at epoch {task.pending.mepoch}, the world is now at "
+        f"epoch {epoch}; chunk dropped, re-push under the new epoch")
 
 # One blocking-pop quantum: the dispatcher re-checks its run/pause flags
 # at least this often, and pause_dispatch() sizes its settle wait from it.
@@ -177,6 +190,11 @@ class _PendingTensor:
         self.buf = None          # dispatcher-owned until completion
         self.comm = comm
         self.scale = scale       # fused scale, applied by assemble
+        # membership epoch at enqueue: a world change (fault/membership)
+        # advances the global epoch and every chunk still carrying the
+        # old one is dropped, not delivered — the whole-world analog of
+        # ServerEngine.reset_key's per-key epoch
+        self.mepoch = _membership.current_epoch()
         self._done = 0
         self.lock = threading.Lock()
 
@@ -532,6 +550,25 @@ class PushPullEngine:
                 if t2 is None:
                     break
                 batch.append(t2)
+            # Membership-epoch guard: chunks enqueued before a world
+            # change (elastic shrink/rejoin, fault/membership.py) must
+            # not be issued into a mesh that no longer exists — they are
+            # dropped here with an ABORTED status so waiters unblock and
+            # the caller re-pushes under the new epoch.
+            ep = _membership.current_epoch()
+            if any(t.pending is not None and t.pending.mepoch != ep
+                   for t in batch):
+                fresh = []
+                for t in batch:
+                    if t.pending is not None and t.pending.mepoch != ep:
+                        counters.inc("membership.stale_chunks_dropped")
+                        self._sync_q.put(([t], None, None,
+                                          _stale_epoch_error(t, ep)))
+                    else:
+                        fresh.append(t)
+                batch = fresh
+                if not batch:
+                    continue
             for kind, unit in _plan_batch(batch, pow2_runs=drain):
                 if kind == "run":
                     self._dispatch_buffer_run(unit)
@@ -648,11 +685,20 @@ class PushPullEngine:
                 self._finish_batch(tasks, out, err)
 
     def _finish_batch(self, tasks, out, err):
+        ep = _membership.current_epoch()
         for idx, task in enumerate(tasks):
             # parts-group dispatches carry one output PER task
             out_t = out[idx] if isinstance(out, list) else out
-            if err is None and not (task.pending is not None
-                                    and task.pending.use_buffer):
+            err_t = err
+            if (err_t is None and task.pending is not None
+                    and task.pending.mepoch != ep):
+                # issued before a world change, completed after: the
+                # result was computed over a mesh that no longer exists
+                # — drop it (credits still return below)
+                counters.inc("membership.stale_chunks_dropped")
+                err_t = _stale_epoch_error(task, ep)
+            if err_t is None and not (task.pending is not None
+                                      and task.pending.use_buffer):
                 self._debug_sample(task, out_t)
             self.scheduler.report_finish(task.nbytes)
             if self.tracer.enabled:
@@ -670,8 +716,13 @@ class PushPullEngine:
                         if task.compression is not None else task.nbytes)
                 self.speed.record(wire * 2)
             if task.callback is not None:
-                if err is not None:
-                    task.callback(None, Status.error(str(err)))
+                if err_t is not None:
+                    # stale-epoch drops carry ABORTED (a recognizable,
+                    # retryable outcome); real failures stay errors
+                    task.callback(None,
+                                  Status(StatusCode.ABORTED, str(err_t))
+                                  if isinstance(err_t, StaleEpochError)
+                                  else Status.error(str(err_t)))
                 else:
                     # Average is applied at assembly granularity: the
                     # reference divides in the done-callback too

@@ -6,8 +6,14 @@ failure path is a *tested code path*: :mod:`injector` plants seeded,
 deterministic faults (kill/delay/bitflip/straggler/drop) at named sites
 across the stack, and :mod:`recovery` turns a detected failure into an
 automated drain → suspend → resume(survivors) → checkpoint-restore
-sequence instead of a bare ``os._exit``.
+sequence instead of a bare ``os._exit``.  :mod:`membership` goes one
+step further: a monotonic membership epoch stamps every dispatch and
+server push, survivors shrink to the agreed survivor world in place
+(no process exit), and a restarted rank rejoins at a step boundary
+with epoch/keys/parameters handed over by a survivor.
 """
 
 from .injector import FaultInjector, arm, disarm  # noqa: F401
+from .membership import (ElasticMembership, Evicted,  # noqa: F401
+                         MembershipTimeout, MembershipView, WorldChanged)
 from .recovery import RecoveryCoordinator, RecoveryResult  # noqa: F401

@@ -1,0 +1,872 @@
+"""Elastic membership: shrink-to-survivors and in-place rejoin.
+
+The reference lets a recovered worker rejoin a running job in place
+(``is_recovery``, reference global.cc:291-294, server.cc:486-489) but
+offers no survivor-side story: a dead peer means the whole job restarts.
+PR 2 built the ingredients — suspend/resume (core/api.py), the
+``RecoveryCoordinator`` drain→restore flow, chaos injection, launcher
+supervision.  This module composes them into a real membership layer:
+
+- **Membership epoch** — a process-wide monotonic counter
+  (:func:`current_epoch`).  Every engine dispatch stamps its pending
+  tensor with the epoch at enqueue (core/engine.py) and every
+  ServerEngine/KVStore push may carry one; work stamped with a dead
+  epoch is *dropped, not summed* — the same residue-vs-fresh-round
+  discipline as ``ServerEngine.reset_key``, applied to the whole world.
+- **Shrink-to-survivors** — on heartbeat loss every survivor's
+  ``on_failure`` runs :meth:`ElasticMembership.shrink`: advance the
+  epoch (stale guard up), drain + ``suspend()``, agree on the new world
+  through an epoch-tagged rendezvous on the membership bus, then
+  ``resume()`` at the smaller size — re-declared keys in original
+  order, re-sharded ``ServerAssigner``, training continues from
+  in-memory state with **no process exit**.
+- **In-place rejoin** — a restarted rank calls
+  :meth:`ElasticMembership.rejoin`; it parks on the bus until the
+  survivors complete a step-boundary sync, then receives the agreed
+  epoch, the declared-key order, and the parameters packed by a
+  survivor (``utils.checkpoint.pack_state`` — the wire form of the
+  broadcast-after-restore contract) and resumes as a full member.
+
+The **membership bus** is a tiny TCP control-plane endpoint hosted by
+the lowest-ranked live member (the *membership coordinator*).  It
+serves three verbs: ``sync`` (per-step barrier + small payload
+all-gather, the vehicle for both failure evidence and join admission),
+``hello`` (the shrink rendezvous), and ``rejoin``.  Clients reach it
+with :class:`common.retry.RetryPolicy` full-jitter backoff, so a bus
+that moves to a new coordinator mid-shrink is a transient, not an
+error.  Control-plane only: gradients ride the XLA collectives; the
+bus carries membership state, step digests, and the (rare) rejoin
+parameter transfer.
+
+Double failure during a shrink: the rendezvous waits
+``membership_rendezvous_timeout_s`` for every proposed survivor; a
+member that never checks in (it died after the first detection) is
+dropped from the agreed world and the shrink completes without it.  A
+member that finds itself outside the agreed world raises
+:class:`Evicted` — under ``bpslaunch-dist --elastic`` it exits
+restartable and comes back through the rejoin path.
+
+Single-host note: the bus address is fixed (``BYTEPS_MEMBERSHIP_PORT``,
+default coordinator port + 2), so coordinator failover — the next
+lowest rank re-binding the same address — works wherever the survivors
+share that address (the CPU chaos tests, single-host multi-process
+runs).  A multi-host deployment keeps the bus on a supervised host
+(worker 0 under launcher ``--elastic`` restart) exactly as the DMLC
+root already must be.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from ..common.logging import get_logger
+from ..common.retry import RetryPolicy
+from ..common.telemetry import counters
+
+__all__ = [
+    "MembershipView", "ElasticMembership", "WorldChanged", "Evicted",
+    "MembershipTimeout", "current_epoch", "advance_epoch", "set_epoch",
+]
+
+
+# -- the process-wide membership epoch --------------------------------------
+#
+# One integer, monotonic, shared by every layer that stamps or checks
+# work: engine pendings (core/engine.py), server pushes
+# (server/engine.py, server/kv_store.py), and the bus protocol below.
+# Epoch 0 is the static world every non-elastic run lives in forever.
+
+_epoch = 0
+_epoch_lock = threading.Lock()
+
+
+def current_epoch() -> int:
+    """The membership epoch this process currently lives in."""
+    return _epoch
+
+
+def advance_epoch() -> int:
+    """Bump the epoch by one (stale guards trip immediately)."""
+    global _epoch
+    with _epoch_lock:
+        _epoch += 1
+        return _epoch
+
+
+def set_epoch(epoch: int) -> int:
+    """Raise the epoch to ``epoch`` (monotonic: never regresses)."""
+    global _epoch
+    with _epoch_lock:
+        if epoch > _epoch:
+            _epoch = epoch
+        return _epoch
+
+
+def _reset_epoch_for_tests() -> None:
+    global _epoch
+    with _epoch_lock:
+        _epoch = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipView:
+    """One agreed (epoch, world) pair; world is a sorted rank tuple."""
+
+    epoch: int
+    world: Tuple[int, ...]
+
+    @property
+    def num_workers(self) -> int:
+        return len(self.world)
+
+    @property
+    def coordinator(self) -> int:
+        return min(self.world)
+
+
+class WorldChanged(RuntimeError):
+    """The world moved under a step_sync; retry the step at the new
+    epoch (the local engine has already been re-initialized)."""
+
+    def __init__(self, view: MembershipView):
+        super().__init__(f"membership changed: epoch {view.epoch}, "
+                         f"world {list(view.world)}")
+        self.view = view
+
+
+class Evicted(RuntimeError):
+    """This rank is not in the agreed world (the survivors shrank past
+    it).  Exit restartable and come back through rejoin()."""
+
+
+class MembershipTimeout(TimeoutError):
+    """A bus request did not complete inside its window."""
+
+
+class _BusUnreachable(ConnectionError):
+    """Transient: the coordinator is dead/moving; retried with backoff."""
+
+
+# -- wire helpers (length-prefixed pickle over a trusted local socket) ------
+
+def _send_obj(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    # 8-byte length prefix: a rejoin state payload is a whole model's
+    # parameters and can exceed the 4 GiB a 32-bit prefix could frame
+    sock.sendall(struct.pack("!Q", len(data)) + data)
+
+
+def _recv_obj(sock: socket.socket) -> Any:
+    buf = b""
+    while len(buf) < 8:
+        chunk = sock.recv(8 - len(buf))
+        if not chunk:
+            raise _BusUnreachable("bus connection closed mid-frame")
+        buf += chunk
+    (n,) = struct.unpack("!Q", buf)
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(min(65536, n - len(data)))
+        if not chunk:
+            raise _BusUnreachable("bus connection closed mid-frame")
+        data += chunk
+    return pickle.loads(data)
+
+
+class _BusServer:
+    """The coordinator-side membership endpoint.
+
+    State: the agreed ``(epoch, world)``, per-(epoch, step) sync
+    payloads, per-epoch shrink hellos, and parked join requests.  Every
+    verb parks its connection thread on one condition variable; any
+    state transition (quorum complete, epoch advanced, join admitted)
+    wakes everyone and each waiter re-evaluates its own predicate —
+    the same pop-time re-evaluation discipline as the server engine's
+    PriorityQueue.
+    """
+
+    def __init__(self, addr: Tuple[str, int], view: MembershipView,
+                 rendezvous_timeout_s: float, sync_timeout_s: float):
+        self.addr = addr
+        self.epoch = view.epoch
+        self.world: Set[int] = set(view.world)
+        self._rdv_timeout = rendezvous_timeout_s
+        self._sync_timeout = sync_timeout_s
+        self._cv = threading.Condition()
+        # (epoch, step) -> {rank: payload}
+        self._sync: Dict[Tuple[int, int], Dict[int, Any]] = {}
+        # (epoch, step) -> (state bytes, declared names, state's step)
+        self._snapshots: Dict[Tuple[int, int], Tuple[bytes, List[str], int]] = {}
+        # proposed epoch -> {rank: proposed world}
+        self._hellos: Dict[int, Dict[int, frozenset]] = {}
+        # rank -> None (parked) | admission info dict
+        self._join_wait: Dict[int, Optional[dict]] = {}
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(addr)
+        self._sock.listen(32)
+        self._sock.settimeout(0.25)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="bps-membership-bus")
+        self._thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout=2)
+        self._sock.close()
+
+    def view(self) -> MembershipView:
+        with self._cv:
+            return MembershipView(self.epoch, tuple(sorted(self.world)))
+
+    # -- serving -----------------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True, name="bps-membership-conn")
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(self._sync_timeout + self._rdv_timeout + 30.0)
+            msg = _recv_obj(conn)
+            op = msg.get("op")
+            if op == "sync":
+                reply = self._do_sync(msg)
+            elif op == "hello":
+                reply = self._do_hello(msg)
+            elif op == "rejoin":
+                reply = self._do_rejoin(msg)
+            else:
+                reply = {"ok": False, "error": f"unknown op {op!r}"}
+            _send_obj(conn, reply)
+        except Exception:  # noqa: BLE001 — a broken/dead client connection
+            # must not take the bus down; the client side has its own
+            # retry/timeout story
+            get_logger().debug("membership bus: connection handler failed",
+                               exc_info=True)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _stale_reply(self) -> dict:
+        return {"ok": False, "stale": True, "epoch": self.epoch,
+                "world": sorted(self.world)}
+
+    # -- verb: sync (step barrier + payload all-gather + join admission) ---
+
+    def _do_sync(self, msg: dict) -> dict:
+        rank, epoch, step = msg["rank"], msg["epoch"], msg["step"]
+        deadline = time.monotonic() + self._sync_timeout
+        with self._cv:
+            if epoch != self.epoch:
+                return self._stale_reply()
+            key = (epoch, step)
+            self._sync.setdefault(key, {})[rank] = msg.get("payload")
+            if msg.get("state") is not None:
+                # the state a member carries at step s is its state
+                # AFTER step s-1 — what a joiner admitted at this
+                # boundary resumes from
+                self._snapshots[key] = (msg["state"],
+                                        list(msg.get("declared") or ()),
+                                        step - 1)
+            # memory hygiene: completed rounds more than a few steps old
+            # can never gain another waiter
+            for k in [k for k in self._sync if k[1] < step - 4]:
+                self._sync.pop(k, None)
+                self._snapshots.pop(k, None)
+            self._cv.notify_all()
+            while not self._stop.is_set():
+                if self.epoch != epoch:
+                    # a shrink or an admission moved the world while this
+                    # round was parked: the payloads are void, retry the
+                    # step at the new epoch
+                    return self._stale_reply()
+                got = self._sync.get(key, {})
+                joins_parked = any(v is None
+                                   for v in self._join_wait.values())
+                if set(got) >= self.world:
+                    if joins_parked and key in self._snapshots:
+                        self._admit(key)
+                        continue  # epoch changed: loop → stale reply
+                    # join_waiting tells members to attach state on the
+                    # NEXT boundary — so the (expensive) state transfer
+                    # happens only when someone is actually rejoining
+                    return {"ok": True, "epoch": epoch,
+                            "payloads": dict(got),
+                            "join_waiting": joins_parked}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # quorum never completed: the missing members are
+                    # failure evidence (the detector may be inert after
+                    # its one firing) — the client turns this into a
+                    # shrink
+                    return {"ok": False, "timeout": True,
+                            "missing": sorted(self.world - set(got)),
+                            "epoch": self.epoch,
+                            "world": sorted(self.world)}
+                self._cv.wait(min(remaining, 0.25))
+        return self._stale_reply()
+
+    def _admit(self, key: Tuple[int, int]) -> None:
+        """Admit every parked joiner at this completed step boundary
+        (caller holds the condition)."""
+        state, declared, state_step = self._snapshots[key]
+        joiners = sorted(r for r, v in self._join_wait.items() if v is None)
+        self.epoch += 1
+        self.world |= set(joiners)
+        info = {"epoch": self.epoch, "world": sorted(self.world),
+                "declared": declared, "step": state_step, "state": state}
+        for r in joiners:
+            self._join_wait[r] = dict(info)
+        counters.inc("membership.rejoin_admitted", len(joiners))
+        get_logger().warning(
+            "membership bus: admitted rank(s) %s at step boundary %d — "
+            "epoch %d, world %s", joiners, key[1], self.epoch,
+            sorted(self.world))
+        # void the old epoch's parked rounds
+        self._sync = {k: v for k, v in self._sync.items()
+                      if k[0] >= self.epoch}
+        self._cv.notify_all()
+
+    # -- verb: hello (the shrink rendezvous) -------------------------------
+
+    def _do_hello(self, msg: dict) -> dict:
+        rank = msg["rank"]
+        proposed_epoch = msg["epoch"]
+        proposed_world = frozenset(msg["world"])
+        deadline = time.monotonic() + self._rdv_timeout
+        with self._cv:
+            if proposed_epoch <= self.epoch:
+                # agreement already happened (or a stray old proposal):
+                # the current view IS the answer
+                return {"ok": True, "epoch": self.epoch,
+                        "world": sorted(self.world)}
+            self._hellos.setdefault(proposed_epoch, {})[rank] = proposed_world
+            self._cv.notify_all()
+            while not self._stop.is_set():
+                if self.epoch >= proposed_epoch:
+                    return {"ok": True, "epoch": self.epoch,
+                            "world": sorted(self.world)}
+                got = self._hellos.get(proposed_epoch, {})
+                # the ranks every proposal agrees are alive must all
+                # check in; a rank someone still believes dead but that
+                # hellos anyway is alive by definition and joins the
+                # agreed world
+                expected = frozenset.intersection(*got.values())
+                if set(got) >= expected:
+                    self._agree(proposed_epoch, sorted(got))
+                    return {"ok": True, "epoch": self.epoch,
+                            "world": sorted(self.world)}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # double failure during the shrink: whoever never
+                    # helloed inside the window is dropped too
+                    get_logger().error(
+                        "membership: rendezvous for epoch %d timed out "
+                        "waiting for %s — proceeding with responders %s",
+                        proposed_epoch, sorted(expected - set(got)),
+                        sorted(got))
+                    self._agree(proposed_epoch, sorted(got))
+                    return {"ok": True, "epoch": self.epoch,
+                            "world": sorted(self.world)}
+                self._cv.wait(min(remaining, 0.25))
+        return self._stale_reply()
+
+    def _agree(self, epoch: int, world: List[int]) -> None:
+        """Commit a shrink agreement (caller holds the condition)."""
+        self.epoch = epoch
+        self.world = set(world)
+        self._hellos.pop(epoch, None)
+        # release every sync round parked under the dead epoch
+        self._sync = {k: v for k, v in self._sync.items() if k[0] >= epoch}
+        counters.inc("membership.shrink_agreed")
+        get_logger().warning("membership bus: agreed epoch %d, world %s",
+                             epoch, world)
+        self._cv.notify_all()
+
+    # -- verb: rejoin ------------------------------------------------------
+
+    def _do_rejoin(self, msg: dict) -> dict:
+        rank = msg["rank"]
+        deadline = time.monotonic() + self._sync_timeout
+        with self._cv:
+            self._join_wait[rank] = None
+            self._cv.notify_all()
+            while not self._stop.is_set():
+                info = self._join_wait.get(rank)
+                if info is not None:
+                    del self._join_wait[rank]
+                    return {"ok": True, **info}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._join_wait.pop(rank, None)
+                    return {"ok": False, "timeout": True}
+                self._cv.wait(min(remaining, 0.25))
+        return {"ok": False, "timeout": True}
+
+
+# -- the per-process membership object --------------------------------------
+
+
+class ElasticMembership:
+    """One process's handle on the elastic world.
+
+    Parameters
+    ----------
+    rank : this process's membership rank (the launcher's
+        ``DMLC_WORKER_ID`` numbering — a per-process identity that
+        exists before any JAX state, same convention as the fault
+        injector).
+    world : the initial member ranks.
+    bus : ``host:port`` of the membership bus; defaults to
+        ``DMLC_PS_ROOT_URI`` with ``BYTEPS_MEMBERSHIP_PORT`` (or
+        coordinator port + 2).  The lowest-ranked live member hosts it.
+    devices : devices for resumed meshes (passed through to
+        ``api.resume``).
+    assigner / server_engine / kv_store : optional attached components
+        re-synced on every world change (``ServerAssigner.reshard``,
+        ``set_membership_epoch``).
+    on_world_change : callback run with the new :class:`MembershipView`
+        after each applied change (keep it short — it can run on the
+        detector thread).
+    """
+
+    def __init__(self, rank: int, world: Iterable[int],
+                 bus: Optional[str] = None, *,
+                 devices=None,
+                 assigner=None, server_engine=None, kv_store=None,
+                 on_world_change: Optional[Callable[[MembershipView],
+                                                    None]] = None,
+                 rendezvous_timeout_s: Optional[float] = None,
+                 sync_timeout_s: Optional[float] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 _view: Optional[MembershipView] = None):
+        from ..common.config import get_config
+        cfg = get_config()
+        self.rank = int(rank)
+        self._view = _view or MembershipView(
+            current_epoch(), tuple(sorted(int(r) for r in world)))
+        if self.rank not in self._view.world:
+            raise ValueError(f"rank {self.rank} not in world "
+                             f"{list(self._view.world)}")
+        if bus is None:
+            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+            port = cfg.membership_port or (
+                int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 2)
+        else:
+            host, port_s = bus.rsplit(":", 1)
+            port = int(port_s)
+        self.bus_addr = (host, port)
+        self.devices = devices
+        self.assigner = assigner
+        self.server_engine = server_engine
+        self.kv_store = kv_store
+        self.on_world_change = on_world_change
+        self.rendezvous_timeout_s = (
+            cfg.membership_rendezvous_timeout_s
+            if rendezvous_timeout_s is None else rendezvous_timeout_s)
+        self.sync_timeout_s = (cfg.membership_sync_timeout_s
+                               if sync_timeout_s is None else sync_timeout_s)
+        self._retry = retry or RetryPolicy.from_config(
+            cfg, retry_on=(_BusUnreachable,))
+        self._apply_lock = threading.Lock()
+        self._ready_cv = threading.Condition()
+        self._bus: Optional[_BusServer] = None
+        # True once a sync reply advertised a parked joiner: the next
+        # step_sync attaches the (expensive) state payload
+        self._join_hint = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ElasticMembership":
+        """Adopt the initial view; host the bus when this rank is the
+        coordinator."""
+        set_epoch(self._view.epoch)
+        self._ensure_bus(self._view)
+        return self
+
+    def stop(self) -> None:
+        if self._bus is not None:
+            self._bus.close()
+            self._bus = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def view(self) -> MembershipView:
+        return self._view
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.rank == self._view.coordinator
+
+    def _ensure_bus(self, view: MembershipView) -> None:
+        """Host the bus iff this rank is the coordinator of ``view``
+        and no bus is running here yet (idempotent; retried because a
+        just-dead predecessor's socket may linger in TIME_WAIT).
+
+        A bind that stays refused is NOT fatal: after a coordinator
+        failover the old minimum rank can rejoin a world whose bus a
+        surviving member already hosts at the fixed address — the
+        rejoiner must join as a client of that bus, not die on
+        EADDRINUSE after it was already admitted."""
+        if self.rank != min(view.world) or self._bus is not None:
+            return
+        def _bind():
+            return _BusServer(self.bus_addr, view,
+                              self.rendezvous_timeout_s,
+                              self.sync_timeout_s)
+        try:
+            self._bus = RetryPolicy.from_config(
+                retry_on=(OSError,)).call(_bind,
+                                          describe="membership bus bind")
+        except OSError:
+            get_logger().warning(
+                "membership: rank %d is the coordinator of %s but the bus "
+                "address %s:%d is already served (coordinator failover "
+                "kept it) — continuing as a bus client",
+                self.rank, list(view.world), *self.bus_addr)
+            return
+        get_logger().info("membership: rank %d hosting the bus at %s:%d",
+                          self.rank, *self.bus_addr)
+
+    # -- bus client --------------------------------------------------------
+
+    def _request(self, msg: dict, timeout: float) -> dict:
+        """One request/reply round trip.  Connection-level failures (the
+        coordinator died; its successor is still binding) are retried
+        with full-jitter backoff; a read that exceeds ``timeout`` is a
+        :class:`MembershipTimeout` and is NOT retried — the server
+        answers its own timeouts explicitly."""
+        def once():
+            try:
+                s = socket.create_connection(self.bus_addr, timeout=3.0)
+            except OSError as e:
+                raise _BusUnreachable(f"bus {self.bus_addr}: {e}") from None
+            try:
+                s.settimeout(timeout)
+                _send_obj(s, msg)
+                return _recv_obj(s)
+            except socket.timeout:
+                raise MembershipTimeout(
+                    f"membership {msg.get('op')} timed out after "
+                    f"{timeout:.1f}s") from None
+            except _BusUnreachable:
+                raise
+            except OSError as e:
+                raise _BusUnreachable(f"bus {self.bus_addr}: {e}") from None
+            finally:
+                s.close()
+        return self._retry.call(once,
+                                describe=f"membership {msg.get('op')}")
+
+    def _declared_order(self) -> List[str]:
+        from ..core import api
+        if api.initialized():
+            return api._require().registry.names_in_declaration_order()
+        return list(api._declared_order)
+
+    # -- the step barrier / all-gather ------------------------------------
+
+    def step_sync(self, step: int, payload: Any = None,
+                  state: Any = None) -> Tuple[MembershipView, Dict[int, Any]]:
+        """Synchronize step ``step`` with every live member.
+
+        Returns ``(view, payloads)`` where ``payloads`` maps rank →
+        the small control-plane payload each member posted.  ``state``
+        (a checkpoint-style pytree, or pre-packed bytes) is what a
+        parked rejoiner would be admitted with; pass it every step to
+        make any step a potential rejoin barrier.  It is only
+        materialized and shipped when the bus has advertised a parked
+        joiner (the previous sync reply's ``join_waiting``), so the
+        per-step cost of the offer is one ignored keyword — the real
+        pack/transfer happens on the one boundary that needs it
+        (admission therefore lands on the *second* quorum after a
+        rejoin request).
+
+        Raises :class:`WorldChanged` when the epoch moved — by then the
+        local engine has already been suspended/resumed onto the new
+        world, so the caller just retries the step.  A quorum timeout
+        with missing members is treated as failure evidence and turned
+        into a shrink (the heartbeat detector fires only once; this is
+        the detection path for failures *after* the first).
+        """
+        view = self._view
+        msg: Dict[str, Any] = {"op": "sync", "rank": self.rank,
+                               "epoch": view.epoch, "step": step,
+                               "payload": payload}
+        if state is not None and self._join_hint:
+            if not isinstance(state, bytes):
+                from ..utils.checkpoint import pack_state
+                state = pack_state(state)
+            msg["state"] = state
+            msg["declared"] = self._declared_order()
+        reply = self._request(msg, timeout=self.sync_timeout_s + 15.0)
+        if reply.get("ok"):
+            self._join_hint = bool(reply.get("join_waiting"))
+            return self._view, reply["payloads"]
+        if reply.get("stale"):
+            new = MembershipView(reply["epoch"], tuple(reply["world"]))
+            if self.rank not in new.world:
+                raise Evicted(
+                    f"rank {self.rank} is outside the agreed world "
+                    f"{list(new.world)} (epoch {new.epoch})")
+            self._maybe_apply(new)
+            raise WorldChanged(new)
+        if reply.get("timeout"):
+            missing = set(reply.get("missing") or ())
+            if missing:
+                get_logger().error(
+                    "membership: step %d sync timed out; missing rank(s) "
+                    "%s treated as failed", step, sorted(missing))
+                return_view = self.shrink(missing)
+                raise WorldChanged(return_view)
+            raise MembershipTimeout(f"step {step} sync timed out")
+        raise RuntimeError(f"membership sync failed: {reply!r}")
+
+    # -- shrink ------------------------------------------------------------
+
+    def on_failure(self, stale: Set[int]) -> None:
+        """``HeartbeatMonitor.on_failure`` action: shrink in place;
+        escalate to the restartable exit only when the shrink itself
+        fails (launcher supervision is the outer loop, as with
+        ``RecoveryCoordinator``)."""
+        try:
+            self.shrink(stale)
+        except Exception:  # noqa: BLE001 — end of the in-process line
+            counters.inc("membership.shrink_failed")
+            from ..utils.failure_detector import _failure_exit_code
+            code = _failure_exit_code()
+            get_logger().error(
+                "elastic shrink failed — exiting %d so the launcher can "
+                "restart", code, exc_info=True)
+            _exit(code)
+
+    def shrink(self, stale: Set[int]) -> MembershipView:
+        """Drop ``stale`` ranks: epoch guard up → drain/suspend →
+        epoch-tagged rendezvous → resume at the survivor world."""
+        view = self._view
+        stale = set(stale) & set(view.world)
+        if not stale:
+            # a late detection of ranks an earlier shrink already
+            # removed — the world is current, nothing to do
+            return view
+        proposed_world = tuple(r for r in view.world if r not in stale)
+        proposed_epoch = view.epoch + 1
+        if self.rank not in proposed_world:
+            raise Evicted(f"rank {self.rank} was declared stale by its "
+                          "own detector input")
+        counters.inc("membership.shrink_started")
+        t0 = time.monotonic()
+        get_logger().error(
+            "membership: rank(s) %s lost — shrinking to %s (epoch %d)",
+            sorted(stale), list(proposed_world), proposed_epoch)
+        # Guard first: from here every in-flight chunk is stale and gets
+        # dropped at dispatch/finish instead of delivered, so the drain
+        # below is fast and the results of a half-dead collective never
+        # reach a callback.
+        set_epoch(proposed_epoch)
+        from ..core import api
+        if api.initialized():
+            api.suspend()
+        # Coordinator failover: if the dead set includes the old
+        # coordinator, the lowest surviving rank hosts the bus before
+        # helloing (to itself); everyone else's connect is retried with
+        # backoff until the new bus is up.
+        self._ensure_bus(MembershipView(view.epoch, proposed_world))
+        reply = self._request(
+            {"op": "hello", "rank": self.rank, "epoch": proposed_epoch,
+             "world": list(proposed_world)},
+            timeout=self.rendezvous_timeout_s + 15.0)
+        agreed = MembershipView(reply["epoch"], tuple(reply["world"]))
+        if self.rank not in agreed.world:
+            raise Evicted(f"rank {self.rank} is outside the agreed world "
+                          f"{list(agreed.world)}")
+        out = self._maybe_apply(agreed)
+        get_logger().warning(
+            "membership: shrink complete in %.2fs — epoch %d, world %s",
+            time.monotonic() - t0, out.epoch, list(out.world))
+        return out
+
+    # -- applying an agreed view ------------------------------------------
+
+    def _maybe_apply(self, view: MembershipView) -> MembershipView:
+        """Re-point this process at ``view``: advance the epoch, rebuild
+        mesh+engine on the new world size, re-shard attached components.
+        Idempotent and monotonic — concurrent appliers (detector thread
+        vs a trainer thread that saw a stale sync reply) serialize here
+        and the second is a no-op."""
+        with self._apply_lock:
+            old = self._view
+            if view.epoch <= old.epoch:
+                return old
+            t0 = time.monotonic()
+            grew = len(view.world) > len(old.world)
+            set_epoch(view.epoch)
+            from ..core import api
+            if api.initialized():
+                api.suspend()
+            _resume_for_world(view, self.devices)
+            self._view = view
+            if self.assigner is not None:
+                try:
+                    self.assigner.reshard(view.num_workers)
+                except Exception:  # noqa: BLE001 — a shape the shrunk
+                    # world can't satisfy must not kill a healthy
+                    # survivor; routing keeps the old map, service
+                    # survives (mixed-mode assigners need an explicit
+                    # reshard(num_servers, num_workers) from
+                    # on_world_change — the split is deployment-specific)
+                    get_logger().error(
+                        "membership: ServerAssigner reshard to %d failed; "
+                        "keeping the previous assignment (drive "
+                        "reshard() from on_world_change for mixed mode)",
+                        view.num_workers, exc_info=True)
+            if self.server_engine is not None:
+                self.server_engine.set_membership_epoch(view.epoch)
+            if self.kv_store is not None:
+                self.kv_store.set_membership_epoch(view.epoch)
+            self._ensure_bus(view)
+            counters.inc("membership.grow" if grew else "membership.shrink")
+            self._record_span("rejoin" if grew else "shrink", t0, view)
+            get_logger().warning(
+                "membership: now epoch %d, world %s (%d worker(s))",
+                view.epoch, list(view.world), view.num_workers)
+        with self._ready_cv:
+            self._ready_cv.notify_all()
+        if self.on_world_change is not None:
+            try:
+                self.on_world_change(view)
+            except Exception:  # noqa: BLE001 — the transition itself
+                # succeeded; a broken user callback must not undo that
+                get_logger().error("on_world_change callback raised",
+                                   exc_info=True)
+        return view
+
+    def wait_ready(self, epoch: int,
+                   timeout: Optional[float] = None) -> MembershipView:
+        """Block until the local view reaches ``epoch`` (trainer-side
+        helper for exception paths where the applying thread is
+        elsewhere)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._ready_cv:
+            while self._view.epoch < epoch:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise MembershipTimeout(
+                        f"world change to epoch {epoch} not applied "
+                        f"locally within {timeout:.1f}s")
+                self._ready_cv.wait(0.1 if remaining is None
+                                    else min(remaining, 0.1))
+        return self._view
+
+    def _record_span(self, name: str, t0: float,
+                     view: MembershipView) -> None:
+        """Membership transition span into the *resumed* engine's tracer
+        (same placement as RecoveryCoordinator._record_span)."""
+        try:
+            from ..core import api
+            eng = api._require()
+        except Exception:  # noqa: BLE001 — tracing is best-effort
+            return
+        eng.tracer.record_span(name, t0, time.monotonic(),
+                               epoch=view.epoch, world=list(view.world))
+
+    # -- rejoin ------------------------------------------------------------
+
+    @classmethod
+    def rejoin(cls, rank: int, bus: Optional[str] = None, *,
+               devices=None, timeout: Optional[float] = None,
+               **kwargs) -> Tuple["ElasticMembership", Optional[int], Any]:
+        """Rejoin a running world from a fresh process.
+
+        Parks on the bus until the survivors pass a step boundary, then
+        adopts the agreed epoch, re-declares every tensor in the
+        received declared-key order (identical key assignment), resumes
+        the engine at the grown world size, and returns
+        ``(membership, step, state)`` — ``state`` is the survivors'
+        in-memory parameters (``utils.checkpoint.unpack_state``), the
+        elastic counterpart of restore-then-broadcast, and ``step`` the
+        training step it corresponds to.
+        """
+        counters.inc("membership.rejoin_requested")
+        t0 = time.monotonic()
+        probe = cls(rank, [rank], bus, devices=devices, **kwargs)
+        wait_s = probe.sync_timeout_s if timeout is None else timeout
+        reply = probe._request({"op": "rejoin", "rank": int(rank)},
+                               timeout=wait_s + 15.0)
+        if not reply.get("ok"):
+            raise MembershipTimeout(
+                f"rejoin of rank {rank} was not admitted: {reply!r}")
+        view = MembershipView(reply["epoch"], tuple(reply["world"]))
+        set_epoch(view.epoch)
+        from ..core import api
+        for name in reply.get("declared") or ():
+            api.declare(name)   # original order ⇒ identical keys
+        _resume_for_world(view, devices)
+        probe._view = view
+        probe._ensure_bus(view)   # no-op unless this rank is coordinator
+        state = None
+        if reply.get("state") is not None:
+            from ..utils.checkpoint import unpack_state
+            state = unpack_state(reply["state"])
+        counters.inc("membership.rejoined")
+        probe._record_span("rejoin", t0, view)
+        get_logger().warning(
+            "membership: rank %d rejoined at epoch %d, world %s, step %s",
+            rank, view.epoch, list(view.world), reply.get("step"))
+        return probe, reply.get("step"), state
+
+
+def _resume_for_world(view: MembershipView, devices) -> None:
+    """Resume the engine for the agreed world.
+
+    Multi-host (a real ``jax.distributed`` run): the world size IS the
+    DMLC host count, so it is exported through ``resume(num_workers=)``
+    exactly as the reference's ``BytePSBasics.resume`` would — with the
+    known caveat that an initialized JAX backend cannot drop a dead
+    peer's devices, so callers pass ``devices=jax.local_devices()``
+    (see RecoveryCoordinator's ``devices`` docstring).
+
+    Single-controller (one process per member, each owning its own
+    local mesh — the CPU chaos topology and any one-host elastic run):
+    the membership world is a *bus-level* fact, not the local JAX
+    topology; resume re-initializes the local mesh unchanged and must
+    NOT rewrite ``DMLC_NUM_WORKER`` (that would send the next
+    bootstrap down the multi-host rendezvous path)."""
+    import jax
+    from ..core import api
+    if jax.process_count() > 1:
+        api.resume(num_workers=view.num_workers, devices=devices)
+    else:
+        api.resume(devices=devices)
+
+
+# monkeypatch point for tests (escalation must not kill the test runner)
+_exit = os._exit

@@ -22,6 +22,12 @@ inner, cheaper one.  Events land in telemetry counters
 (``recovery.attempt/completed/failed``) and, when tracing is on, a
 ``recovery`` span in the chrome timeline.
 
+This coordinator recovers ONE process against a checkpoint.  The
+multi-survivor story — every survivor agreeing on the shrunk world and
+continuing from *in-memory* state, plus in-place rejoin of a restarted
+rank — is :mod:`byteps_tpu.fault.membership`, which composes the same
+drain/suspend/resume primitives under an epoch-tagged rendezvous.
+
 The wedged-collective caveat from the detector's docstring still holds:
 a survivor stuck *inside* a DCN collective cannot run this path (the
 thread is captive in XLA) — that case stays with the StepWatchdog's

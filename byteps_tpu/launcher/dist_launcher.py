@@ -23,6 +23,14 @@ Supervision: ``--restart N`` (or ``BYTEPS_RESTART_LIMIT``) restarts a
 worker whose exit code equals the failure detector's restartable code
 (``BYTEPS_FAILURE_EXIT_CODE``, default 17) with full-jitter backoff; a
 per-host exit-code summary is printed at the end either way.
+
+Elastic mode (``--elastic`` / ``BYTEPS_ELASTIC``): the survivors shrink
+in place (fault/membership.py) instead of exiting, so supervision
+restarts **only the dead rank, not the world** — any nonzero exit of a
+single worker is restart-worthy (the crash IS the membership event),
+and the restarted incarnation gets ``BYTEPS_ELASTIC_REJOIN=1`` so it
+comes back through the membership rejoin barrier instead of the init
+push barrier.
 """
 
 from __future__ import annotations
@@ -37,7 +45,7 @@ import time
 import traceback
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from ..common.config import _env_int
+from ..common.config import _env_bool, _env_int
 from ..common.retry import RetryPolicy
 
 # env vars forwarded from the launcher's own environment when set
@@ -149,7 +157,8 @@ def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
            ssh_runner=None,
            restart_limit: Optional[int] = None,
            restartable_codes: Optional[Set[int]] = None,
-           backoff: Optional[RetryPolicy] = None) -> "LaunchReport":
+           backoff: Optional[RetryPolicy] = None,
+           elastic: bool = False) -> "LaunchReport":
     """Fan the command out to every host; block until all exit.  Returns
     per-host exit codes (a :class:`LaunchReport`).
     ``ssh_runner(argv, stdout, stderr) -> int`` is injectable (tests use
@@ -163,6 +172,13 @@ def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
     retrying; a crash (exit 1) or signal death is not.  A raised
     ``ssh_runner`` (connection refused, DNS) is retried by the same
     policy before counting as a launcher error.
+
+    ``elastic=True`` changes the supervision contract: survivors never
+    exit on a peer failure (they shrink in place), so ANY nonzero exit
+    is one dead rank worth restarting on its own — the restarted
+    incarnation carries ``BYTEPS_ELASTIC_REJOIN=1`` (and every worker
+    ``BYTEPS_ELASTIC=1``) so it rejoins the running world through the
+    membership bus rather than re-running the cold bootstrap.
     """
     os.makedirs(log_dir, exist_ok=True)
     if ssh_runner is None:
@@ -170,6 +186,8 @@ def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
             return subprocess.call(argv, stdout=stdout, stderr=stderr)
     if restart_limit is None:
         restart_limit = _env_int("BYTEPS_RESTART_LIMIT", 0)
+    if elastic and restart_limit == 0:
+        restart_limit = 1   # elastic without restarts cannot re-grow
     if restartable_codes is None:
         restartable_codes = {_env_int("BYTEPS_FAILURE_EXIT_CODE", 17)}
     if backoff is None:
@@ -182,11 +200,19 @@ def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
 
     def run(i: int, host: str, port: str) -> None:
         env = build_env(hosts, i, coordinator_port, extra_env or {})
-        argv = ssh_argv(host, port, env, cmd, username)
+        if elastic:
+            env.setdefault("BYTEPS_ELASTIC", "1")
         base = os.path.join(log_dir, f"worker{i}")
         try:
             attempt = 0
             while True:
+                attempt_env = dict(env)
+                if elastic and attempt > 0:
+                    # only the dead rank restarts; it must come back as
+                    # a rejoiner, not a cold bootstrap racing a world
+                    # that kept running without it
+                    attempt_env["BYTEPS_ELASTIC_REJOIN"] = "1"
+                argv = ssh_argv(host, port, attempt_env, cmd, username)
                 # restarts append — the first incarnation's logs are the
                 # evidence of WHY the restart happened
                 mode = "wb" if attempt == 0 else "ab"
@@ -195,8 +221,9 @@ def launch(hosts: List[Tuple[str, str]], cmd: Sequence[str],
                     codes[i] = backoff.call(
                         ssh_runner, argv, out, err,
                         describe=f"ssh dispatch worker{i} [{host}]")
-                if (codes[i] in restartable_codes
-                        and attempt < restart_limit):
+                restart_worthy = (codes[i] != 0 if elastic
+                                  else codes[i] in restartable_codes)
+                if restart_worthy and attempt < restart_limit:
                     attempt += 1
                     restarts[i] = attempt
                     delay = backoff.backoff(attempt)
@@ -250,6 +277,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "with the restartable failure code "
                          "(BYTEPS_FAILURE_EXIT_CODE, default 17); "
                          "default from BYTEPS_RESTART_LIMIT")
+    ap.add_argument("--elastic", action="store_true",
+                    default=_env_bool("BYTEPS_ELASTIC", False),
+                    help="elastic membership mode: survivors shrink in "
+                         "place, ONLY the dead rank is restarted (on any "
+                         "nonzero exit) and rejoins the running world "
+                         "(BYTEPS_ELASTIC_REJOIN=1)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run on every host")
     args = ap.parse_args(argv)
@@ -268,7 +301,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
           f"(coordinator {hosts[0][0]}:{args.port})")
     codes = launch(hosts, cmd, coordinator_port=args.port,
                    extra_env=parse_envs(args.env), username=args.username,
-                   log_dir=args.log_dir, restart_limit=args.restart)
+                   log_dir=args.log_dir, restart_limit=args.restart,
+                   elastic=args.elastic)
     print(format_exit_summary(hosts, codes, args.log_dir), file=sys.stderr)
     # signal deaths are negative return codes; max() would mask them
     # behind any worker that exited 0

@@ -35,7 +35,9 @@ import numpy as np
 
 from ..common.logging import get_logger
 from ..common.retry import RetryPolicy
+from ..common.telemetry import counters
 from ..fault import injector as _fault
+from ..fault import membership as _membership
 from ..native import inplace_add
 
 
@@ -167,6 +169,10 @@ class ServerEngine:
         self._debug_key = (debug_key if debug_key is not None
                            else cfg.server_debug_key)
         self.queues = [PriorityQueue(sched) for _ in range(self.num_threads)]
+        # membership-epoch gate (fault/membership.py): pushes stamped
+        # with another epoch arrive from a world that no longer exists
+        # and are dropped, not summed
+        self._membership_epoch = _membership.current_epoch()
         self._states: Dict[str, _KeyState] = {}
         self._codecs: Dict[str, "_Codec"] = {}
         self._states_lock = threading.Lock()
@@ -202,13 +208,40 @@ class ServerEngine:
 
     # -- public API --------------------------------------------------------
 
+    def set_membership_epoch(self, epoch: int) -> None:
+        """Adopt a new membership epoch (monotonic).  From now on any
+        push stamped with a different epoch — residue from before an
+        elastic shrink, or a worker that missed the world change — is
+        dropped at the door instead of poisoning a merge round."""
+        if epoch > self._membership_epoch:
+            self._membership_epoch = epoch
+            get_logger().warning(
+                "server engine: membership epoch now %d; differently "
+                "stamped pushes will be dropped", epoch)
+
+    @property
+    def membership_epoch(self) -> int:
+        return self._membership_epoch
+
     def push(self, key: str, value, worker_id: int,
-             num_workers: int) -> None:
+             num_workers: int, mepoch: Optional[int] = None) -> None:
         """One worker's contribution for this round (non-blocking).
         The key's shape/dtype are established by its first push and every
         later push is validated here, in the caller's thread — a
         mismatched push must never reach COPY_FIRST/SUM_RECV on the
-        engine thread (where it would poison the round)."""
+        engine thread (where it would poison the round).
+
+        ``mepoch``: the caller's membership epoch.  A mismatch against
+        the engine's current epoch means the push crossed an elastic
+        world change — it is dropped, not summed (the merge round it was
+        meant for no longer exists).  ``None`` (non-elastic callers)
+        skips the check."""
+        if mepoch is not None and mepoch != self._membership_epoch:
+            counters.inc("membership.stale_pushes_dropped")
+            get_logger().warning(
+                "server engine: dropped push(%r) from membership epoch "
+                "%d (current %d)", key, mepoch, self._membership_epoch)
+            return
         arr = np.asarray(value)
         if _fault.ENABLED:
             # chaos sites: bitflip corrupts this worker's contribution
@@ -310,10 +343,19 @@ class ServerEngine:
         return codec
 
     def push_compressed(self, key: str, data: bytes, worker_id: int,
-                        num_workers: int) -> None:
+                        num_workers: int,
+                        mepoch: Optional[int] = None) -> None:
         """Push one worker's wire-encoded payload; decompressed here (the
         caller's thread — same placement as shape validation) and merged
-        by the engine threads like any dense push."""
+        by the engine threads like any dense push.  A stale ``mepoch``
+        is dropped before the decode even runs."""
+        if mepoch is not None and mepoch != self._membership_epoch:
+            counters.inc("membership.stale_pushes_dropped")
+            get_logger().warning(
+                "server engine: dropped compressed push(%r) from "
+                "membership epoch %d (current %d)", key, mepoch,
+                self._membership_epoch)
+            return
         comp = self._codec(key).comp
         value = np.asarray(comp.decompress(comp.wire_decode(data)))
         self.push(key, value, worker_id, num_workers)

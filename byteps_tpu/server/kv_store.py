@@ -23,6 +23,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..common.logging import get_logger
+from ..common.telemetry import counters
+from ..fault import membership as _membership
 from ..native import inplace_add, load as _native_load
 
 
@@ -33,9 +36,30 @@ class KVStore:
         self._versions: Dict[str, int] = {}
         self._codecs: Dict[str, object] = {}
         self.wire_bytes = 0  # total compressed bytes pushed (accounting)
+        # membership-epoch gate (fault/membership.py): deltas stamped
+        # with another epoch are dropped, not summed
+        self._membership_epoch = _membership.current_epoch()
         # force the one-time native build/load here, NOT under self._lock in
         # push_delta (the first load may g++-compile core.cc for seconds)
         _native_load()
+
+    def set_membership_epoch(self, epoch: int) -> None:
+        """Adopt a new membership epoch (monotonic); see ServerEngine."""
+        with self._lock:
+            if epoch > self._membership_epoch:
+                self._membership_epoch = epoch
+
+    def _stale(self, key: str, mepoch: Optional[int]) -> bool:
+        """True when the delta crossed an elastic world change; stale
+        deltas are dropped (the async accumulation they belonged to no
+        longer exists) and the key's version is left untouched."""
+        if mepoch is None or mepoch == self._membership_epoch:
+            return False
+        counters.inc("membership.stale_pushes_dropped")
+        get_logger().warning(
+            "kv store: dropped delta for %r from membership epoch %d "
+            "(current %d)", key, mepoch, self._membership_epoch)
+        return True
 
     def init_key(self, key: str, value) -> None:
         """Idempotent first-push initialization (reference init-push
@@ -55,10 +79,14 @@ class KVStore:
         self._versions[key] += 1
         return self._versions[key]
 
-    def push_delta(self, key: str, delta) -> int:
+    def push_delta(self, key: str, delta,
+                   mepoch: Optional[int] = None) -> int:
         """Sum a delta into the store (async SUM_RECV path); returns the
-        new version."""
+        new version.  A stale ``mepoch`` (see :meth:`_stale`) is dropped
+        — the current version is returned unchanged."""
         with self._lock:
+            if self._stale(key, mepoch):
+                return self._versions.get(key, -1)
             return self._push_delta_locked(key, np.asarray(delta))
 
     def register_compression(self, key: str, kwargs: dict, numel: int,
@@ -79,14 +107,18 @@ class KVStore:
             comp = reg.create(dict(kwargs), numel, dtype, for_server=True)
             self._codecs[key] = (dict(kwargs), comp)
 
-    def push_delta_wire(self, key: str, data: bytes) -> int:
+    def push_delta_wire(self, key: str, data: bytes,
+                        mepoch: Optional[int] = None) -> int:
         """Sum a wire-encoded compressed delta (the reference's async +
         compressed combination: compressed pushes, decompress-and-sum on
         the server, server.cc:87-113 + 310-314).  The key's codec must
         be registered via :meth:`register_compression`; the bytes are
         what a real worker->server network hop would carry, accumulated
-        in :attr:`wire_bytes` only for pushes that land."""
+        in :attr:`wire_bytes` only for pushes that land.  A stale
+        ``mepoch`` is dropped before the decode runs."""
         with self._lock:
+            if self._stale(key, mepoch):
+                return self._versions.get(key, -1)
             codec = self._codecs.get(key)
             if codec is None:
                 raise KeyError(f"key {key!r} has no registered compression")

@@ -67,14 +67,22 @@ class ServerAssigner:
     keeping the colocated machines' NICs from double-duty."""
 
     def __init__(self, num_servers: int, fn: Optional[str] = None,
-                 mixed_mode: bool = False, num_workers: int = 0,
-                 bound: int = 101):
+                 mixed_mode: Optional[bool] = None, num_workers: int = 0,
+                 bound: Optional[int] = None):
         if num_servers < 1:
             raise ValueError("num_servers must be >= 1")
-        if fn is None:
-            # BYTEPS_KEY_HASH_FN (reference global.cc:159-176)
+        if fn is None or mixed_mode is None or bound is None:
+            # env-reachable knobs (reference global.cc:159-176, 566-596):
+            # BYTEPS_KEY_HASH_FN, BYTEPS_ENABLE_MIXED_MODE,
+            # BYTEPS_MIXED_MODE_BOUND — explicit arguments win
             from ..common.config import get_config
-            fn = get_config().key_hash_fn
+            cfg = get_config()
+            fn = cfg.key_hash_fn if fn is None else fn
+            if mixed_mode is None:
+                mixed_mode = cfg.enable_mixed_mode
+                if mixed_mode and num_workers == 0:
+                    num_workers = cfg.num_hosts
+            bound = cfg.mixed_mode_bound if bound is None else bound
         if fn not in _FNS:
             raise ValueError(f"unknown hash fn {fn!r}; one of {list(_FNS)}")
         self.num_servers = num_servers
@@ -83,23 +91,59 @@ class ServerAssigner:
         self._mixed = mixed_mode
         self._bound = bound
         self._num_workers = num_workers
-        if mixed_mode:
-            nonco = num_servers - num_workers
-            if not 0 < nonco <= num_workers:
-                raise ValueError(
-                    "mixed mode needs 0 < num_servers - num_workers <= "
-                    "num_workers (global.cc ratio constraint)")
-            if bound < num_servers:
-                raise ValueError("BYTEPS_MIXED_MODE_BOUND must be >= "
-                                 "num_servers")
-            w = num_workers
-            self._ratio = (2.0 * nonco * (w - 1)) / (
-                w * (w + nonco) - 2 * nonco)
-            self._threshold = self._ratio * bound
-            self._nonco = nonco
+        self._init_mixed()
         self._cache: Dict[int, int] = {}
         self.load_bytes: List[int] = [0] * num_servers
         self._lock = threading.Lock()
+
+    def _init_mixed(self) -> None:
+        """(Re)derive the mixed-mode split from the current shape."""
+        if not self._mixed:
+            return
+        nonco = self.num_servers - self._num_workers
+        if not 0 < nonco <= self._num_workers:
+            raise ValueError(
+                "mixed mode needs 0 < num_servers - num_workers <= "
+                "num_workers (global.cc ratio constraint)")
+        if self._bound < self.num_servers:
+            raise ValueError("BYTEPS_MIXED_MODE_BOUND must be >= "
+                             "num_servers")
+        w = self._num_workers
+        self._ratio = (2.0 * nonco * (w - 1)) / (
+            w * (w + nonco) - 2 * nonco)
+        self._threshold = self._ratio * self._bound
+        self._nonco = nonco
+
+    def reshard(self, num_servers: int,
+                num_workers: Optional[int] = None) -> None:
+        """Re-hash the key space for a changed world (elastic shrink or
+        rejoin, fault/membership.py).  Drops the assignment cache —
+        every key re-routes under the new server count — and restarts
+        the byte-load accounting.  A mixed-mode assigner REQUIRES an
+        explicit ``num_workers``: the colocated/non-colocated split is
+        deployment-specific and inferring it would silently misroute
+        the key space; a shape the new world cannot satisfy raises and
+        the previous shape is kept (the caller decides whether to
+        degrade)."""
+        if num_servers < 1:
+            raise ValueError("num_servers must be >= 1")
+        if self._mixed and num_workers is None:
+            raise ValueError(
+                "mixed-mode reshard needs an explicit num_workers (the "
+                "colocated/non-colocated split cannot be inferred from "
+                "the server count alone)")
+        with self._lock:
+            old = (self.num_servers, self._num_workers)
+            self.num_servers = num_servers
+            if num_workers is not None:
+                self._num_workers = num_workers
+            try:
+                self._init_mixed()
+            except ValueError:
+                self.num_servers, self._num_workers = old
+                raise
+            self._cache.clear()
+            self.load_bytes = [0] * num_servers
 
     def assign(self, key: int, nbytes: int = 0) -> int:
         with self._lock:

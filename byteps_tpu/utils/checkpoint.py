@@ -150,6 +150,30 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True,
     return jax.process_index() == 0
 
 
+def pack_state(state: Any) -> bytes:
+    """Wire form of a checkpoint pytree for the survivor→rejoiner
+    parameter broadcast (fault/membership.py).
+
+    The elastic counterpart of :func:`restore_and_broadcast`: instead of
+    every replica restoring a file and the root broadcasting over the
+    mesh, one *survivor* packs its live in-memory state and the
+    membership bus carries it to the rejoining rank — same consistency
+    contract (the joiner resumes bit-identical to the sender), different
+    transport.  Device arrays are materialized to host numpy first, so
+    the bytes never reference a mesh the receiver does not have.
+    Control-plane use only: the stream is pickle over a trusted
+    intra-cluster socket, never untrusted input."""
+    import pickle
+    materialized = jax.tree.map(lambda x: np.asarray(x), state)
+    return pickle.dumps(materialized, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def unpack_state(data: bytes) -> Any:
+    """Inverse of :func:`pack_state` (host numpy leaves)."""
+    import pickle
+    return pickle.loads(data)
+
+
 def restore_and_broadcast(path: str, template: Any, *,
                           root_rank: int = 0) -> Any:
     """Restore a pytree and broadcast it from ``root_rank`` so every

@@ -27,7 +27,11 @@ Two cooperating pieces:
 The default ``on_failure``/``on_stall`` exit code is restartable: the
 launchers' ``--restart`` supervision recognizes exactly it.  For
 in-process recovery instead of exit, pass a
-:class:`byteps_tpu.fault.RecoveryCoordinator`'s ``on_failure``.
+:class:`byteps_tpu.fault.RecoveryCoordinator`'s or
+:class:`byteps_tpu.fault.ElasticMembership`'s ``on_failure`` — or
+:func:`install_failure_action` to rewire the *default* itself (covers
+the auto-armed monitor ``bps.init()`` starts under
+``BYTEPS_HEARTBEAT_ON``).
 
 Both are pure host-side Python (sockets + threads), independent of the
 JAX runtime, so they keep working exactly when the runtime doesn't.
@@ -47,6 +51,29 @@ from ..fault import injector as _fault
 
 _MAGIC = b"bpshb1 "
 
+# monkeypatch point for tests (a real os._exit would take pytest with it)
+_exit = os._exit
+
+# Process-wide pluggable default action (install_failure_action below):
+# lets elastic layers (fault.membership.ElasticMembership.on_failure,
+# fault.RecoveryCoordinator.on_failure) take over the DEFAULT escalation
+# path — including the auto-armed monitor bps.init() starts — without
+# every construction site having to thread a callback through.
+_installed_action: Optional[Callable[[Set[int]], None]] = None
+
+
+def install_failure_action(
+        action: Optional[Callable[[Set[int]], None]]
+) -> Optional[Callable[[Set[int]], None]]:
+    """Replace the default on_failure escalation (log + restartable
+    exit) with ``action`` for every monitor that uses the default.
+    Pass ``None`` to restore the exit behavior.  Returns the previously
+    installed action so callers can chain or restore it."""
+    global _installed_action
+    prev = _installed_action
+    _installed_action = action
+    return prev
+
 
 def _failure_exit_code() -> int:
     """BYTEPS_FAILURE_EXIT_CODE (default 17): the code the launchers'
@@ -60,12 +87,18 @@ def _failure_exit_code() -> int:
 
 
 def _default_on_failure(stale: Set[int]) -> None:
+    action = _installed_action
+    if action is not None:
+        # an elastic layer owns the failure path (in-place shrink
+        # instead of exit); it escalates itself if that fails
+        action(stale)
+        return
     code = _failure_exit_code()
     get_logger().error(
         "failure detector: rank(s) %s missed heartbeats — exiting %d so "
         "the launcher can restart/resume (a wedged collective cannot be "
         "cancelled in-process)", sorted(stale), code)
-    os._exit(code)
+    _exit(code)
 
 
 class HeartbeatMonitor:
@@ -265,7 +298,7 @@ class StepWatchdog:
         get_logger().error(
             "step watchdog: no progress for %.1fs — exiting %d so the "
             "launcher can restart", gap, code)
-        os._exit(code)
+        _exit(code)
 
     def start(self) -> "StepWatchdog":
         self._last = time.monotonic()

@@ -1,0 +1,205 @@
+"""Worker body for the elastic-membership chaos tests (test_elastic.py).
+
+Three (or two) real processes, each with its own engine on the virtual
+CPU mesh, share one heartbeat endpoint and one membership bus.  Each
+"training" step: the local gradient — a rank-distinct constant, so the
+cross-rank mean *changes* when the world changes — rides the engine's
+``push_pull_local`` (exercising enqueue/dispatch under every epoch),
+then ``membership.step_sync`` all-gathers the per-rank grads over the
+bus and every member applies the mean.  The data plane across
+*processes* is thus the membership bus at toy scale; that is deliberate
+(same reasoning as chaos_worker.py: an initialized JAX backend cannot
+drop a dead peer, so real cross-host collectives cannot shrink
+in-process — what these tests pin is the membership machinery itself:
+epoch agreement, stale-work drops, suspend/resume at the new size, and
+rejoin-with-state).
+
+Scenarios, driven by env:
+
+- **victim**: ``BYTEPS_FAULT_SPEC=kill:rank=R:step=K`` makes the
+  injector kill this process at its K-th push — mid-train, no cleanup.
+- **survivor**: heartbeat detects, ``ElasticMembership.on_failure``
+  shrinks in place; the worker keeps stepping to the final step and
+  prints ``FINAL <epoch> <world> <w[0]>``.
+- **die-on-detect** (``BYTEPS_ELASTIC_DIE_ON_DETECT=1``): exits the
+  moment its detector fires — manufactures a double failure *during*
+  the survivors' shrink window.
+- **rejoiner** (``BYTEPS_ELASTIC_REJOIN=1``): comes up fresh, parks on
+  the bus, and resumes from the survivor-broadcast epoch/keys/params.
+- **stale probes** (``BYTEPS_ELASTIC_STALE_PROBE=1``): after training,
+  deterministically manufactures a stale-epoch chunk (pause dispatch →
+  enqueue → advance epoch → resume) and a stale-epoch server push, and
+  asserts both are dropped, not delivered/summed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+LR = 0.1
+DIM = 8
+
+
+def _grad(rank: int) -> np.ndarray:
+    # rank-distinct so shrink/grow changes the mean: {1,4,9}/3 vs {1,9}/2
+    return np.full(DIM, float((rank + 1) ** 2), np.float32)
+
+
+def _stale_probes(api, mm) -> int:
+    """Deterministic stale-epoch drop checks (rank 0, after training)."""
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.server.engine import ServerEngine
+
+    # 1. stale CHUNK: enqueued under the current epoch, epoch advances
+    #    before dispatch → dropped with an ABORTED status, not delivered
+    eng = api._require()
+    before = counters.get("membership.stale_chunks_dropped")
+    eng.pause_dispatch()
+    h = eng.push_pull_local_async(np.ones(DIM, np.float32), "stale_probe",
+                                  op="sum")
+    mm.advance_epoch()
+    eng.resume_dispatch()
+    try:
+        h.wait(timeout=20)
+        print("STALE-CHUNK-DELIVERED", flush=True)
+        return 5
+    except RuntimeError as e:
+        if "stale membership epoch" not in str(e):
+            print("STALE-CHUNK-WRONG-ERROR", e, flush=True)
+            return 5
+    if counters.get("membership.stale_chunks_dropped") <= before:
+        print("STALE-CHUNK-NO-COUNTER", flush=True)
+        return 5
+    print("STALE-CHUNK-DROPPED", flush=True)
+
+    # 2. stale PUSH: a server push stamped with the pre-shrink epoch is
+    #    dropped at the door — the next merge must NOT include it
+    srv = ServerEngine(num_threads=1)
+    srv.set_membership_epoch(mm.current_epoch())
+    srv.push("digest", np.ones(4, np.float32), 0, 1,
+             mepoch=mm.current_epoch())
+    v1 = srv.pull("digest", timeout=10)
+    assert float(v1[0]) == 1.0, v1
+    srv.push("digest", np.full(4, 100.0, np.float32), 0, 1,
+             mepoch=mm.current_epoch() - 1)          # residue: dropped
+    srv.push("digest", np.full(4, 2.0, np.float32), 0, 1,
+             mepoch=mm.current_epoch())
+    v2 = srv.pull("digest", timeout=10)
+    srv.shutdown()
+    if float(v2[0]) != 2.0:   # 102.0 would mean the stale push summed
+        print("STALE-PUSH-SUMMED", float(v2[0]), flush=True)
+        return 5
+    if counters.get("membership.stale_pushes_dropped") < 1:
+        print("STALE-PUSH-NO-COUNTER", flush=True)
+        return 5
+    print("STALE-PUSH-DROPPED", flush=True)
+    return 0
+
+
+def main() -> int:
+    rank = int(os.environ["BYTEPS_ELASTIC_RANK"])
+    world = [int(r) for r in os.environ["BYTEPS_ELASTIC_WORLD"].split(",")]
+    bus = os.environ["BYTEPS_ELASTIC_BUS"]
+    hb_port = os.environ.get("BYTEPS_ELASTIC_HB_PORT", "")
+    n_steps = int(os.environ["BYTEPS_ELASTIC_STEPS"])
+    start_step = int(os.environ.get("BYTEPS_ELASTIC_START_STEP", "1"))
+    init_w = float(os.environ.get("BYTEPS_ELASTIC_INIT_W", "0"))
+    sleep_s = float(os.environ.get("BYTEPS_ELASTIC_STEP_SLEEP", "0.05"))
+    rejoining = os.environ.get("BYTEPS_ELASTIC_REJOIN", "") == "1"
+    die_on_detect = os.environ.get("BYTEPS_ELASTIC_DIE_ON_DETECT", "") == "1"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import byteps_tpu.core.api as api
+    from byteps_tpu.fault import membership as mm
+    from byteps_tpu.fault.membership import (ElasticMembership,
+                                             MembershipTimeout, WorldChanged)
+    from byteps_tpu.utils.failure_detector import HeartbeatMonitor
+
+    mon = None
+    if rejoining:
+        # fresh process: park on the bus, adopt epoch/keys/params from a
+        # survivor, resume mid-run (no heartbeat: the old monitors are
+        # inert after their one firing and a new one sized for the grown
+        # world would false-positive on itself)
+        m, step0, state = ElasticMembership.rejoin(rank, bus)
+        w = np.asarray(state["w"], np.float32)
+        start_step = int(step0) + 1
+        print("REJOINED", mm.current_epoch(),
+              ",".join(map(str, m.view().world)), step0, flush=True)
+    else:
+        api.init()   # arms the injector from BYTEPS_FAULT_SPEC (victim)
+        m = ElasticMembership(rank, world, bus).start()
+        w = np.full(DIM, init_w, np.float32)
+        if die_on_detect:
+            def on_failure(stale):
+                print("DIED-ON-DETECT", sorted(stale), flush=True)
+                os._exit(1)
+        else:
+            on_failure = m.on_failure
+        if hb_port:
+            mon = HeartbeatMonitor(
+                rank, len(world), "127.0.0.1:" + hb_port,
+                interval=0.08, timeout=0.7, grace=60.0,
+                on_failure=on_failure).start()
+    print("START", rank, flush=True)
+
+    step = start_step
+    retries = 0
+    while step <= n_steps:
+        if retries > 200:   # a real wedge must fail loudly, not spin
+            print("RETRY-BUDGET-EXHAUSTED at", step, flush=True)
+            return 6
+        try:
+            eng = api._require()
+            red = np.asarray(eng.push_pull_local(_grad(rank), "grad",
+                                                 op="sum"))
+        except RuntimeError:
+            # engine torn down / rebuilt by a concurrent world change on
+            # the detector thread — wait for the transition, retry
+            retries += 1
+            m.wait_ready(mm.current_epoch(), timeout=30)
+            time.sleep(0.05)
+            continue
+        try:
+            _, payloads = m.step_sync(step, payload=red,
+                                      state={"w": w, "step": step - 1})
+        except WorldChanged as e:
+            print("WORLD", e.view.epoch,
+                  ",".join(map(str, e.view.world)), "at", step, flush=True)
+            continue   # engine already on the new world; retry the step
+        except MembershipTimeout:
+            retries += 1
+            continue
+        retries = 0
+        grads = [np.asarray(p) for p in payloads.values()]
+        w = w - np.float32(LR) * (np.sum(grads, axis=0,
+                                         dtype=np.float32)
+                                  / np.float32(len(grads)))
+        step += 1
+        time.sleep(sleep_s)
+
+    assert np.all(w == w[0]), w   # uniform by construction
+    rc = 0
+    if os.environ.get("BYTEPS_ELASTIC_STALE_PROBE", "") == "1":
+        rc = _stale_probes(api, mm)
+    view = m.view()
+    print("FINAL", view.epoch, ",".join(map(str, view.world)),
+          repr(float(w[0])), flush=True)
+    if mon is not None:
+        mon.stop()
+    m.stop()
+    api.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -176,6 +176,55 @@ def test_custom_failure_exit_code_honored(tmp_path, monkeypatch):
     assert report == [0] and report.restarts == [1]
 
 
+def test_elastic_restarts_only_the_dead_rank_as_rejoiner(tmp_path):
+    """--elastic supervision: survivors never exit, so ANY nonzero exit
+    is one dead rank restarted alone — and the restarted incarnation
+    carries BYTEPS_ELASTIC_REJOIN=1 so it rejoins the running world
+    instead of re-bootstrapping."""
+    hosts = [("h0", "22"), ("h1", "22"), ("h2", "22")]
+    attempts = {"h0": 0, "h1": 0, "h2": 0}
+    remotes = {}
+
+    def fake_ssh(argv, stdout, stderr):
+        host = argv[argv.index("-p") + 2]
+        attempts[host] += 1
+        remotes.setdefault(host, []).append(argv[-1])
+        if host == "h1":
+            return 1 if attempts[host] == 1 else 0   # crash once, rejoin
+        return 0
+
+    report = dl.launch(hosts, ["x"], log_dir=str(tmp_path / "l"),
+                       ssh_runner=fake_ssh, restart_limit=2,
+                       backoff=_fast_backoff(), elastic=True)
+    assert report == [0, 0, 0]
+    assert report.restarts == [0, 1, 0]              # only the dead rank
+    assert attempts == {"h0": 1, "h1": 2, "h2": 1}
+    # every worker runs in elastic mode; only the RESTARTED incarnation
+    # is a rejoiner
+    for host in hosts:
+        assert "BYTEPS_ELASTIC=1" in remotes[host[0]][0]
+    assert "BYTEPS_ELASTIC_REJOIN=1" not in remotes["h1"][0]
+    assert "BYTEPS_ELASTIC_REJOIN=1" in remotes["h1"][1]
+    assert "BYTEPS_ELASTIC_REJOIN" not in remotes["h0"][0]
+
+
+def test_elastic_defaults_one_restart_and_cli_flag(tmp_path):
+    """--elastic with no explicit limit still restarts once (an elastic
+    world that can never re-grow is pointless); the CLI flag reaches
+    launch()."""
+    calls = []
+
+    def fake_ssh(argv, stdout, stderr):
+        calls.append(argv[-1])
+        return 3 if len(calls) == 1 else 0
+
+    report = dl.launch([("h0", "22")], ["x"], log_dir=str(tmp_path / "l"),
+                       ssh_runner=fake_ssh, backoff=_fast_backoff(),
+                       elastic=True)
+    assert report == [0] and report.restarts == [1]
+    assert "BYTEPS_ELASTIC_REJOIN=1" in calls[1]
+
+
 def test_ssh_dispatch_retry_on_raised_runner(tmp_path):
     """A raising ssh_runner (connection refused) is retried by the
     backoff policy before the launch counts it as a launcher error."""

@@ -1,0 +1,217 @@
+"""Elastic membership end-to-end (fault/membership.py + elastic_worker.py).
+
+The acceptance pins for shrink-to-survivors and in-place rejoin:
+
+- ``test_shrink_to_survivors_matches_clean_run`` — 3 real processes,
+  one killed mid-train by the fault injector; the two survivors shrink
+  in place (no process exit), finish training, and their final state
+  matches a clean 2-process run started from the state at the shrink.
+- ``test_rejoin_in_place_at_step_boundary`` — the killed rank restarts
+  and rejoins the running world at a step boundary, receiving
+  epoch/declared keys/parameters from a survivor; stale-epoch chunks
+  and server pushes manufactured after the transitions are dropped,
+  not delivered/summed.
+- ``test_double_failure_during_shrink`` — a second member dies inside
+  the shrink window (before its rendezvous hello); the rendezvous
+  times it out and the last survivor completes alone.
+
+All are ``chaos``-marked; `tools/run_chaos.sh` runs them under a hard
+per-test timeout so a wedged rendezvous fails fast.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from .conftest import free_port as _free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def _spawn(rank, world, bus_port, hb_port, steps, extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["DMLC_NUM_WORKER"] = "1"        # single-host engines; the world
+    env["DMLC_WORKER_ID"] = str(rank)   # lives in the membership layer
+    env["BYTEPS_ELASTIC_RANK"] = str(rank)
+    env["BYTEPS_ELASTIC_WORLD"] = world
+    env["BYTEPS_ELASTIC_BUS"] = f"127.0.0.1:{bus_port}"
+    env["BYTEPS_ELASTIC_HB_PORT"] = hb_port
+    env["BYTEPS_ELASTIC_STEPS"] = str(steps)
+    env["BYTEPS_MEMBERSHIP_RENDEZVOUS_TIMEOUT"] = "3"
+    env["BYTEPS_MEMBERSHIP_SYNC_TIMEOUT"] = "15"
+    env["BYTEPS_LOG_LEVEL"] = "ERROR"
+    env.pop("BYTEPS_FAULT_SPEC", None)
+    env.pop("BYTEPS_ELASTIC_REJOIN", None)
+    env.update(extra or {})
+    return subprocess.Popen([sys.executable, WORKER], env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _communicate(procs, timeout=180):
+    outs = {}
+    try:
+        for name, p in procs.items():
+            outs[name], _ = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        for p in procs.values():
+            p.kill()
+        pytest.fail("elastic workers hung; partial output: "
+                    + "".join(o[-1500:] for o in outs.values()))
+    return outs
+
+
+def _final(out):
+    """Parse the worker's 'FINAL <epoch> <world> <w0>' line."""
+    for line in out.splitlines():
+        if line.startswith("FINAL "):
+            _, epoch, world, w0 = line.split()
+            return int(epoch), world, float(w0)
+    raise AssertionError("no FINAL line in:\n" + out[-3000:])
+
+
+def _simulate(w0, ranks, n_steps):
+    """The worker's update rule, bit-for-bit (float32 ops, same order)."""
+    w = np.float32(w0)
+    for _ in range(n_steps):
+        g = (np.sum([np.float32((r + 1) ** 2) for r in ranks],
+                    dtype=np.float32) / np.float32(len(ranks)))
+        w = np.float32(w - np.float32(0.1) * g)
+    return float(w)
+
+
+@pytest.mark.chaos
+def test_shrink_to_survivors_matches_clean_run():
+    """Kill rank 1 at push step 4 of 9: ranks 0 and 2 shrink in place
+    (epoch 1, world {0,2}, no exit), finish training, and their final
+    state equals a clean 2-process {0,2} run started from the state at
+    the shrink boundary."""
+    n, kill_at = 9, 4
+    bus, hb = str(_free_port()), str(_free_port())
+    procs = {
+        r: _spawn(r, "0,1,2", bus, hb, n, extra=(
+            {"BYTEPS_FAULT_SPEC": f"kill:rank=1:step={kill_at}",
+             "BYTEPS_FAULT_SEED": "7"} if r == 1 else None))
+        for r in (0, 1, 2)}
+    outs = _communicate(procs)
+
+    # the victim really was killed mid-train (crash exit, no FINAL)
+    assert procs[1].returncode == 1, outs[1][-3000:]
+    assert "START 1" in outs[1]
+    assert "FINAL" not in outs[1]
+    # both survivors shrank in place: process exit code 0, shrink event
+    # observed, final world/epoch agreed
+    finals = {}
+    for r in (0, 2):
+        assert procs[r].returncode == 0, outs[r][-3000:]
+        assert "WORLD 1 0,2" in outs[r], outs[r][-3000:]
+        finals[r] = _final(outs[r])
+        assert finals[r][0] == 1 and finals[r][1] == "0,2", finals[r]
+    assert finals[0][2] == pytest.approx(finals[2][2], abs=1e-6)
+
+    # clean 2-process run from the same state: world {0,2} from the
+    # shrink-boundary state, steps kill_at..n
+    w_shrink = _simulate(0.0, (0, 1, 2), kill_at - 1)
+    bus2 = str(_free_port())
+    procs2 = {
+        r: _spawn(r, "0,2", bus2, "", n, extra={
+            "BYTEPS_ELASTIC_START_STEP": str(kill_at),
+            "BYTEPS_ELASTIC_INIT_W": repr(w_shrink)})
+        for r in (0, 2)}
+    outs2 = _communicate(procs2)
+    for r in (0, 2):
+        assert procs2[r].returncode == 0, outs2[r][-3000:]
+    clean = _final(outs2[0])
+    assert clean[0] == 0 and clean[1] == "0,2"
+    assert clean[2] == pytest.approx(_final(outs2[2])[2], abs=1e-6)
+    # the acceptance equivalence: elastic shrink == clean run from the
+    # same state
+    assert finals[0][2] == pytest.approx(clean[2], abs=1e-5), (
+        finals, clean, w_shrink)
+
+
+@pytest.mark.chaos
+def test_rejoin_in_place_at_step_boundary():
+    """Restart the killed rank: it rejoins at a step boundary (epoch 2)
+    with epoch/declared keys/params broadcast from a survivor, every
+    member finishes at the same state, and stale-epoch chunks/pushes
+    after the transitions are dropped, not summed."""
+    n, kill_at = 40, 4
+    bus, hb = str(_free_port()), str(_free_port())
+    procs = {
+        r: _spawn(r, "0,1,2", bus, hb, n, extra={
+            "BYTEPS_ELASTIC_STEP_SLEEP": "0.3",
+            **({"BYTEPS_FAULT_SPEC": f"kill:rank=1:step={kill_at}",
+                "BYTEPS_FAULT_SEED": "7"} if r == 1 else
+               {"BYTEPS_ELASTIC_STALE_PROBE": "1"} if r == 0 else {})})
+        for r in (0, 1, 2)}
+    # the victim dies early; restart it as a rejoiner against the same
+    # bus (what bpslaunch-dist --elastic does with BYTEPS_ELASTIC_REJOIN)
+    out_victim, _ = procs[1].communicate(timeout=120)
+    assert procs[1].returncode == 1, out_victim[-3000:]
+    rejoiner = _spawn(1, "0,1,2", bus, "", n, extra={
+        "BYTEPS_ELASTIC_REJOIN": "1",
+        "BYTEPS_ELASTIC_STEP_SLEEP": "0.3"})
+    outs = _communicate({0: procs[0], 2: procs[2], "rj": rejoiner})
+
+    # the rejoiner was admitted at a step boundary with state in hand
+    assert rejoiner.returncode == 0, outs["rj"][-3000:]
+    rejoin_line = next(l for l in outs["rj"].splitlines()
+                       if l.startswith("REJOINED "))
+    _, epoch, world, step0 = rejoin_line.split()
+    assert int(epoch) == 2 and world == "0,1,2", rejoin_line
+    assert kill_at - 1 <= int(step0) < n, rejoin_line
+    # survivors observed both transitions: shrink then grow, each at a
+    # step boundary
+    finals = {}
+    for r in (0, 2):
+        assert procs[r].returncode == 0, outs[r][-3000:]
+        assert "WORLD 1 0,2" in outs[r], outs[r][-3000:]
+        assert "WORLD 2 0,1,2" in outs[r], outs[r][-3000:]
+        finals[r] = _final(outs[r])
+        assert finals[r][0] == 2 and finals[r][1] == "0,1,2", finals[r]
+    fin_rj = _final(outs["rj"])
+    assert fin_rj[0] == 2 and fin_rj[1] == "0,1,2", fin_rj
+    # identical final state on every member — the rejoiner continued
+    # from the survivor-broadcast parameters, not from scratch
+    assert finals[0][2] == pytest.approx(finals[2][2], abs=1e-6)
+    assert finals[0][2] == pytest.approx(fin_rj[2], abs=1e-6)
+    # the deterministic stale-epoch probes (rank 0, post-training)
+    assert "STALE-CHUNK-DROPPED" in outs[0], outs[0][-3000:]
+    assert "STALE-PUSH-DROPPED" in outs[0], outs[0][-3000:]
+
+
+@pytest.mark.chaos
+def test_double_failure_during_shrink():
+    """Rank 1 is killed mid-train; rank 2 dies the moment its detector
+    fires (inside the shrink window, before its rendezvous hello).  The
+    rendezvous times rank 2 out and rank 0 completes training alone at
+    epoch 1, world {0}."""
+    n, kill_at = 9, 4
+    bus, hb = str(_free_port()), str(_free_port())
+    procs = {
+        r: _spawn(r, "0,1,2", bus, hb, n, extra=(
+            {"BYTEPS_FAULT_SPEC": f"kill:rank=1:step={kill_at}",
+             "BYTEPS_FAULT_SEED": "7"} if r == 1 else
+            {"BYTEPS_ELASTIC_DIE_ON_DETECT": "1"} if r == 2 else None))
+        for r in (0, 1, 2)}
+    outs = _communicate(procs)
+
+    assert procs[1].returncode == 1, outs[1][-3000:]
+    assert procs[2].returncode == 1, outs[2][-3000:]
+    assert "DIED-ON-DETECT" in outs[2], outs[2][-3000:]
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    epoch, world, w0 = _final(outs[0])
+    assert epoch == 1 and world == "0", (epoch, world)
+    expected = _simulate(_simulate(0.0, (0, 1, 2), kill_at - 1),
+                         (0,), n - kill_at + 1)
+    assert w0 == pytest.approx(expected, abs=1e-5), (w0, expected)

@@ -17,7 +17,10 @@ import time
 
 import pytest
 
-from byteps_tpu.utils.failure_detector import HeartbeatMonitor, StepWatchdog
+from byteps_tpu.utils import failure_detector as fd_mod
+from byteps_tpu.utils.failure_detector import (HeartbeatMonitor,
+                                               StepWatchdog,
+                                               install_failure_action)
 
 from .conftest import free_port as _free_port
 
@@ -94,6 +97,84 @@ def test_step_watchdog_stall_and_feed():
     time.sleep(1.2)  # stop feeding -> stall
     wd.stop()
     assert len(stalls) == 1 and stalls[0] > 0.5
+
+
+def test_custom_on_failure_suppresses_exit(monkeypatch):
+    """Satellite: a custom on_failure callback fully replaces the exit
+    path — os._exit is never reached, the survivor stays alive."""
+    exits = []
+    monkeypatch.setattr(fd_mod, "_exit", exits.append)
+    port = _free_port()
+    fired = []
+    done = threading.Event()
+
+    def on_failure(stale):
+        fired.append(stale)
+        done.set()
+
+    m = HeartbeatMonitor(0, 2, f"127.0.0.1:{port}", interval=0.1,
+                         timeout=0.5, grace=0.5, on_failure=on_failure)
+    m.start()
+    assert done.wait(5.0)
+    m.stop()
+    assert fired == [{1}]
+    assert exits == []           # the process would have survived
+
+
+def test_custom_on_stall_suppresses_exit(monkeypatch):
+    exits = []
+    monkeypatch.setattr(fd_mod, "_exit", exits.append)
+    stalls = []
+    wd = StepWatchdog(timeout=0.3, on_stall=stalls.append)
+    wd.start()
+    time.sleep(0.9)
+    wd.stop()
+    assert len(stalls) == 1
+    assert exits == []
+
+
+def test_default_on_failure_exits_restartable(monkeypatch):
+    """The DEFAULT action still exits with the configured restartable
+    code when nothing is installed."""
+    monkeypatch.setenv("BYTEPS_FAILURE_EXIT_CODE", "23")
+    exits = []
+    monkeypatch.setattr(fd_mod, "_exit", exits.append)
+    fd_mod._default_on_failure({1})
+    assert exits == [23]
+
+
+def test_install_failure_action_rewires_the_default(monkeypatch):
+    """install_failure_action lets an elastic layer own the DEFAULT
+    escalation (covers the auto-armed monitor) without any exit."""
+    exits = []
+    monkeypatch.setattr(fd_mod, "_exit", exits.append)
+    seen = []
+    prev = install_failure_action(seen.append)
+    try:
+        fd_mod._default_on_failure({2, 3})
+        assert seen == [{2, 3}]
+        assert exits == []
+    finally:
+        install_failure_action(prev)
+    # restored: the default exits again
+    fd_mod._default_on_failure({1})
+    assert len(exits) == 1
+
+
+def test_failure_exit_code_rejects_non_restartable_codes(monkeypatch):
+    """Satellite: BYTEPS_FAILURE_EXIT_CODE parsing rejects codes the
+    --restart supervision could not distinguish from normal exits, with
+    an error that says why."""
+    from byteps_tpu.common.config import Config
+    for bad in ("0", "1", "256", "-3"):
+        monkeypatch.setenv("BYTEPS_FAILURE_EXIT_CODE", bad)
+        with pytest.raises(ValueError, match="not restartable"):
+            Config.from_env()
+    monkeypatch.setenv("BYTEPS_FAILURE_EXIT_CODE", "copper")
+    with pytest.raises(ValueError, match="integer"):
+        Config.from_env()
+    monkeypatch.setenv("BYTEPS_FAILURE_EXIT_CODE", "23")
+    assert Config.from_env().failure_exit_code == 23
 
 
 _WORKER = r"""

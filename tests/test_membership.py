@@ -1,0 +1,370 @@
+"""Elastic membership units (fault/membership.py): epoch semantics, the
+bus protocol (sync quorum / shrink rendezvous / rejoin admission), and
+the stale-epoch guards in the engine, server engine, KV store, and
+server assigner.  The multiprocess end-to-end pins live in
+tests/test_elastic.py."""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import byteps_tpu.core.api as api
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.telemetry import counters
+from byteps_tpu.fault import membership as mm
+from byteps_tpu.fault.membership import (MembershipView, _BusServer,
+                                         _recv_obj, _send_obj)
+from byteps_tpu.server.engine import ServerEngine
+from byteps_tpu.server.kv_store import KVStore
+from byteps_tpu.server.sharding import ServerAssigner
+from byteps_tpu.utils.checkpoint import pack_state, unpack_state
+
+from .conftest import free_port as _free_port
+
+
+@pytest.fixture(autouse=True)
+def _fresh_epoch():
+    mm._reset_epoch_for_tests()
+    yield
+    if api.initialized():
+        api.shutdown()
+    api._declared_order = []
+    mm._reset_epoch_for_tests()
+
+
+def _req(port, msg, timeout=20.0):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(timeout)
+    _send_obj(s, msg)
+    reply = _recv_obj(s)
+    s.close()
+    return reply
+
+
+# -- epoch ------------------------------------------------------------------
+
+
+def test_epoch_is_monotonic():
+    assert mm.current_epoch() == 0
+    assert mm.advance_epoch() == 1
+    assert mm.set_epoch(5) == 5
+    assert mm.set_epoch(3) == 5          # never regresses
+    assert mm.current_epoch() == 5
+
+
+def test_view_basics():
+    v = MembershipView(2, (0, 2, 5))
+    assert v.num_workers == 3
+    assert v.coordinator == 0
+
+
+# -- bus: sync --------------------------------------------------------------
+
+
+def test_bus_sync_quorum_delivers_all_payloads():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(0, (0, 1)),
+                     rendezvous_timeout_s=2.0, sync_timeout_s=5.0)
+    try:
+        out = {}
+
+        def member(r):
+            out[r] = _req(port, {"op": "sync", "rank": r, "epoch": 0,
+                                 "step": 1, "payload": r * 10})
+
+        ts = [threading.Thread(target=member, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        for r in (0, 1):
+            assert out[r]["ok"], out
+            assert out[r]["payloads"] == {0: 0, 1: 10}
+    finally:
+        bus.close()
+
+
+def test_bus_sync_wrong_epoch_is_stale():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(3, (0,)),
+                     rendezvous_timeout_s=1.0, sync_timeout_s=2.0)
+    try:
+        r = _req(port, {"op": "sync", "rank": 0, "epoch": 1, "step": 7})
+        assert r == {"ok": False, "stale": True, "epoch": 3, "world": [0]}
+    finally:
+        bus.close()
+
+
+def test_bus_sync_timeout_names_the_missing():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(0, (0, 1, 2)),
+                     rendezvous_timeout_s=1.0, sync_timeout_s=0.5)
+    try:
+        r = _req(port, {"op": "sync", "rank": 0, "epoch": 0, "step": 1})
+        assert r["timeout"] and r["missing"] == [1, 2], r
+    finally:
+        bus.close()
+
+
+# -- bus: shrink rendezvous -------------------------------------------------
+
+
+def test_bus_hello_agreement_and_stale_sync_release():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(0, (0, 1, 2)),
+                     rendezvous_timeout_s=5.0, sync_timeout_s=30.0)
+    try:
+        # a survivor parked on a sync when the failure hits...
+        parked = {}
+
+        def sync_waiter():
+            parked["r"] = _req(port, {"op": "sync", "rank": 0, "epoch": 0,
+                                      "step": 4}, timeout=40.0)
+
+        t = threading.Thread(target=sync_waiter)
+        t.start()
+        # ...both survivors rendezvous for epoch 1 without rank 1
+        out = {}
+
+        def hello(r):
+            out[r] = _req(port, {"op": "hello", "rank": r, "epoch": 1,
+                                 "world": [0, 2]})
+
+        hs = [threading.Thread(target=hello, args=(r,)) for r in (0, 2)]
+        for h in hs:
+            h.start()
+        for h in hs:
+            h.join(timeout=20)
+        for r in (0, 2):
+            assert out[r] == {"ok": True, "epoch": 1, "world": [0, 2]}, out
+        # the parked sync was released as stale with the NEW view
+        t.join(timeout=20)
+        assert parked["r"]["stale"] and parked["r"]["epoch"] == 1
+        assert bus.view() == MembershipView(1, (0, 2))
+        # a straggler's hello for the already-agreed epoch just gets the
+        # current view (idempotent)
+        late = _req(port, {"op": "hello", "rank": 2, "epoch": 1,
+                           "world": [0, 2]})
+        assert late == {"ok": True, "epoch": 1, "world": [0, 2]}
+        assert counters.get("membership.shrink_agreed") >= 1
+    finally:
+        bus.close()
+
+
+def test_bus_hello_timeout_drops_nonresponders():
+    """Double failure during the shrink: the second dead member never
+    hellos; the rendezvous window expires and the agreement proceeds
+    with the responders only."""
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(0, (0, 1, 2)),
+                     rendezvous_timeout_s=0.5, sync_timeout_s=5.0)
+    try:
+        r = _req(port, {"op": "hello", "rank": 0, "epoch": 1,
+                        "world": [0, 2]})
+        assert r == {"ok": True, "epoch": 1, "world": [0]}
+        assert bus.view() == MembershipView(1, (0,))
+    finally:
+        bus.close()
+
+
+# -- bus: rejoin admission --------------------------------------------------
+
+
+def test_bus_rejoin_admitted_at_step_boundary_with_state():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(1, (0, 2)),
+                     rendezvous_timeout_s=2.0, sync_timeout_s=10.0)
+    try:
+        state = pack_state({"w": np.arange(4, dtype=np.float32),
+                            "step": np.array(6)})
+        out = {}
+
+        def rejoiner():
+            out["join"] = _req(port, {"op": "rejoin", "rank": 1},
+                               timeout=30.0)
+
+        def member(r):
+            out[r] = _req(port, {"op": "sync", "rank": r, "epoch": 1,
+                                 "step": 7, "payload": None,
+                                 "state": state,
+                                 "declared": ["a", "b"]}, timeout=30.0)
+
+        tj = threading.Thread(target=rejoiner)
+        tj.start()
+        ts = [threading.Thread(target=member, args=(r,)) for r in (0, 2)]
+        for t in ts:
+            t.start()
+        for t in ts + [tj]:
+            t.join(timeout=30)
+        # members see the admission as a world change (retry the step)
+        for r in (0, 2):
+            assert out[r]["stale"], out[r]
+            assert out[r]["epoch"] == 2 and out[r]["world"] == [0, 1, 2]
+        # the joiner received epoch, world, declared order, and the
+        # survivor's packed state for the boundary step
+        join = out["join"]
+        assert join["ok"] and join["epoch"] == 2
+        assert join["world"] == [0, 1, 2]
+        assert join["declared"] == ["a", "b"]
+        assert join["step"] == 6     # state is the post-step-6 snapshot
+        got = unpack_state(join["state"])
+        np.testing.assert_allclose(got["w"],
+                                   np.arange(4, dtype=np.float32))
+        assert counters.get("membership.rejoin_admitted") >= 1
+    finally:
+        bus.close()
+
+
+def test_bus_rejoin_times_out_without_a_quorum():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(0, (0,)),
+                     rendezvous_timeout_s=0.5, sync_timeout_s=0.5)
+    try:
+        r = _req(port, {"op": "rejoin", "rank": 9})
+        assert r == {"ok": False, "timeout": True}
+    finally:
+        bus.close()
+
+
+# -- engine epoch guard -----------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_stale_epoch_chunk_dropped_not_delivered():
+    """A chunk enqueued before a world change is dropped at dispatch
+    with an ABORTED status naming the stale epoch — and fresh pushes
+    under the new epoch flow normally."""
+    counters.reset()
+    api.init(Config())
+    eng = api._require()
+    eng.pause_dispatch()
+    h = eng.push_pull_local_async(np.ones(8, np.float32), "g", op="sum")
+    mm.advance_epoch()
+    eng.resume_dispatch()
+    with pytest.raises(RuntimeError, match="stale membership epoch"):
+        h.wait(timeout=20)
+    assert counters.get("membership.stale_chunks_dropped") >= 1
+    out = eng.push_pull_local(np.ones(8, np.float32), "g", op="sum")
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+@pytest.mark.chaos
+def test_stale_epoch_chunk_dropped_at_completion():
+    """The syncer-side guard: a chunk that was already ISSUED when the
+    epoch moved is dropped at completion (the result was computed over
+    a dead mesh)."""
+    api.init(Config())
+    eng = api._require()
+    h = eng.push_pull_local_async(np.ones(8, np.float32), "g", op="sum")
+    # freeze the syncer behind the runtime lock is racy; instead bump
+    # after enqueue and rely on whichever guard (dispatch or finish)
+    # catches it — both must produce the same recognizable ABORT
+    mm.advance_epoch()
+    with pytest.raises(RuntimeError, match="stale membership epoch"):
+        h.wait(timeout=20)
+
+
+# -- server engine / kv store epoch gates ----------------------------------
+
+
+def test_server_engine_drops_stale_membership_push():
+    counters.reset()
+    srv = ServerEngine(num_threads=1)
+    srv.push("k", np.ones(4, np.float32), 0, 1, mepoch=0)
+    assert float(srv.pull("k", timeout=10)[0]) == 1.0
+    srv.set_membership_epoch(2)
+    assert srv.membership_epoch == 2
+    srv.set_membership_epoch(1)          # monotonic: no regress
+    assert srv.membership_epoch == 2
+    # residue from the dead world: dropped, not summed
+    srv.push("k", np.full(4, 100.0, np.float32), 0, 1, mepoch=0)
+    srv.push("k", np.full(4, 2.0, np.float32), 0, 1, mepoch=2)
+    assert float(srv.pull("k", timeout=10)[0]) == 2.0
+    assert counters.get("membership.stale_pushes_dropped") == 1
+    # un-stamped pushes (non-elastic callers) are never gated
+    srv.push("k", np.full(4, 3.0, np.float32), 0, 1)
+    assert float(srv.pull("k", timeout=10)[0]) == 3.0
+    srv.shutdown()
+
+
+def test_kv_store_drops_stale_membership_delta():
+    counters.reset()
+    kv = KVStore()
+    kv.init_key("w", np.zeros(4, np.float32))
+    assert kv.push_delta("w", np.ones(4), mepoch=0) == 1
+    kv.set_membership_epoch(3)
+    v = kv.push_delta("w", np.full(4, 50.0), mepoch=0)   # stale: dropped
+    assert v == 1                                        # version unchanged
+    np.testing.assert_allclose(kv.pull("w"), 1.0)
+    assert kv.push_delta("w", np.ones(4), mepoch=3) == 2
+    np.testing.assert_allclose(kv.pull("w"), 2.0)
+    assert counters.get("membership.stale_pushes_dropped") == 1
+
+
+# -- assigner resharding / mixed-mode config wiring -------------------------
+
+
+def test_assigner_reshard_rehashes_and_resets_load():
+    a = ServerAssigner(num_servers=4, fn="djb2")
+    keys = list(range(64))
+    before = {k: a.assign(k, 100) for k in keys}
+    assert any(s >= 2 for s in before.values())
+    a.reshard(2)
+    assert a.load_bytes == [0, 0]        # accounting restarts
+    after = {k: a.assign(k, 1) for k in keys}
+    assert all(0 <= s < 2 for s in after.values())
+    # deterministic: re-assignment equals a fresh 2-server assigner
+    fresh = ServerAssigner(num_servers=2, fn="djb2")
+    assert after == {k: fresh.assign(k) for k in keys}
+    with pytest.raises(ValueError):
+        a.reshard(0)
+
+
+def test_assigner_mixed_mode_from_env(monkeypatch):
+    """Satellite: BYTEPS_ENABLE_MIXED_MODE / BYTEPS_MIXED_MODE_BOUND
+    reach ServerAssigner through Config env parsing (previously
+    programmatic-only)."""
+    from byteps_tpu.common.config import reset_config
+    monkeypatch.setenv("BYTEPS_ENABLE_MIXED_MODE", "1")
+    monkeypatch.setenv("BYTEPS_MIXED_MODE_BOUND", "120")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "3")
+    reset_config()
+    a = ServerAssigner(num_servers=5)
+    assert a._mixed and a._bound == 120 and a._num_workers == 3
+    # and the mixed constraint still validates through the env path
+    monkeypatch.setenv("BYTEPS_MIXED_MODE_BOUND", "2")   # < num_servers
+    reset_config()
+    with pytest.raises(ValueError, match="MIXED_MODE_BOUND"):
+        ServerAssigner(num_servers=5)
+    reset_config()
+
+
+def test_assigner_mixed_reshard_violation_restores_shape():
+    a = ServerAssigner(num_servers=5, fn="djb2", mixed_mode=True,
+                       num_workers=3, bound=101)
+    with pytest.raises(ValueError):
+        a.reshard(1, num_workers=0)      # nonsense shape
+    assert a.num_servers == 5 and a._num_workers == 3
+    # the split is deployment-specific: guessing it would silently
+    # misroute, so a mixed reshard without num_workers refuses
+    with pytest.raises(ValueError, match="explicit num_workers"):
+        a.reshard(4)
+    assert a.num_servers == 5 and a._num_workers == 3
+
+
+# -- state wire form --------------------------------------------------------
+
+
+def test_pack_unpack_state_roundtrip():
+    import jax.numpy as jnp
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "opt": {"m": np.ones(3)},
+             "step": 17}
+    got = unpack_state(pack_state(state))
+    np.testing.assert_allclose(got["w"], np.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(got["opt"]["m"], 1.0)
+    assert int(got["step"]) == 17
+    assert isinstance(got["w"], np.ndarray)   # host-materialized

@@ -1,0 +1,62 @@
+"""Per-test hard timeout for the chaos lane (tools/run_chaos.sh).
+
+The chaos tests spawn real processes and park on rendezvous barriers;
+a wedged rendezvous must fail ONE test fast, not eat the whole tier-1
+time budget.  pytest-timeout is not in the image, so this is the
+minimal POSIX equivalent: SIGALRM around each test phase, raising a
+``ChaosTimeout`` in the main thread — which interrupts blocking socket
+reads and ``subprocess`` waits exactly where a wedge would park.
+
+Usage (what run_chaos.sh does):
+
+    pytest -p tools.chaos_timeout_plugin --chaos-timeout 120 -m chaos
+
+Main-thread only by design: worker threads are daemonic in this
+codebase and die with the test process; the failure modes worth
+bounding (multiprocess communicate(), bus rendezvous) all block the
+main thread.
+"""
+
+from __future__ import annotations
+
+import signal
+
+import pytest
+
+
+class ChaosTimeout(Exception):
+    pass
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--chaos-timeout", type=float, default=120.0, metavar="SECONDS",
+        help="hard per-test timeout for the chaos lane (SIGALRM; "
+             "0 disables)")
+
+
+def _limit(seconds: float):
+    def _on_alarm(signum, frame):
+        raise ChaosTimeout(
+            f"chaos test exceeded its {seconds:.0f}s hard timeout "
+            "(wedged rendezvous / hung worker process?)")
+    signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+
+
+def _clear():
+    signal.setitimer(signal.ITIMER_REAL, 0)
+    signal.signal(signal.SIGALRM, signal.SIG_DFL)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    seconds = item.config.getoption("--chaos-timeout")
+    if seconds and seconds > 0:
+        _limit(seconds)
+        try:
+            yield
+        finally:
+            _clear()
+    else:
+        yield

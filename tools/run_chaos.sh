@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Chaos lane: the fault-injection / kill-and-recover / elastic-membership
+# tests (pytest -m chaos), with TWO layers of wedge protection:
+#
+#   1. a hard per-test timeout (tools/chaos_timeout_plugin.py, SIGALRM):
+#      a wedged rendezvous or hung worker process fails ITS test fast
+#      with a traceback instead of parking pytest forever;
+#   2. an outer `timeout -k` on the whole lane as the backstop for
+#      anything the in-process alarm cannot interrupt.
+#
+# Usage:  tools/run_chaos.sh [extra pytest args...]
+# Env:    CHAOS_TEST_TIMEOUT  per-test seconds   (default 120)
+#         CHAOS_LANE_TIMEOUT  whole-lane seconds (default 600)
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+PER_TEST="${CHAOS_TEST_TIMEOUT:-120}"
+LANE="${CHAOS_LANE_TIMEOUT:-600}"
+
+exec timeout -k 15 "$LANE" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    -p tools.chaos_timeout_plugin --chaos-timeout "$PER_TEST" \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    "$@"
