@@ -147,6 +147,27 @@ class Config:
     #                                  barrier quorum window; a member
     #                                  missing past it is failure evidence
 
+    # --- data integrity (common/integrity.py) ---
+    integrity_on: bool = True        # BYTEPS_INTEGRITY: CRC32C-checksummed
+    #                                  envelopes + non-finite quarantine on
+    #                                  every host-crossing payload (server
+    #                                  pushes, KV deltas, membership bus,
+    #                                  rejoin state); 0 = zero-overhead off
+    integrity_max_retransmits: int = 3
+    #                                  BYTEPS_INTEGRITY_MAX_RETRANSMITS:
+    #                                  bounded retransmit budget after a
+    #                                  CRC NACK (from the sender's source
+    #                                  copy; past it the push fails loudly)
+    nonfinite_policy: str = "raise"  # BYTEPS_NONFINITE_POLICY: what a
+    #                                  receiver does with NaN/Inf
+    #                                  contributions/merges —
+    #                                  raise | skip (quarantine the round,
+    #                                  republish the previous merge) | zero
+    bus_max_frame: int = 1 << 30     # BYTEPS_BUS_MAX_FRAME: membership-bus
+    #                                  frame-size clamp; a corrupt length
+    #                                  prefix fails the connection instead
+    #                                  of parking a multi-petabyte recv
+
     # --- fault injection (fault/injector.py) ---
     fault_spec: str = ""             # BYTEPS_FAULT_SPEC: chaos schedule
     #                                  (kill:rank=1:step=40, delay:site=dcn:
@@ -205,6 +226,14 @@ class Config:
             raise ValueError("membership timeouts must be positive")
         if not 0 <= self.membership_port < 65536:
             raise ValueError("membership_port must be in 0..65535")
+        if self.nonfinite_policy not in ("raise", "skip", "zero"):
+            raise ValueError(
+                f"BYTEPS_NONFINITE_POLICY must be raise, skip, or zero — "
+                f"got {self.nonfinite_policy!r}")
+        if self.integrity_max_retransmits < 0:
+            raise ValueError("integrity_max_retransmits must be >= 0")
+        if self.bus_max_frame <= 0:
+            raise ValueError("bus_max_frame must be positive")
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -247,6 +276,12 @@ class Config:
             heartbeat_timeout_s=_env_float("BYTEPS_HEARTBEAT_TIMEOUT",
                                            30.0),
             failure_exit_code=_env_int("BYTEPS_FAILURE_EXIT_CODE", 17),
+            integrity_on=_env_bool("BYTEPS_INTEGRITY", True),
+            integrity_max_retransmits=_env_int(
+                "BYTEPS_INTEGRITY_MAX_RETRANSMITS", 3),
+            nonfinite_policy=_env_str("BYTEPS_NONFINITE_POLICY",
+                                      "raise").strip().lower(),
+            bus_max_frame=_env_int("BYTEPS_BUS_MAX_FRAME", 1 << 30),
             fault_spec=_env_str("BYTEPS_FAULT_SPEC", ""),
             fault_seed=_env_int("BYTEPS_FAULT_SEED", 0),
             restart_limit=_env_int("BYTEPS_RESTART_LIMIT", 0),

@@ -1,0 +1,403 @@
+"""End-to-end data integrity: checksummed wire envelopes + quarantine.
+
+The reference's PS wire path (ps-lite over ZMQ/RDMA) inherits
+transport-level integrity from TCP, but every *host-side* hop in this
+rebuild — ``ServerEngine.push``, ``KVStore.push_delta*``, the membership
+bus, ``pack_state`` rejoin blobs — carries raw arrays with no corruption,
+duplication, or sanity checks.  Gradient compression makes that worse:
+one flipped bit in an entropy-coded payload decodes into a many-element
+error no value check can localize.  Detection therefore lives in our own
+envelope around the wire bytes, not in the codec.
+
+Three cooperating pieces:
+
+**Envelope** — a CRC32C-checksummed, sequence-numbered frame wrapped
+around every host-crossing payload::
+
+    !4s  magic  b"BPSE"
+    !B   version (1)
+    !B   kind    (1 = ndarray, 2 = opaque bytes)
+    !H   key length
+    !q   worker rank   (-1 = not a per-worker hop)
+    !Q   sequence number
+    !H   dtype-string length   (0 for kind=bytes)
+    !B   ndim                  (0 for kind=bytes)
+    !Q   payload length
+    key utf-8 | dtype utf-8 | ndim x !Q dims | payload | !I CRC32C(all prior)
+
+The CRC covers header *and* payload, so a flip that mangles the shape,
+the dtype, the sequence token, or the data itself is equally detected
+(CRC32C catches all single-bit and all burst-<=32-bit errors).
+``open_*`` raises :class:`IntegrityError` — the receiver's NACK — and
+the sender retransmits from its source copy (``server/engine.py``,
+``server/kv_store.py``) under ``BYTEPS_INTEGRITY_MAX_RETRANSMITS``.
+
+**Sequence tokens** — a per-(key, worker) monotonic counter lets the
+receiver drop duplicates (``KVStore`` dedup): a retry after a lost ack
+can never double-sum a delta in async mode (idempotent pushes).
+
+**Non-finite quarantine** — :func:`nonfinite_policy` selects what a
+receiver does with NaN/Inf contributions or merges
+(``BYTEPS_NONFINITE_POLICY=raise|skip|zero``); the policy mechanics live
+at the receivers, the shared helpers live here.
+
+Zero-overhead when ``BYTEPS_INTEGRITY=0``: every call site guards with
+:func:`enabled` — nothing is sealed, hashed, or allocated.
+
+CRC32C backend resolution (first available wins, cached):
+``native/core.cc bps_crc32c`` (slice-by-8, no-copy ctypes) →
+``google_crc32c`` → a pure-Python table (correct, slow — last resort).
+All three agree on the Castagnoli check value
+(``crc32c(b"123456789") == 0x%08X`` :data:`_CHECK`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from .logging import get_logger
+from .telemetry import counters
+
+__all__ = [
+    "IntegrityError", "AckLost", "EnvelopeMeta", "enabled",
+    "nonfinite_policy", "max_retransmits", "crc32c", "seal_array",
+    "seal_bytes", "open_array", "open_bytes", "open_frame", "is_frame",
+    "wire_transmit", "screen_nonfinite", "record_span",
+]
+
+MAGIC = b"BPSE"
+VERSION = 1
+KIND_NDARRAY = 1
+KIND_BYTES = 2
+
+# magic, version, kind, key_len, worker, seq, dtype_len, ndim, payload_len
+_FIXED = struct.Struct("!4sBBHqQHBQ")
+_DIM = struct.Struct("!Q")
+_CRC = struct.Struct("!I")
+_CHECK = 0xE3069283  # CRC32C(b"123456789"), the Castagnoli check value
+
+
+class IntegrityError(ValueError):
+    """A frame failed verification — the receiver's NACK.  The sender
+    retransmits from its source copy; past the retransmit budget the
+    error propagates to the caller."""
+
+
+class AckLost(ConnectionError):
+    """The receiver applied the push but the acknowledgement was lost
+    (chaos ``drop:site=kv_push``).  The sender retries with the SAME
+    sequence token; the receiver's dedup makes the retry a no-op, so
+    at-most-once summation survives the retry."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvelopeMeta:
+    """Verified header fields of an opened frame."""
+
+    kind: int
+    key: str
+    worker: int
+    seq: int
+    dtype: Optional[np.dtype] = None
+    shape: Tuple[int, ...] = ()
+
+
+# -- config accessors (read through the live Config so tests that reset
+#    the environment see the change; get_config() caches after first use) --
+
+def enabled() -> bool:
+    from .config import get_config
+    return get_config().integrity_on
+
+
+def nonfinite_policy() -> str:
+    from .config import get_config
+    return get_config().nonfinite_policy
+
+
+def max_retransmits() -> int:
+    from .config import get_config
+    return get_config().integrity_max_retransmits
+
+
+# -- CRC32C backend ---------------------------------------------------------
+
+_crc_impl: Optional[Callable[[bytes, int], int]] = None
+
+
+def _py_table():
+    poly = 0x82F63B78  # reflected Castagnoli
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (poly ^ (c >> 1)) if (c & 1) else (c >> 1)
+        table.append(c)
+    return table
+
+
+def _pick_impl() -> Callable[[bytes, int], int]:
+    try:  # native slice-by-8 (core.cc): fastest, releases the GIL in C
+        from ..native import crc32c as native_crc
+        if native_crc(b"123456789") == _CHECK:
+            return native_crc
+    except Exception:  # noqa: BLE001 — build/toolchain absent: fall back
+        pass
+    try:
+        import google_crc32c
+
+        def _google(data: bytes, crc: int = 0) -> int:
+            return google_crc32c.extend(crc, bytes(data))
+
+        if _google(b"123456789") == _CHECK:
+            return _google
+    except Exception:  # noqa: BLE001 — wheel absent: pure-Python floor
+        pass
+    table = _py_table()
+
+    def _pure(data: bytes, crc: int = 0) -> int:
+        c = ~crc & 0xFFFFFFFF
+        for b in bytes(data):
+            c = table[(c ^ b) & 0xFF] ^ (c >> 8)
+        return ~c & 0xFFFFFFFF
+
+    return _pure
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data``, optionally continuing ``crc``."""
+    global _crc_impl
+    if _crc_impl is None:
+        _crc_impl = _pick_impl()
+    return _crc_impl(data, crc)
+
+
+# -- sealing ----------------------------------------------------------------
+
+def _seal(kind: int, key: str, worker: int, seq: int, dtype_s: str,
+          shape: Tuple[int, ...], payload: bytes) -> bytes:
+    kb = key.encode("utf-8")
+    db = dtype_s.encode("ascii")
+    head = _FIXED.pack(MAGIC, VERSION, kind, len(kb), worker, seq,
+                       len(db), len(shape), len(payload))
+    parts = [head, kb, db, *(_DIM.pack(d) for d in shape), payload]
+    crc = 0
+    for part in parts:  # incremental: no body-copy just to append 4 bytes
+        crc = crc32c(part, crc)
+    parts.append(_CRC.pack(crc))
+    return b"".join(parts)
+
+
+def seal_array(arr, *, key: str, seq: int = 0, worker: int = -1) -> bytes:
+    """Wrap an ndarray for a host hop; shape/dtype ride the header so a
+    shape-mangled frame is as detectable as a flipped data bit."""
+    a = np.asarray(arr)
+    shape = a.shape  # ascontiguousarray promotes 0-d to (1,): keep ours
+    a = np.ascontiguousarray(a)
+    return _seal(KIND_NDARRAY, key, worker, seq, a.dtype.str, shape,
+                 a.tobytes())
+
+
+def seal_bytes(data: bytes, *, key: str, seq: int = 0,
+               worker: int = -1) -> bytes:
+    """Wrap an opaque byte payload (compressed codec wire, pickle blobs)."""
+    return _seal(KIND_BYTES, key, worker, seq, "", (), bytes(data))
+
+
+def envelope_overhead(key: str) -> int:
+    """Bytes :func:`seal_bytes` adds around a payload, so a sender can
+    budget a size clamp without paying the full CRC+copy of a seal that
+    the clamp would only throw away."""
+    return _FIXED.size + len(key.encode("utf-8")) + _CRC.size
+
+
+def is_frame(data: bytes) -> bool:
+    """Cheap sniff: does this blob start like an envelope?  Lets
+    receivers accept both sealed and legacy-raw senders."""
+    return len(data) >= _FIXED.size + _CRC.size and data[:4] == MAGIC
+
+
+# -- opening (verify-on-receive) --------------------------------------------
+
+def open_frame(frame: bytes) -> Tuple[Any, EnvelopeMeta]:
+    """Verify and unwrap one frame; returns ``(payload, meta)`` where
+    payload is an ndarray (kind=1) or bytes (kind=2).
+
+    Raises :class:`IntegrityError` — magic/version mismatch, CRC32C
+    mismatch, or any internal length inconsistency.  The CRC is checked
+    FIRST, so no header field (lengths included) is ever trusted before
+    it has been authenticated against the checksum."""
+    if len(frame) < _FIXED.size + _CRC.size:
+        raise IntegrityError(
+            f"frame truncated: {len(frame)} bytes < minimum "
+            f"{_FIXED.size + _CRC.size}")
+    if bytes(frame[:4]) != MAGIC:
+        raise IntegrityError(f"bad magic {frame[:4]!r} (not an envelope)")
+    # memoryview slices: a 100 MB gradient frame is opened on every push
+    # (and again per retransmit), so the body/payload views must not
+    # each memcpy the whole payload
+    mv = memoryview(frame)
+    body, trailer = mv[:-_CRC.size], mv[-_CRC.size:]
+    (want,) = _CRC.unpack(trailer)
+    got = crc32c(body)
+    if got != want:
+        raise IntegrityError(
+            f"CRC32C mismatch: frame carries 0x{want:08x}, payload hashes "
+            f"to 0x{got:08x}")
+    (magic, version, kind, key_len, worker, seq, dtype_len, ndim,
+     payload_len) = _FIXED.unpack_from(body)
+    if version != VERSION:
+        raise IntegrityError(f"envelope version {version} != {VERSION}")
+    off = _FIXED.size
+    want_len = off + key_len + dtype_len + ndim * _DIM.size + payload_len
+    if want_len != len(body):
+        raise IntegrityError(
+            f"frame length {len(body)} != header-declared {want_len}")
+    key = bytes(body[off:off + key_len]).decode("utf-8", errors="replace")
+    off += key_len
+    dtype_s = bytes(body[off:off + dtype_len]).decode("ascii",
+                                                      errors="replace")
+    off += dtype_len
+    shape = tuple(_DIM.unpack_from(body, off + i * _DIM.size)[0]
+                  for i in range(ndim))
+    off += ndim * _DIM.size
+    payload = body[off:off + payload_len]
+    if kind == KIND_BYTES:
+        return bytes(payload), EnvelopeMeta(kind, key, worker, seq)
+    if kind != KIND_NDARRAY:
+        raise IntegrityError(f"unknown payload kind {kind}")
+    try:
+        dtype = np.dtype(dtype_s)
+    except TypeError:
+        raise IntegrityError(f"bad dtype string {dtype_s!r}") from None
+    numel = 1
+    for d in shape:
+        numel *= d
+    if dtype.itemsize == 0 or numel * dtype.itemsize != payload_len:
+        raise IntegrityError(
+            f"shape-mangled frame: {shape}/{dtype} needs "
+            f"{numel * dtype.itemsize} bytes, payload is {payload_len}")
+    arr = np.frombuffer(payload, dtype=dtype).reshape(shape)
+    return arr, EnvelopeMeta(kind, key, worker, seq, dtype, shape)
+
+
+def open_array(frame: bytes) -> Tuple[np.ndarray, EnvelopeMeta]:
+    payload, meta = open_frame(frame)
+    if meta.kind != KIND_NDARRAY:
+        raise IntegrityError(
+            f"expected an ndarray frame, got kind {meta.kind}")
+    return payload, meta
+
+
+def open_bytes(frame: bytes) -> Tuple[bytes, EnvelopeMeta]:
+    payload, meta = open_frame(frame)
+    if meta.kind != KIND_BYTES:
+        raise IntegrityError(f"expected a bytes frame, got kind {meta.kind}")
+    return payload, meta
+
+
+# -- the chaos-instrumented wire hop (shared by every receiver) -------------
+
+def wire_transmit(frame: bytes, *, key: str, worker: int, seq: int,
+                  site: str, opener: Callable, who: str,
+                  on_reject: Optional[Callable[[], None]] = None):
+    """Transmit ``frame`` across the chaos-instrumented hop ``site`` and
+    verify on receive; the one NACK/retransmit state machine behind both
+    ``ServerEngine`` and ``KVStore``.
+
+    A failed verification is the NACK (``integrity.crc_reject``,
+    ``on_reject`` for per-receiver accounting): the frame is
+    retransmitted from the sealed SOURCE copy — never from the
+    possibly-corrupt received bytes — up to
+    ``BYTEPS_INTEGRITY_MAX_RETRANSMITS`` times
+    (``integrity.retransmit``); past the budget the
+    :class:`IntegrityError` propagates to the caller.  Retransmit storms
+    land a tracing span."""
+    from .retry import RetryPolicy
+    from ..fault import injector as _fault
+    budget = max_retransmits()
+    attempts = {"n": 0}
+    t0 = time.monotonic()
+
+    def transmit():
+        attempts["n"] += 1
+        if attempts["n"] > 1:
+            counters.inc("integrity.retransmit")
+        wire = frame
+        if _fault.ENABLED:
+            wire = _fault.corrupt_bytes(site, wire)
+            _fault.fire(site)
+        try:
+            payload, _meta = opener(wire)
+        except IntegrityError as e:
+            counters.inc("integrity.crc_reject")
+            if on_reject is not None:
+                on_reject()
+            get_logger().warning(
+                "%s: NACK %r seq %d worker %d (attempt %d/%d): %s",
+                who, key, seq, worker, attempts["n"], budget + 1, e)
+            raise
+        return payload
+
+    policy = RetryPolicy(max_attempts=budget + 1, base_delay_s=0.0,
+                         max_delay_s=0.0, retry_on=(IntegrityError,))
+    out = policy.call(transmit, describe=f"{who} {key!r} wire")
+    if attempts["n"] > 1:
+        record_span("retransmit", t0, key=key, worker=worker, seq=seq,
+                    attempts=attempts["n"])
+    return out
+
+
+# -- non-finite quarantine helpers ------------------------------------------
+
+def screen_nonfinite(arr: np.ndarray, *, what: str, key: str,
+                     worker: int) -> Optional[np.ndarray]:
+    """Screen one contribution under the process policy.
+
+    Returns the array to merge (possibly zero-patched), or ``None`` when
+    the policy is ``skip`` (the caller quarantines the round / drops the
+    delta).  ``raise`` raises ValueError naming the blamed worker — the
+    corrupt gradient never reaches a merge buffer."""
+    if not np.issubdtype(arr.dtype, np.inexact):
+        return arr
+    finite = np.isfinite(arr)
+    if finite.all():
+        return arr
+    n_bad = int(arr.size - np.count_nonzero(finite))
+    policy = nonfinite_policy()
+    if policy == "zero":
+        counters.inc("integrity.nonfinite_zeroed")
+        get_logger().warning(
+            "integrity: zeroed %d non-finite element(s) in %s %r from "
+            "worker %d", n_bad, what, key, worker)
+        return np.nan_to_num(arr, nan=0.0, posinf=0.0, neginf=0.0)
+    if policy == "skip":
+        counters.inc("integrity.nonfinite_skipped")
+        get_logger().error(
+            "integrity: skipped %s %r — %d non-finite element(s), blamed "
+            "worker %d", what, key, n_bad, worker)
+        return None
+    counters.inc("integrity.nonfinite_rejected")
+    raise ValueError(
+        f"{what} {key!r}: {n_bad} non-finite element(s) from worker "
+        f"{worker} (BYTEPS_NONFINITE_POLICY=raise)")
+
+
+# -- tracing ----------------------------------------------------------------
+
+def record_span(name: str, t0: float, **meta) -> None:
+    """Integrity event span into the live engine's tracer (best-effort,
+    same placement as ElasticMembership._record_span — retransmit storms
+    and quarantines must be visible in the chrome timeline)."""
+    try:
+        from ..core import api
+        eng = api._require()
+        eng.tracer.record_span(f"integrity.{name}", t0, time.monotonic(),
+                               **meta)
+    except Exception:  # noqa: BLE001 — tracing is best-effort
+        pass

@@ -9,11 +9,18 @@ subsystem: injected faults (``fault.kill`` / ``fault.delay`` /
 ``fault.bitflip`` / ``fault.straggler`` / ``fault.drop``), retry
 attempts (``retry.attempt`` / ``retry.gave_up``), recovery stages
 (``recovery.attempt`` / ``recovery.completed`` / ``recovery.failed``),
-and elastic-membership transitions (``membership.shrink_started`` /
+elastic-membership transitions (``membership.shrink_started`` /
 ``shrink_agreed`` / ``shrink`` / ``grow`` / ``rejoin_requested`` /
 ``rejoin_admitted`` / ``rejoined`` / ``shrink_failed`` plus the epoch
 guards ``membership.stale_chunks_dropped`` /
-``membership.stale_pushes_dropped``) all increment the module singleton
+``membership.stale_pushes_dropped``), and the data-integrity layer
+(``integrity.crc_reject`` — frames NACKed by a CRC32C/shape check,
+``integrity.retransmit`` — envelope retransmissions,
+``integrity.dup_dropped`` — idempotence dedup hits, and the non-finite
+quarantine ``integrity.nonfinite_rejected`` / ``nonfinite_skipped`` /
+``nonfinite_zeroed`` / ``quarantine_dropped`` — late same-round pushes
+discarded after their round was quarantined) all increment the module
+singleton
 :data:`counters`, so a chaos run is inspectable after the fact.
 """
 

@@ -30,7 +30,11 @@ Sites (where the hooks are woven):
   (core/engine.py)
 - ``dcn``    — collective dispatch (comm/collectives.py)
 - ``server_push`` / ``server_pull`` — ServerEngine entry points
-  (server/engine.py); ``bitflip`` corrupts the pushed value here
+  (server/engine.py); ``bitflip`` corrupts the pushed value (or, with
+  integrity envelopes armed, the sealed wire frame) here
+- ``kv_push`` — KVStore delta pushes (server/kv_store.py); ``bitflip``
+  corrupts the wire frame, ``drop`` loses the *acknowledgement* after
+  the delta applied (the duplicate-retry scenario the seq dedup absorbs)
 - ``heartbeat`` — the heartbeat client's UDP send
   (utils/failure_detector.py); ``drop`` suppresses the datagram
 
@@ -67,11 +71,11 @@ _active: Optional["FaultInjector"] = None
 _exit = os._exit
 
 VALID_KINDS = ("bitflip", "delay", "drop", "kill", "straggler")
-VALID_SITES = ("dcn", "dispatch", "heartbeat", "server_pull",
+VALID_SITES = ("dcn", "dispatch", "heartbeat", "kv_push", "server_pull",
                "server_push", "sync")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
-CORRUPT_SITES = ("server_push",)
+CORRUPT_SITES = ("kv_push", "server_push")
 _FIELDS = ("rank", "step", "site", "p", "ms", "code")
 # fields each kind actually reads — anything else is rejected, not
 # silently ignored (kill:p=0.1 must fail loudly, not kill
@@ -323,3 +327,15 @@ def should_drop(site: str) -> bool:
 
 def corrupt(site: str, arr):
     return arr if _active is None else _active.corrupt(site, arr)
+
+
+def corrupt_bytes(site: str, data: bytes) -> bytes:
+    """Byte-payload twin of :func:`corrupt` for wire frames (integrity
+    envelopes, compressed codec payloads): one random bit of the frame
+    is flipped when a bitflip rule fires at ``site``."""
+    if _active is None or not data:
+        return data
+    import numpy as np
+    view = np.frombuffer(data, dtype=np.uint8)
+    out = _active.corrupt(site, view)
+    return data if out is view else out.tobytes()

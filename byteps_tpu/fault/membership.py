@@ -154,16 +154,55 @@ class _BusUnreachable(ConnectionError):
     """Transient: the coordinator is dead/moving; retried with backoff."""
 
 
-# -- wire helpers (length-prefixed pickle over a trusted local socket) ------
+class _BusFrameError(_BusUnreachable):
+    """A RECEIVED bus frame failed a sanity or integrity check.  The
+    connection is failed loudly (server side logs and closes; client
+    side retries with a fresh connection under the bounded backoff
+    policy — the corruption is plausibly transient) — never acted on."""
+
+
+class _BusFrameTooLarge(ValueError):
+    """Deterministic sender-side refusal: the frame WE are about to send
+    exceeds ``BYTEPS_BUS_MAX_FRAME``.  Deliberately NOT a
+    :class:`_BusUnreachable` (nor an ``OSError``): retrying cannot
+    succeed until the operator raises the env var, and each retry would
+    re-pickle and re-CRC a multi-gigabyte rejoin state for nothing."""
+
+
+# -- wire helpers (length-prefixed pickle over a trusted local socket,
+#    CRC32C-enveloped when BYTEPS_INTEGRITY is armed) -----------------------
 
 def _send_obj(sock: socket.socket, obj: Any) -> None:
+    from ..common import integrity as _integrity
+    from ..common.config import get_config
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sealing = _integrity.enabled()
+    max_frame = get_config().bus_max_frame
+    wire_len = len(data) + (
+        _integrity.envelope_overhead("membership-bus") if sealing else 0)
+    if wire_len > max_frame:
+        # fail at the SENDER, before shipping gigabytes the receiver's
+        # clamp would reject anyway (and misattribute to corruption) —
+        # and before CRC'ing and copying them into an envelope this
+        # refusal would only discard
+        raise _BusFrameTooLarge(
+            f"refusing to send a {wire_len}-byte bus frame > "
+            f"BYTEPS_BUS_MAX_FRAME={max_frame}; legitimately large rejoin "
+            "states need BYTEPS_BUS_MAX_FRAME raised on every member")
+    if sealing:
+        # membership frames carry epochs, worlds, and rejoin parameter
+        # blobs — a silently corrupt one could commit a wrong world or
+        # hand a joiner bad weights, so they ride the same envelope as
+        # every other host hop
+        data = _integrity.seal_bytes(data, key="membership-bus")
     # 8-byte length prefix: a rejoin state payload is a whole model's
     # parameters and can exceed the 4 GiB a 32-bit prefix could frame
     sock.sendall(struct.pack("!Q", len(data)) + data)
 
 
 def _recv_obj(sock: socket.socket) -> Any:
+    from ..common import integrity as _integrity
+    from ..common.config import get_config
     buf = b""
     while len(buf) < 8:
         chunk = sock.recv(8 - len(buf))
@@ -171,13 +210,40 @@ def _recv_obj(sock: socket.socket) -> Any:
             raise _BusUnreachable("bus connection closed mid-frame")
         buf += chunk
     (n,) = struct.unpack("!Q", buf)
+    max_frame = get_config().bus_max_frame
+    if n > max_frame:
+        # an 8-byte prefix is the first thing a corrupt stream mangles:
+        # trusting it unconditionally would park this thread on a
+        # multi-petabyte recv.  Clamp and fail the connection instead.
+        raise _BusFrameError(
+            f"bus frame length {n} exceeds BYTEPS_BUS_MAX_FRAME="
+            f"{max_frame} — corrupt length prefix or misbehaving peer "
+            "(senders clamp too, so a legitimately large rejoin state "
+            "would have failed at its sender: raise BYTEPS_BUS_MAX_FRAME "
+            "on every member); failing the connection")
     data = b""
     while len(data) < n:
         chunk = sock.recv(min(65536, n - len(data)))
         if not chunk:
             raise _BusUnreachable("bus connection closed mid-frame")
         data += chunk
-    return pickle.loads(data)
+    if _integrity.is_frame(data):
+        try:
+            data, _ = _integrity.open_bytes(data)
+        except _integrity.IntegrityError as e:
+            counters.inc("integrity.crc_reject")
+            raise _BusFrameError(
+                f"bus frame failed integrity verification: {e}") from None
+    try:
+        return pickle.loads(data)
+    except Exception as e:
+        # a flip in the envelope's 4 magic bytes defeats the is_frame
+        # sniff and lands the raw envelope (or otherwise-corrupt bytes)
+        # here — that is still wire corruption and must fail through the
+        # retriable _BusFrameError path, not an unclassified
+        # UnpicklingError that skips the caller's backoff/close handling
+        counters.inc("integrity.crc_reject")
+        raise _BusFrameError(f"bus frame failed to unpickle: {e}") from None
 
 
 class _BusServer:
@@ -258,7 +324,17 @@ class _BusServer:
                 reply = self._do_rejoin(msg)
             else:
                 reply = {"ok": False, "error": f"unknown op {op!r}"}
-            _send_obj(conn, reply)
+            try:
+                _send_obj(conn, reply)
+            except _BusFrameTooLarge as e:
+                # the reply (e.g. a rejoin state snapshot) exceeds the
+                # coordinator's BYTEPS_BUS_MAX_FRAME: a silent close
+                # would have the joiner retry a deterministic failure
+                # under backoff — answer with a small error naming the
+                # knob instead, so the client fails fast and loudly
+                get_logger().warning(
+                    "membership bus: reply for op %r too large: %s", op, e)
+                _send_obj(conn, {"ok": False, "error": str(e)})
         except Exception:  # noqa: BLE001 — a broken/dead client connection
             # must not take the bus down; the client side has its own
             # retry/timeout story
@@ -623,7 +699,10 @@ class ElasticMembership:
         if state is not None and self._join_hint:
             if not isinstance(state, bytes):
                 from ..utils.checkpoint import pack_state
-                state = pack_state(state)
+                # seal=False: the bus frame (_send_obj) already envelopes
+                # this whole message — double-sealing a multi-GB state
+                # would double the rejoin's CRC and copy cost
+                state = pack_state(state, seal=False)
             msg["state"] = state
             msg["declared"] = self._declared_order()
         reply = self._request(msg, timeout=self.sync_timeout_s + 15.0)

@@ -12,6 +12,8 @@ workers.
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -19,7 +21,26 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..common import integrity as _integrity
+from ..common.logging import get_logger
+from ..fault import membership as _membership
+from ..common.retry import RetryPolicy
 from ..server import KVStore
+
+# Default sender identities: the store dedups by (key, worker) sequence
+# floor, so two senders sharing a worker id would swallow each other's
+# pushes as "duplicates".  One optimizer per process (the normal
+# deployment) gets the host id unchanged (n=0); extra in-process
+# instances (tests, multi-worker simulations sharing one store) get
+# distinct high ids so their seq streams never collide.
+_sender_ids = itertools.count()
+_sender_lock = threading.Lock()
+
+
+def _default_sender_id(host_id: int) -> int:
+    with _sender_lock:
+        n = next(_sender_ids)
+    return host_id if n == 0 else (n << 20) | host_id
 
 
 class AsyncDistributedOptimizer:
@@ -28,18 +49,28 @@ class AsyncDistributedOptimizer:
     def __init__(self, tx: optax.GradientTransformation,
                  store: Optional[KVStore] = None,
                  name_prefix: str = "async",
-                 compression: Optional[dict] = None):
+                 compression: Optional[dict] = None,
+                 worker_id: Optional[int] = None):
         """``compression``: the engine's kwargs dict (compressor/ef/...)
         — weight deltas then cross the worker->store boundary as
         wire-encoded compressed payloads (the reference's async +
         compressed combination), with per-leaf worker-side compressor
-        state (error feedback) held here."""
+        state (error feedback) held here.
+
+        ``worker_id`` (default: the process's ``DMLC_WORKER_ID``) plus a
+        per-leaf monotonic sequence counter make every push idempotent:
+        a retry after a lost ack (chaos ``drop:site=kv_push`` →
+        :class:`integrity.AckLost`) is deduplicated by the store and can
+        never double-sum a delta."""
         self._tx = tx
         self._store = store if store is not None else KVStore()
         self._prefix = name_prefix
         self._names = None
         self._compression = dict(compression) if compression else None
         self._codecs = {}       # name -> (worker_comp, state)
+        self._worker_id = worker_id
+        self._seqs = {}         # name -> last sequence token issued
+        self._ack_retry = None  # built at init() (config is live there)
 
     @property
     def store(self) -> KVStore:
@@ -53,6 +84,13 @@ class AsyncDistributedOptimizer:
         """Registers every parameter leaf with the store (the init-push
         barrier of the reference, server.cc:261-289) and returns optax
         state."""
+        from ..common.config import get_config
+        cfg = get_config()
+        if self._worker_id is None:
+            self._worker_id = _default_sender_id(cfg.host_id)
+        self._ack_retry = RetryPolicy.from_config(
+            cfg, retry_on=(_integrity.AckLost,), base_delay_s=0.0,
+            max_delay_s=0.0)
         self._names = self._leaf_names(params)
         for name, leaf in zip(self._names,
                               jax.tree_util.tree_leaves(params)):
@@ -88,6 +126,14 @@ class AsyncDistributedOptimizer:
         fresh = []
         for name, old, new in zip(self._names, leaves_old, leaves_new):
             delta = np.asarray(new) - np.asarray(old)
+            seq = self._seqs[name] = self._seqs.get(name, 0) + 1
+            # stamp the membership epoch ONCE per logical push, outside
+            # the ack-retry loop: a retry that crosses an elastic world
+            # change must carry the OLD epoch so the store's stale gate
+            # drops it — re-reading the epoch inside the retry would let
+            # the duplicate through the cleared dedup floors and
+            # double-sum (see KVStore.set_membership_epoch)
+            mepoch = _membership.current_epoch()
             if self._compression is not None:
                 # compressed wire push (reference async + compressed):
                 # worker-side chain (EF state threaded here) encodes the
@@ -96,8 +142,23 @@ class AsyncDistributedOptimizer:
                 payload, st = wc.compress(
                     jnp.asarray(delta.reshape(-1)), st)
                 self._codecs[name] = (wc, st)
-                self._store.push_delta_wire(name, wc.wire_encode(payload))
+                wire = wc.wire_encode(payload)
+                push = lambda: self._store.push_delta_wire(  # noqa: E731
+                    name, wire, worker_id=self._worker_id, seq=seq,
+                    mepoch=mepoch)
             else:
-                self._store.push_delta(name, delta)
+                push = lambda: self._store.push_delta(  # noqa: E731
+                    name, delta, worker_id=self._worker_id, seq=seq,
+                    mepoch=mepoch)
+            try:
+                self._ack_retry.call(push, describe=f"async push {name}")
+            except _integrity.AckLost:
+                # every ack of every retry was dropped — but AckLost is
+                # only ever raised AFTER the delta applied, and the seq
+                # token made the retries no-ops, so the sum is correct;
+                # log and move on rather than killing the training loop
+                get_logger().warning(
+                    "async push %s: ack lost on every attempt; delta "
+                    "landed exactly once (seq dedup)", name)
             fresh.append(jnp.asarray(self._store.pull(name)))
         return jax.tree_util.tree_unflatten(treedef, fresh), state

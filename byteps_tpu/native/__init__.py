@@ -66,7 +66,7 @@ def load() -> Optional[ctypes.CDLL]:
                 _compile(path)
                 lib = ctypes.CDLL(path)
             _declare_signatures(lib)
-            if lib.bps_native_abi_version() != 2:
+            if lib.bps_native_abi_version() != 3:
                 raise RuntimeError("native ABI mismatch")
             _lib = lib
         except Exception:
@@ -134,6 +134,8 @@ def _declare_signatures(lib: ctypes.CDLL) -> None:
     lib.bps_elias_decode.restype = i64
     lib.bps_elias_decode.argtypes = [ctypes.POINTER(ctypes.c_uint32), i64,
                                      ctypes.POINTER(ctypes.c_int8), i64]
+    lib.bps_crc32c.restype = ctypes.c_uint32
+    lib.bps_crc32c.argtypes = [ctypes.c_char_p, i64, ctypes.c_uint32]
     lib.bps_native_abi_version.restype = ctypes.c_int
 
 
@@ -325,3 +327,23 @@ def elias_decode(words: np.ndarray, nbits: int,
     if rc != 0:
         raise ValueError("malformed elias-delta stream")
     return out
+
+
+# ------------------------------------------------------------------- crc32c
+
+def crc32c(data: bytes, crc: int = 0) -> Optional[int]:
+    """CRC32C (Castagnoli) over ``data``, continuing ``crc``; None when
+    the native core is unavailable (common/integrity.py falls back to
+    google_crc32c or its pure-Python table)."""
+    lib = load()
+    if lib is None:
+        return None
+    mv = memoryview(data)
+    if not mv.c_contiguous:
+        mv = memoryview(bytes(mv))
+    # np.frombuffer exposes the address of a READ-ONLY buffer (ctypes
+    # from_buffer refuses those), so a memoryview of a 100 MB frame is
+    # checksummed without an extra memcpy
+    view = np.frombuffer(mv, dtype=np.uint8)
+    ptr = view.ctypes.data_as(ctypes.c_char_p)
+    return int(lib.bps_crc32c(ptr, view.nbytes, crc & 0xFFFFFFFF))

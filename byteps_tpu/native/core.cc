@@ -424,6 +424,78 @@ int64_t bps_elias_decode(const uint32_t* words, int64_t nbits,
   return 0;
 }
 
-int bps_native_abi_version() { return 2; }
+// ------------------------------------------------------------------ crc32c
+//
+// CRC32C (Castagnoli) for the integrity envelopes (common/integrity.py):
+// every host-crossing payload — server pushes, async-PS deltas, membership
+// bus frames, rejoin state blobs — is framed and verified with this
+// checksum.  Slice-by-8 software implementation (~1 GB/s at -O3): fast
+// enough that the envelope never becomes the wire bottleneck, with no ISA
+// dependency (no SSE4.2 requirement).
+
+namespace {
+
+struct Crc32cTables {
+  uint32_t t[8][256];
+  Crc32cTables() {
+    const uint32_t kPoly = 0x82f63b78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? (kPoly ^ (c >> 1)) : (c >> 1);
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = t[0][i];
+      for (int s = 1; s < 8; ++s) {
+        c = t[0][c & 0xff] ^ (c >> 8);
+        t[s][i] = c;
+      }
+    }
+  }
+};
+
+const Crc32cTables kCrc;
+
+inline uint32_t crc32c_byte(uint32_t crc, uint8_t b) {
+  return kCrc.t[0][(crc ^ b) & 0xff] ^ (crc >> 8);
+}
+
+inline bool host_is_little_endian() {
+  const uint16_t probe = 1;
+  uint8_t low;
+  std::memcpy(&low, &probe, 1);
+  return low == 1;
+}
+
+}  // namespace
+
+// Continue `crc` (0 to start) over n bytes; returns the finalized value.
+uint32_t bps_crc32c(const uint8_t* p, int64_t n, uint32_t crc) {
+  crc = ~crc;
+  if (host_is_little_endian()) {
+    while (n > 0 && (reinterpret_cast<uintptr_t>(p) & 7)) {
+      crc = crc32c_byte(crc, *p++);
+      --n;
+    }
+    while (n >= 8) {
+      uint64_t v;
+      std::memcpy(&v, p, 8);
+      v ^= crc;
+      crc = kCrc.t[7][v & 0xff] ^ kCrc.t[6][(v >> 8) & 0xff] ^
+            kCrc.t[5][(v >> 16) & 0xff] ^ kCrc.t[4][(v >> 24) & 0xff] ^
+            kCrc.t[3][(v >> 32) & 0xff] ^ kCrc.t[2][(v >> 40) & 0xff] ^
+            kCrc.t[1][(v >> 48) & 0xff] ^ kCrc.t[0][(v >> 56) & 0xff];
+      p += 8;
+      n -= 8;
+    }
+  }
+  while (n > 0) {
+    crc = crc32c_byte(crc, *p++);
+    --n;
+  }
+  return ~crc;
+}
+
+int bps_native_abi_version() { return 3; }
 
 }  // extern "C"

@@ -28,11 +28,13 @@ from __future__ import annotations
 from collections import deque
 import itertools
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..common import integrity as _integrity
 from ..common.logging import get_logger
 from ..common.retry import RetryPolicy
 from ..common.telemetry import counters
@@ -51,6 +53,10 @@ class _Msg:
     seq: int = 0        # arrival order, stamped by PriorityQueue.push
     epoch: int = 0      # key epoch at push time; bumped by reset_key so
     #                     pre-reset residue in the queues is dropped
+    round_no: int = 0   # push-side merge round this message belongs to —
+    #                     lets a quarantine drop exactly the blamed
+    #                     round's queued messages, not earlier complete
+    #                     rounds still waiting in the queue
 
 
 class PriorityQueue:
@@ -137,7 +143,9 @@ class _Codec:
 
 class _KeyState:
     __slots__ = ("merged", "count", "version", "parked", "lock",
-                 "submitted", "shape", "dtype", "poisoned", "epoch")
+                 "submitted", "shape", "dtype", "poisoned", "epoch",
+                 "published", "round_pushed", "drop_once", "known_workers",
+                 "round_no", "merge_round", "quarantined_rounds")
 
     def __init__(self):
         self.merged: Optional[np.ndarray] = None
@@ -148,6 +156,38 @@ class _KeyState:
         self.dtype = None
         self.poisoned = False   # poisoned until reset_key(): merge failed
         self.epoch = 0          # bumped by reset_key()
+        self.published: Optional[np.ndarray] = None
+        #                         last COMPLETED merge (aliases merged at
+        #                         publish time; COPY_FIRST rebinds merged to
+        #                         a fresh buffer, leaving this intact) — what
+        #                         a non-finite quarantine republishes
+        self.round_pushed: set = set()
+        #                         worker ids that entered the current round
+        #                         (push side; cleared when all num_workers
+        #                         have) — lets a quarantine know which
+        #                         workers' round-k pushes are still inbound
+        self.drop_once: set = set()
+        #                         workers whose NEXT push belongs to a
+        #                         quarantined round and must be dropped,
+        #                         not counted into the restarted round
+        self.known_workers: set = set()
+        #                         every worker id that has ever pushed this
+        #                         key — after an elastic shrink the survivor
+        #                         world keeps ORIGINAL ranks (e.g. {0, 2}
+        #                         with num_workers=2), so a quarantine must
+        #                         not derive the inbound-push set from
+        #                         range(num_workers) alone
+        self.round_no = 0       # push-side round id (incremented when a
+        #                         round is fully entered); stamped onto
+        #                         every queued message
+        self.merge_round = -1   # round id currently being merged (set at
+        #                         COPY_FIRST) — tells a quarantine whether
+        #                         the partial sum in ``merged`` belongs to
+        #                         the blamed round or an earlier one
+        self.quarantined_rounds: set = set()
+        #                         round ids whose queued messages must be
+        #                         dropped at _process; pruned as later
+        #                         rounds stream past (per-key FIFO)
         self.parked: List[Callable[[Optional[np.ndarray]], None]] = []
         self.lock = threading.Lock()
 
@@ -173,6 +213,9 @@ class ServerEngine:
         # with another epoch arrive from a world that no longer exists
         # and are dropped, not summed
         self._membership_epoch = _membership.current_epoch()
+        # integrity envelope sequence numbers (one counter per engine; the
+        # (key, worker) identity rides the frame header)
+        self._wire_seq = itertools.count(1)
         self._states: Dict[str, _KeyState] = {}
         self._codecs: Dict[str, "_Codec"] = {}
         self._states_lock = threading.Lock()
@@ -215,6 +258,16 @@ class ServerEngine:
         dropped at the door instead of poisoning a merge round."""
         if epoch > self._membership_epoch:
             self._membership_epoch = epoch
+            # a world change invalidates the quarantine bookkeeping: a
+            # one-shot drop armed against a departed rank must not fire
+            # on its REJOINED incarnation's first push, and known ids
+            # repopulate from the new world's actual pushes
+            with self._states_lock:
+                states = list(self._states.values())
+            for st in states:
+                with st.lock:
+                    st.drop_once.clear()
+                    st.known_workers.clear()
             get_logger().warning(
                 "server engine: membership epoch now %d; differently "
                 "stamped pushes will be dropped", epoch)
@@ -243,13 +296,51 @@ class ServerEngine:
                 "%d (current %d)", key, mepoch, self._membership_epoch)
             return
         arr = np.asarray(value)
-        if _fault.ENABLED:
-            # chaos sites: bitflip corrupts this worker's contribution
-            # (simulated wire corruption); delay stalls the push
+        if _integrity.enabled():
+            # the loopback wire: seal → (chaos corrupts the frame) →
+            # verify-on-receive, with bounded NACK-driven retransmit from
+            # the sealed source copy.  A frame still corrupt past the
+            # budget raises IntegrityError to the caller.
+            arr = self._wire_recv_array(key, arr, worker_id)
+        elif _fault.ENABLED:
+            # integrity off: the bitflip lands silently in this worker's
+            # contribution — the unprotected baseline the envelope fixes
             arr = np.asarray(_fault.corrupt("server_push", arr))
             _fault.fire("server_push")
+        self._push_checked(key, arr, worker_id, num_workers)
+
+    def _push_checked(self, key: str, arr: np.ndarray, worker_id: int,
+                      num_workers: int) -> None:
+        """Post-wire half of push(): non-finite screen, shape/dtype
+        validation, round accounting, enqueue."""
         st = self._state(key)
+        if _integrity.enabled():
+            with st.lock:
+                st.known_workers.add(worker_id)
+                if self._drop_if_quarantined(st, key, worker_id):
+                    return
+            arr = _integrity.screen_nonfinite(arr, what="push", key=key,
+                                              worker=worker_id)
+            if arr is None:  # skip policy: quarantine the whole round
+                # atomic with the drop_once re-check: a quarantine that
+                # fired while this push was being screened already dropped
+                # it, and its non-finite values must not quarantine the
+                # restarted round too
+                with st.lock:
+                    if self._drop_if_quarantined(st, key, worker_id):
+                        return
+                    quarantined = self._quarantine_round_locked(
+                        st, key, worker_id, num_workers)
+                self._fulfill_quarantined(key, quarantined)
+                return
         with st.lock:
+            # re-checked atomically with round entry: a quarantine firing
+            # between the pre-screen check and here would otherwise count
+            # this stale round-k push into the restarted round AND leave
+            # the one-shot drop armed against the next legitimate push
+            if _integrity.enabled() and self._drop_if_quarantined(
+                    st, key, worker_id):
+                return
             if st.poisoned:
                 raise RuntimeError(f"key {key!r} is poisoned by an "
                                    "earlier merge failure")
@@ -259,11 +350,116 @@ class ServerEngine:
                 raise ValueError(
                     f"push({key!r}): {arr.shape}/{arr.dtype} != "
                     f"established {st.shape}/{st.dtype}")
+            st.round_pushed.add(worker_id)
+            round_no = st.round_no
+            if len(st.round_pushed) >= num_workers:
+                st.round_pushed.clear()  # the round is fully entered
+                st.round_no += 1
             st.submitted += 1
             epoch = st.epoch
         q = self.queues[self.thread_id(key, arr.nbytes)]
         q.push(_Msg(key=key, value=arr, worker_id=worker_id,
-                    num_workers=num_workers, epoch=epoch))
+                    num_workers=num_workers, epoch=epoch,
+                    round_no=round_no))
+
+    # -- the loopback wire (integrity envelopes) ---------------------------
+
+    def _wire_recv_array(self, key: str, arr: np.ndarray,
+                         worker_id: int) -> np.ndarray:
+        seq = next(self._wire_seq)
+        frame = _integrity.seal_array(arr, key=key, seq=seq,
+                                      worker=worker_id)
+        return _integrity.wire_transmit(
+            frame, key=key, worker=worker_id, seq=seq, site="server_push",
+            opener=_integrity.open_array, who="server engine")
+
+    def _drop_if_quarantined(self, st: "_KeyState", key: str,
+                             worker_id: int) -> bool:
+        """Caller holds ``st.lock``.  True when this worker's in-flight
+        push belongs to a round that was quarantined before it landed:
+        counting it into the restarted round would phase-shift every
+        later merge by one contribution."""
+        if worker_id not in st.drop_once:
+            return False
+        st.drop_once.discard(worker_id)
+        counters.inc("integrity.quarantine_dropped")
+        get_logger().warning(
+            "server engine: dropped push(%r) from worker %d — "
+            "its round was quarantined", key, worker_id)
+        return True
+
+    def _quarantine_round_locked(self, st: "_KeyState", key: str,
+                                 blamed: int, num_workers: int) -> tuple:
+        """Abandon the round the blamed push was entering after a skipped
+        non-finite contribution, *without* wedging it: that round's
+        already-queued messages are marked droppable (``round_no``-scoped
+        — earlier fully-entered rounds still waiting in the queue merge
+        and publish normally), workers whose same-round push is still
+        inbound are marked for a one-shot drop (their late arrival must
+        not be counted into the restarted round), the round accounting
+        restarts, and parked pulls are answered with the previous
+        completed merge — the round's result is REPUBLISHED rather than
+        advanced.  Shape/dtype survive (same world, same geometry); a
+        first-round quarantine has nothing to republish, so its parked
+        pulls stay parked for the next round.
+
+        Caller holds ``st.lock`` so the decision to quarantine and the
+        round restart are one atomic step (two concurrent non-finite
+        pushers must produce ONE quarantine — the second pusher lands in
+        ``drop_once`` and is dropped, not a second round restart).
+        Returns ``(parked, out, version, t0)`` for
+        :meth:`_fulfill_quarantined` to answer outside the lock."""
+        t0 = time.monotonic()
+        q_round = st.round_no   # the round the blamed push was entering
+        st.quarantined_rounds.add(q_round)
+        # round-q messages already queued: every worker in round_pushed
+        # enqueued exactly one (the blamed push was screened before it
+        # could), minus any _process already merged
+        inflight_q = len(st.round_pushed)
+        if st.count and st.merge_round == q_round:
+            # part of the quarantined round is already in the partial
+            # sum — discard it; COPY_FIRST of the next surviving round
+            # rebinds ``merged``
+            inflight_q -= st.count
+            st.count = 0
+            st.merged = st.published
+        # pre-deduct the to-be-dropped messages so pull's in-flight
+        # check (submitted == 0) never waits on a round that will not
+        # publish; _process skips the decrement for quarantined drops
+        st.submitted -= inflight_q
+        # workers that have neither entered this round nor are the blamed
+        # one will still send their round-k contribution — drop exactly
+        # one push each.  range(num_workers) covers the contiguous-rank
+        # convention (incl. a first-round quarantine before anyone else
+        # pushed); known_workers covers post-shrink worlds that keep
+        # ORIGINAL ranks (e.g. {0, 2} with num_workers=2).  Ghost ids the
+        # union may arm for a world that shrank are cleared on the next
+        # membership-epoch adoption.
+        st.drop_once |= ((st.known_workers | set(range(num_workers)))
+                         - st.round_pushed - {blamed})
+        st.round_pushed.clear()
+        st.round_no = q_round + 1
+        version = st.version
+        # flush parked pulls with the previous merge ONLY when no earlier
+        # fully-entered round is still in flight — otherwise that round's
+        # own publish (which this quarantine must not discard) answers
+        # them with the value they were actually waiting for
+        if st.published is not None and st.submitted <= 0:
+            parked, st.parked = st.parked, []
+            out = st.published
+        else:
+            parked, out = [], None
+        return parked, out, version, t0
+
+    def _fulfill_quarantined(self, key: str, quarantined: tuple) -> None:
+        parked, out, version, t0 = quarantined
+        for fulfill in parked:
+            fulfill(np.array(out, copy=True), version)
+        _integrity.record_span("quarantine", t0, key=key,
+                               republished_version=version)
+        get_logger().error(
+            "server engine: round for key %r quarantined — previous merge "
+            "version %d republished", key, version)
 
     def pull(self, key: str, timeout: Optional[float] = None,
              retry: Optional[RetryPolicy] = None) -> np.ndarray:
@@ -308,8 +504,12 @@ class ServerEngine:
             # (count == 0) — a popped-but-unfinished round would otherwise
             # leak one worker's raw contribution (arrival-order semantics
             # of the reference handler: a pull enqueued after a round's
-            # pushes waits for that round)
-            if st.version > 0 and st.submitted == 0 and st.count == 0:
+            # pushes waits for that round).  ``merged`` can be None with
+            # version > 0 after reset_key (version survives a reset so
+            # pull caches never regress) — park until the next round
+            # completes instead of answering with nothing
+            if (st.version > 0 and st.submitted == 0 and st.count == 0
+                    and st.merged is not None):
                 return np.array(st.merged, copy=True), st.version
             st.parked.append(fulfill)
         if not ev.wait(timeout):
@@ -348,7 +548,13 @@ class ServerEngine:
         """Push one worker's wire-encoded payload; decompressed here (the
         caller's thread — same placement as shape validation) and merged
         by the engine threads like any dense push.  A stale ``mepoch``
-        is dropped before the decode even runs."""
+        is dropped before the decode even runs.
+
+        With integrity armed, the envelope wraps the *compressed wire
+        bytes* — exactly what a real network hop would carry.  A corrupt
+        frame is NACKed and retransmitted BEFORE ``wire_decode`` ever
+        runs: one flipped bit in an entropy-coded payload would otherwise
+        decode into an undetectable many-element error."""
         if mepoch is not None and mepoch != self._membership_epoch:
             counters.inc("membership.stale_pushes_dropped")
             get_logger().warning(
@@ -357,6 +563,18 @@ class ServerEngine:
                 self._membership_epoch)
             return
         comp = self._codec(key).comp
+        if _integrity.enabled():
+            seq = next(self._wire_seq)
+            frame = _integrity.seal_bytes(data, key=key, seq=seq,
+                                          worker=worker_id)
+            data = _integrity.wire_transmit(
+                frame, key=key, worker=worker_id, seq=seq,
+                site="server_push", opener=_integrity.open_bytes,
+                who="server engine")
+            value = np.asarray(comp.decompress(comp.wire_decode(
+                bytes(data))))
+            self._push_checked(key, value, worker_id, num_workers)
+            return
         value = np.asarray(comp.decompress(comp.wire_decode(data)))
         self.push(key, value, worker_id, num_workers)
 
@@ -407,10 +625,16 @@ class ServerEngine:
         with st.lock:
             st.poisoned = False
             st.merged = None
+            st.published = None
             st.count = 0
             st.submitted = 0
             st.shape = None
             st.dtype = None
+            st.round_pushed.clear()
+            st.drop_once.clear()
+            st.known_workers.clear()
+            st.quarantined_rounds.clear()
+            st.merge_round = -1
             st.epoch += 1   # queued pre-reset messages become droppable
             parked, st.parked = st.parked, []
         for fulfill in parked:
@@ -448,6 +672,7 @@ class ServerEngine:
                     st.poisoned = True
                     st.count = 0
                     st.merged = None
+                    st.published = None
                     parked, st.parked = st.parked, []
                 q.clear_counter(msg.key)
                 for fulfill in parked:
@@ -461,11 +686,21 @@ class ServerEngine:
                 # this message was counted under — merging it would seed
                 # the fresh round with a dead worker's contribution
                 return
+            if msg.round_no in st.quarantined_rounds:
+                # the round was quarantined after this push was queued;
+                # its submitted share was already deducted at quarantine
+                return
             st.submitted -= 1
+            if st.quarantined_rounds:
+                # per-key FIFO: once a later round's message arrives, no
+                # more messages of an earlier quarantined round can follow
+                st.quarantined_rounds = {
+                    r for r in st.quarantined_rounds if r > msg.round_no}
             if st.poisoned:
                 return  # drop: messages queued before the poison landed
             if st.count == 0:
                 # COPY_FIRST: first worker replaces last round's merge
+                st.merge_round = msg.round_no
                 st.merged = np.array(msg.value, copy=True)
             else:
                 # SUM_RECV: native multithreaded in-place sum
@@ -477,12 +712,53 @@ class ServerEngine:
                     msg.key, st.count, msg.num_workers,
                     float(np.sum(st.merged)))
             if st.count >= msg.num_workers:
-                # ALL_RECV: publish + flush parked pulls
+                # ALL_RECV: screen, publish + flush parked pulls
                 st.count = 0
-                st.version += 1
                 q.clear_counter(msg.key)
+                if (_integrity.enabled()
+                        and np.issubdtype(st.merged.dtype, np.inexact)
+                        and not np.isfinite(st.merged).all()):
+                    # contributions screened finite can still merge
+                    # non-finite (overflow, inf + -inf); the policy
+                    # decides before anything is published
+                    if not self._screen_merged(st, msg.key):
+                        return
+                st.version += 1
+                st.published = st.merged
                 parked, st.parked = st.parked, []
                 out = st.merged
                 version = st.version
                 for fulfill in parked:
                     fulfill(np.array(out, copy=True), version)
+
+    def _screen_merged(self, st: _KeyState, key: str) -> bool:
+        """Policy gate for a non-finite MERGED result (caller holds
+        ``st.lock`` and has already zeroed the round count).  True →
+        publish (possibly zero-patched); False → the previous completed
+        merge was republished in place.  ``raise`` raises — _run's
+        handler poisons the key, composing with reset_key exactly like
+        any other merge failure."""
+        policy = _integrity.nonfinite_policy()
+        if policy == "zero":
+            counters.inc("integrity.nonfinite_zeroed")
+            get_logger().warning(
+                "server engine: zeroed non-finite elements in merged "
+                "result for key %r", key)
+            np.nan_to_num(st.merged, copy=False, nan=0.0, posinf=0.0,
+                          neginf=0.0)
+            return True
+        if policy == "skip":
+            counters.inc("integrity.nonfinite_skipped")
+            get_logger().error(
+                "server engine: merged result for key %r is non-finite — "
+                "republishing previous merge version %d", key, st.version)
+            st.merged = st.published
+            if st.published is not None:
+                parked, st.parked = st.parked, []
+                for fulfill in parked:
+                    fulfill(np.array(st.published, copy=True), st.version)
+            return False
+        counters.inc("integrity.nonfinite_rejected")
+        raise RuntimeError(
+            f"merged result for key {key!r} is non-finite "
+            "(BYTEPS_NONFINITE_POLICY=raise); key poisoned")

@@ -11,6 +11,24 @@ Reference behavior being reproduced (server.cc):
   collapse away: summation here is numpy on the host (or the engine's
   collective when several local ranks contribute one delta each).
 
+Data integrity (common/integrity.py, BYTEPS_INTEGRITY):
+- every delta crosses a CRC32C-verified envelope hop (chaos site
+  ``kv_push``); a corrupt frame is NACKed and retransmitted from the
+  sealed source copy, never decoded or summed;
+- pushes carrying a ``(worker_id, seq)`` token are **idempotent**: a
+  retry after a lost ack (``drop:site=kv_push``, raised to the caller as
+  :class:`integrity.AckLost` AFTER the sum applied) is dropped by the
+  per-(key, worker) monotonic dedup — async mode can never double-sum;
+- non-finite deltas and non-finite merge results go through the
+  ``BYTEPS_NONFINITE_POLICY`` quarantine (``skip`` leaves the stored
+  value at its previous version);
+- :attr:`wire_bytes` counts only bytes that *landed*;
+  :attr:`wire_bytes_wasted` counts retransmitted and duplicate-dropped
+  frames, so compression-ratio accounting stays meaningful under chaos.
+  Both are denominated in wire-ENCODED (compressed) bytes — raw
+  ``push_delta`` traffic never touches either (its rejects show up in
+  ``integrity.crc_reject``/``integrity.retransmit``).
+
 Single-process scope: this store backs the async training mode for all
 ranks under one controller.  A cross-host replicated store (gossip over
 DCN collectives) is the natural extension and rides the same interface.
@@ -18,13 +36,16 @@ DCN collectives) is the natural extension and rides the same interface.
 
 from __future__ import annotations
 
+import itertools
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..common import integrity as _integrity
 from ..common.logging import get_logger
 from ..common.telemetry import counters
+from ..fault import injector as _fault
 from ..fault import membership as _membership
 from ..native import inplace_add, load as _native_load
 
@@ -35,7 +56,11 @@ class KVStore:
         self._store: Dict[str, np.ndarray] = {}
         self._versions: Dict[str, int] = {}
         self._codecs: Dict[str, object] = {}
-        self.wire_bytes = 0  # total compressed bytes pushed (accounting)
+        self.wire_bytes = 0         # compressed bytes that LANDED (summed)
+        self.wire_bytes_wasted = 0  # retransmitted + duplicate-dropped bytes
+        # per-(key, worker) highest sequence token seen — the dedup floor
+        self._seen: Dict[Tuple[str, int], int] = {}
+        self._wire_seq = itertools.count(1)
         # membership-epoch gate (fault/membership.py): deltas stamped
         # with another epoch are dropped, not summed
         self._membership_epoch = _membership.current_epoch()
@@ -44,10 +69,20 @@ class KVStore:
         _native_load()
 
     def set_membership_epoch(self, epoch: int) -> None:
-        """Adopt a new membership epoch (monotonic); see ServerEngine."""
+        """Adopt a new membership epoch (monotonic); see ServerEngine.
+
+        The dedup floors reset with the world: a rejoined incarnation of
+        a dead rank restarts its sequence counter at 1, and holding it
+        to the dead incarnation's floor would silently dup-drop every
+        delta it ever pushes (mirrors ServerEngine clearing
+        drop_once/known_workers on adoption).  The cross-boundary
+        retry-dup window this reopens is closed by the mepoch gate: a
+        retry of a pre-change push still carries the old epoch and is
+        dropped as stale in :meth:`_stale`."""
         with self._lock:
             if epoch > self._membership_epoch:
                 self._membership_epoch = epoch
+                self._seen.clear()
 
     def _stale(self, key: str, mepoch: Optional[int]) -> bool:
         """True when the delta crossed an elastic world change; stale
@@ -61,6 +96,32 @@ class KVStore:
             "(current %d)", key, mepoch, self._membership_epoch)
         return True
 
+    def _dup(self, key: str, worker_id: int, seq: Optional[int]) -> bool:
+        """Idempotence gate (caller holds the lock): a (key, worker)
+        token at or below the recorded floor is a duplicate — the retry
+        of a push whose ACK was lost — and is dropped, not re-summed.
+        Check only; the floor advances via :meth:`_mark_seen`.  Legacy
+        callers that pass no token are exempt (and unprotected)."""
+        if seq is None:
+            return False
+        floor = self._seen.get((key, worker_id), 0)
+        if seq <= floor:
+            counters.inc("integrity.dup_dropped")
+            get_logger().warning(
+                "kv store: dropped duplicate delta for %r from worker %d "
+                "(seq %d <= %d)", key, worker_id, seq, floor)
+            return True
+        return False
+
+    def _mark_seen(self, key: str, worker_id: int,
+                   seq: Optional[int]) -> None:
+        """Advance the dedup floor — called only once the push's fate is
+        FINAL (summed, or deliberately dropped by policy).  A push that
+        died on the wire must not burn its token, or the caller's
+        legitimate retry would be swallowed as a duplicate."""
+        if seq is not None and seq > self._seen.get((key, worker_id), 0):
+            self._seen[(key, worker_id)] = seq
+
     def init_key(self, key: str, value) -> None:
         """Idempotent first-push initialization (reference init-push
         barrier, server.cc:261-289)."""
@@ -72,22 +133,115 @@ class KVStore:
     def _push_delta_locked(self, key: str, delta: np.ndarray) -> int:
         if key not in self._store:
             raise KeyError(f"key {key!r} not initialized")
+        target = self._store[key]
+        screened = _integrity.enabled()
+        prev = None
+        if screened and _integrity.nonfinite_policy() in ("skip", "raise"):
+            # skip must UNDO a sum (inf + -inf can merge non-finite from
+            # finite inputs); raise must leave the store untouched — the
+            # error goes to the pushing worker only, so a mutated value
+            # would be silently pullable by everyone else
+            prev = target.copy()
         # native multithreaded sum when available (reference server
         # engine threads sum with the C++ CpuReducer, server.cc:77-198)
-        inplace_add(self._store[key], delta.reshape(
-            self._store[key].shape))
+        inplace_add(target, delta.reshape(target.shape))
+        if (screened and np.issubdtype(target.dtype, np.inexact)
+                and not np.isfinite(target).all()):
+            policy = _integrity.nonfinite_policy()
+            if policy == "skip":
+                target[...] = prev
+                counters.inc("integrity.nonfinite_skipped")
+                get_logger().error(
+                    "kv store: merge for %r went non-finite — delta "
+                    "dropped, value stays at version %d", key,
+                    self._versions[key])
+                return self._versions[key]
+            if policy == "zero":
+                counters.inc("integrity.nonfinite_zeroed")
+                get_logger().warning(
+                    "kv store: zeroed non-finite elements in merged "
+                    "value for %r", key)
+                np.nan_to_num(target, copy=False, nan=0.0, posinf=0.0,
+                              neginf=0.0)
+            else:
+                counters.inc("integrity.nonfinite_rejected")
+                target[...] = prev  # version not bumped: pulls stay sane
+                raise RuntimeError(
+                    f"kv store: merged value for {key!r} is non-finite "
+                    "(BYTEPS_NONFINITE_POLICY=raise)")
         self._versions[key] += 1
         return self._versions[key]
 
-    def push_delta(self, key: str, delta,
-                   mepoch: Optional[int] = None) -> int:
+    def _maybe_drop_ack(self, key: str, version: int,
+                        seq: Optional[int]) -> None:
+        """Chaos ``drop:site=kv_push``: the delta HAS been applied; the
+        acknowledgement is what gets lost.  The caller retries with the
+        same seq token and the dedup absorbs the duplicate.  A token-less
+        legacy push (``seq is None``) never loses its ack — it has no
+        token to retry with, so its retry would double-sum (the dedup
+        exempts ``seq=None``) and a non-retry would crash the caller."""
+        if (seq is not None and _fault.ENABLED
+                and _fault.should_drop("kv_push")):
+            raise _integrity.AckLost(
+                f"push for {key!r} applied as version {version} but the "
+                "ack was dropped; retry with the same seq token")
+
+    def _wire_recv(self, key: str, frame: bytes, worker_id: int, seq: int,
+                   opener, wasted_nbytes: int):
+        """Envelope hop for a sealed frame (caller holds the lock): the
+        shared :func:`integrity.wire_transmit` NACK/retransmit machine at
+        chaos site ``kv_push``, with every rejected transmission
+        accounting ``wasted_nbytes`` into :attr:`wire_bytes_wasted`."""
+        def wasted():
+            self.wire_bytes_wasted += wasted_nbytes
+
+        return _integrity.wire_transmit(
+            frame, key=key, worker=worker_id, seq=seq, site="kv_push",
+            opener=opener, who="kv store", on_reject=wasted)
+
+    def push_delta(self, key: str, delta, mepoch: Optional[int] = None,
+                   worker_id: int = 0, seq: Optional[int] = None) -> int:
         """Sum a delta into the store (async SUM_RECV path); returns the
         new version.  A stale ``mepoch`` (see :meth:`_stale`) is dropped
-        — the current version is returned unchanged."""
+        — the current version is returned unchanged.  With integrity
+        armed the delta crosses the envelope hop (chaos-visible, CRC
+        verified); a ``(worker_id, seq)`` token makes the push
+        idempotent (see :meth:`_dup`)."""
         with self._lock:
             if self._stale(key, mepoch):
                 return self._versions.get(key, -1)
-            return self._push_delta_locked(key, np.asarray(delta))
+            if self._dup(key, worker_id, seq):
+                version = self._versions.get(key, -1)
+                self._maybe_drop_ack(key, version, seq)
+                return version
+            arr = np.asarray(delta)
+            if _integrity.enabled():
+                seq_env = seq if seq is not None else next(self._wire_seq)
+                frame = _integrity.seal_array(arr, key=key, seq=seq_env,
+                                              worker=worker_id)
+                # wasted_nbytes=0: the wire counters are denominated in
+                # wire-ENCODED (compressed) bytes only — charging raw
+                # float32 nbytes here would let uncompressed deltas dwarf
+                # the compressed traffic and wreck the waste ratio; raw
+                # rejects stay visible in integrity.crc_reject/retransmit
+                arr = self._wire_recv(key, frame, worker_id, seq_env,
+                                      _integrity.open_array, 0)
+                arr = _integrity.screen_nonfinite(
+                    arr, what="delta", key=key, worker=worker_id)
+                if arr is None:  # skip policy: drop this contribution
+                    self._mark_seen(key, worker_id, seq)  # fate is final
+                    return self._versions.get(key, -1)
+            elif _fault.ENABLED:
+                # integrity off: the bitflip lands silently in this
+                # delta — the unprotected baseline the envelope fixes
+                # (mirrors ServerEngine.push; a corrupt-site spec must
+                # never silently no-op)
+                arr = np.asarray(_fault.corrupt("kv_push", arr))
+                _fault.fire("kv_push")
+            version = self._push_delta_locked(key, arr)
+            self._mark_seen(key, worker_id, seq)
+            self._maybe_drop_ack(key, version, seq)
+            return version
 
     def register_compression(self, key: str, kwargs: dict, numel: int,
                              dtype=np.float32) -> None:
@@ -108,24 +262,62 @@ class KVStore:
             self._codecs[key] = (dict(kwargs), comp)
 
     def push_delta_wire(self, key: str, data: bytes,
-                        mepoch: Optional[int] = None) -> int:
+                        mepoch: Optional[int] = None,
+                        worker_id: int = 0,
+                        seq: Optional[int] = None) -> int:
         """Sum a wire-encoded compressed delta (the reference's async +
         compressed combination: compressed pushes, decompress-and-sum on
         the server, server.cc:87-113 + 310-314).  The key's codec must
         be registered via :meth:`register_compression`; the bytes are
         what a real worker->server network hop would carry, accumulated
-        in :attr:`wire_bytes` only for pushes that land.  A stale
-        ``mepoch`` is dropped before the decode runs."""
+        in :attr:`wire_bytes` only for pushes that land (retransmits and
+        duplicates land in :attr:`wire_bytes_wasted`).  A stale
+        ``mepoch`` is dropped before the decode runs; a corrupt frame is
+        NACKed and retransmitted before the decode runs — the codec
+        never sees unverified bytes."""
         with self._lock:
             if self._stale(key, mepoch):
                 return self._versions.get(key, -1)
             codec = self._codecs.get(key)
             if codec is None:
                 raise KeyError(f"key {key!r} has no registered compression")
+            if self._dup(key, worker_id, seq):
+                self.wire_bytes_wasted += len(data)
+                version = self._versions.get(key, -1)
+                self._maybe_drop_ack(key, version, seq)
+                return version
+            if _integrity.enabled():
+                env_seq = seq if seq is not None else next(self._wire_seq)
+                frame = _integrity.seal_bytes(data, key=key, seq=env_seq,
+                                              worker=worker_id)
+                verified = bytes(self._wire_recv(
+                    key, frame, worker_id, env_seq,
+                    _integrity.open_bytes, len(data)))
+            else:
+                verified = data
+                if _fault.ENABLED:
+                    # integrity off: corruption reaches the codec and
+                    # decodes into a many-element error — the baseline
+                    # the envelope exists to fix
+                    verified = _fault.corrupt_bytes("kv_push", verified)
+                    _fault.fire("kv_push")
             delta = np.asarray(codec[1].decompress(
-                codec[1].wire_decode(data)))
+                codec[1].wire_decode(verified)))
+            if _integrity.enabled():
+                delta = _integrity.screen_nonfinite(
+                    delta, what="delta", key=key, worker=worker_id)
+                if delta is None:  # skip policy: dropped, bytes wasted
+                    self.wire_bytes_wasted += len(data)
+                    self._mark_seen(key, worker_id, seq)  # fate is final
+                    return self._versions.get(key, -1)
+            before = self._versions.get(key, -1)
             version = self._push_delta_locked(key, delta)
-            self.wire_bytes += len(data)
+            self._mark_seen(key, worker_id, seq)
+            if version != before:
+                self.wire_bytes += len(data)
+            else:  # merged-screen skip: the delta did not land
+                self.wire_bytes_wasted += len(data)
+            self._maybe_drop_ack(key, version, seq)
             return version
 
     def pull(self, key: str) -> np.ndarray:
@@ -147,4 +339,6 @@ class KVStore:
             self._store.clear()
             self._versions.clear()
             self._codecs.clear()
+            self._seen.clear()
             self.wire_bytes = 0
+            self.wire_bytes_wasted = 0

@@ -150,7 +150,7 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True,
     return jax.process_index() == 0
 
 
-def pack_state(state: Any) -> bytes:
+def pack_state(state: Any, seal: bool = True) -> bytes:
     """Wire form of a checkpoint pytree for the survivor→rejoiner
     parameter broadcast (fault/membership.py).
 
@@ -162,15 +162,39 @@ def pack_state(state: Any) -> bytes:
     transport.  Device arrays are materialized to host numpy first, so
     the bytes never reference a mesh the receiver does not have.
     Control-plane use only: the stream is pickle over a trusted
-    intra-cluster socket, never untrusted input."""
+    intra-cluster socket, never untrusted input.  With integrity armed
+    (``BYTEPS_INTEGRITY``) the pickle rides a CRC32C envelope: a
+    rejoiner must NEVER unpack corrupt parameters — silently resuming
+    from a flipped-bit model is the exact poisoning this layer exists to
+    stop.  ``seal=False`` skips the envelope for callers whose transport
+    already seals (the membership bus frames every message): sealing a
+    multi-GB state twice would double the CRC and copy cost of a rejoin
+    for no added detection power (:func:`unpack_state` sniffs and
+    accepts either form)."""
     import pickle
+    from ..common import integrity as _integrity
     materialized = jax.tree.map(lambda x: np.asarray(x), state)
-    return pickle.dumps(materialized, protocol=pickle.HIGHEST_PROTOCOL)
+    data = pickle.dumps(materialized, protocol=pickle.HIGHEST_PROTOCOL)
+    if seal and _integrity.enabled():
+        data = _integrity.seal_bytes(data, key="pack_state")
+    return data
 
 
 def unpack_state(data: bytes) -> Any:
-    """Inverse of :func:`pack_state` (host numpy leaves)."""
+    """Inverse of :func:`pack_state` (host numpy leaves).  Verifies the
+    integrity envelope when present; a corrupt blob raises
+    :class:`integrity.IntegrityError` instead of deserializing garbage
+    into a resuming rank."""
     import pickle
+    from ..common import integrity as _integrity
+    from ..common.telemetry import counters
+    if _integrity.is_frame(data):
+        try:
+            data, _ = _integrity.open_bytes(data)
+        except _integrity.IntegrityError as e:
+            counters.inc("integrity.crc_reject")
+            raise _integrity.IntegrityError(
+                f"refusing to unpack corrupt rejoin state: {e}") from None
     return pickle.loads(data)
 
 

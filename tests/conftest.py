@@ -44,6 +44,16 @@ legacy_skip = pytest.mark.skipif(
            "byteps_tpu/common/jax_compat.py")
 
 
+def pytest_configure(config):
+    # registered here as well as in pyproject.toml so the marker exists
+    # even under bare `pytest tests/` invocations with a stripped config
+    # (tools/run_chaos.sh's integrity lane selects on it)
+    config.addinivalue_line(
+        "markers",
+        "integrity: data-integrity envelope / dedup / quarantine tests "
+        "(common/integrity.py wire paths)")
+
+
 def free_port() -> int:
     """An OS-assigned free TCP port (shared by the multi-process and
     failure-detector tests)."""

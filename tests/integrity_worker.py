@@ -1,0 +1,129 @@
+"""Worker body for the 3-process data-integrity chaos test.
+
+Launched three times by tests/test_integrity.py (the subprocess pattern
+of tests/test_multiprocess.py / tests/chaos_worker.py): rank 0 hosts a
+``ServerEngine`` and a plain TCP accept loop; ranks 1 and 2 connect and
+ship their per-step gradients over the membership-bus wire helpers
+(``_send_obj``/``_recv_obj`` — length-prefixed, CRC32C-enveloped, frame
+clamped), so the cross-PROCESS hop exercises the bus envelope while the
+server's push path exercises the loopback-wire envelope.
+
+Per step, every rank derives a deterministic float32 gradient from
+(seed, step, rank); rank 0 pushes all three contributions into the
+engine in a fixed order (num_threads=1, so the merge order — COPY_FIRST
+then SUM_RECV in arrival order — is reproducible bit-for-bit), pulls the
+merged sum, broadcasts it back, and every rank applies the same SGD
+update.  The chaos variant arms ``bitflip:site=server_push:p=0.05`` in
+rank 0: each corrupted frame must be NACKed (``integrity.crc_reject``)
+and retransmitted from the sealed source copy, so the final parameters
+are BIT-IDENTICAL to the fault-free run from the same seed — that
+equality is the test's headline assertion.
+
+Env (set by the test): BYTEPS_INTEG_RANK, BYTEPS_INTEG_PORT,
+BYTEPS_INTEG_OUT (rank 0 writes final params there), plus
+BYTEPS_FAULT_SPEC / BYTEPS_FAULT_SEED for the chaos variant.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+STEPS = 30
+N = 257  # odd, > one cache line: bitflips land all over the frame
+LR = np.float32(0.05)
+
+
+def _grad(step: int, rank: int) -> np.ndarray:
+    return np.random.RandomState(7919 * step + rank).randn(N) \
+        .astype(np.float32)
+
+
+def main() -> int:
+    rank = int(os.environ["BYTEPS_INTEG_RANK"])
+    port = int(os.environ["BYTEPS_INTEG_PORT"])
+
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.fault import injector as inj
+    from byteps_tpu.fault.membership import _recv_obj, _send_obj
+
+    spec = os.environ.get("BYTEPS_FAULT_SPEC", "")
+    if spec and rank == 0:
+        inj.arm(spec, seed=int(os.environ.get("BYTEPS_FAULT_SEED", "0")),
+                rank=rank)
+
+    params = np.zeros(N, np.float32)
+
+    if rank == 0:
+        from byteps_tpu.server.engine import ServerEngine
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(2)
+        srv.settimeout(60)
+        conns = {}
+        for _ in range(2):
+            c, _addr = srv.accept()
+            hello = _recv_obj(c)
+            conns[hello["rank"]] = c
+        eng = ServerEngine(num_threads=1)
+        try:
+            for step in range(STEPS):
+                grads = {0: _grad(step, 0)}
+                # fixed receive AND push order: the merge is
+                # COPY_FIRST(0) + SUM_RECV(1) + SUM_RECV(2) every run,
+                # so the float32 sum is bit-reproducible
+                for r in (1, 2):
+                    msg = _recv_obj(conns[r])
+                    assert msg["step"] == step, (msg["step"], step)
+                    grads[r] = msg["grad"]
+                for r in (0, 1, 2):
+                    eng.push("grad", grads[r], worker_id=r, num_workers=3)
+                merged = np.asarray(eng.pull("grad", timeout=30))
+                for r in (1, 2):
+                    _send_obj(conns[r], {"step": step, "merged": merged})
+                params -= LR * merged
+        finally:
+            eng.shutdown()
+            for c in conns.values():
+                c.close()
+            srv.close()
+        with open(os.environ["BYTEPS_INTEG_OUT"], "wb") as f:
+            f.write(params.tobytes())
+        print("REJECTS", counters.get("integrity.crc_reject"), flush=True)
+        print("RETRANS", counters.get("integrity.retransmit"), flush=True)
+    else:
+        import time
+        deadline = time.monotonic() + 60
+        while True:  # rank 0 may not be listening yet
+            try:
+                sock = socket.create_connection(("127.0.0.1", port),
+                                                timeout=60)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        _send_obj(sock, {"rank": rank})
+        try:
+            for step in range(STEPS):
+                _send_obj(sock, {"step": step, "grad": _grad(step, rank)})
+                reply = _recv_obj(sock)
+                assert reply["step"] == step, (reply["step"], step)
+                params -= LR * np.asarray(reply["merged"])
+        finally:
+            sock.close()
+
+    print("DIGEST", rank, hashlib.sha256(params.tobytes()).hexdigest(),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -243,11 +243,15 @@ def test_reset_key_fails_parked_pulls_and_drops_stale_pushes():
 
 @pytest.mark.chaos
 def test_fault_injected_bitflip_poison_then_reset_recovers(monkeypatch):
-    """End-to-end chaos loop on the server path: a bitflip-corrupted push
-    merges into a wrong sum (detected by value), and reset_key gives the
-    recovery pass a clean slate."""
+    """End-to-end chaos loop on the UNPROTECTED server path
+    (BYTEPS_INTEGRITY=0 — the pre-envelope baseline this pins): a
+    bitflip-corrupted push merges into a wrong sum (detected by value),
+    and reset_key gives the recovery pass a clean slate."""
+    from byteps_tpu.common.config import reset_config
     from byteps_tpu.fault import injector as inj_mod
 
+    monkeypatch.setenv("BYTEPS_INTEGRITY", "0")
+    reset_config()
     inj_mod.arm("bitflip:site=server_push:p=1", seed=5, rank=0)
     eng = ServerEngine(num_threads=1)
     try:
@@ -262,6 +266,32 @@ def test_fault_injected_bitflip_poison_then_reset_recovers(monkeypatch):
             eng.push("k", np.ones(4, np.float32), worker_id=r,
                      num_workers=2)
         np.testing.assert_allclose(eng.pull("k", timeout=5), 2.0)
+    finally:
+        inj_mod.disarm()
+        eng.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.integrity
+def test_fault_injected_bitflip_detected_and_retransmitted():
+    """The same chaos site with the integrity envelope armed (the
+    default): every corrupted frame is NACKed (integrity.crc_reject),
+    retransmitted from the caller's source copy, and the merged sum is
+    exact — the silent-poisoning proof inverted into a resilience
+    proof."""
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.fault import injector as inj_mod
+
+    counters.reset()
+    inj_mod.arm("bitflip:site=server_push:p=0.5", seed=3, rank=0)
+    eng = ServerEngine(num_threads=1)
+    try:
+        for r in range(4):
+            eng.push("k", np.ones(64, np.float32), worker_id=r,
+                     num_workers=4)
+        np.testing.assert_array_equal(eng.pull("k", timeout=5), 4.0)
+        assert counters.get("integrity.crc_reject") > 0
+        assert counters.get("integrity.retransmit") > 0
     finally:
         inj_mod.disarm()
         eng.shutdown()

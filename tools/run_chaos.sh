@@ -1,6 +1,9 @@
 #!/usr/bin/env bash
-# Chaos lane: the fault-injection / kill-and-recover / elastic-membership
-# tests (pytest -m chaos), with TWO layers of wedge protection:
+# Chaos lanes: fault-injection / kill-and-recover / elastic-membership
+# tests (default lane, pytest -m chaos) and the data-integrity lane
+# (pytest -m integrity: bitflip detection + retransmit, drop-with-retry
+# dedup, non-finite quarantine — tests/test_integrity.py), both with TWO
+# layers of wedge protection:
 #
 #   1. a hard per-test timeout (tools/chaos_timeout_plugin.py, SIGALRM):
 #      a wedged rendezvous or hung worker process fails ITS test fast
@@ -8,7 +11,8 @@
 #   2. an outer `timeout -k` on the whole lane as the backstop for
 #      anything the in-process alarm cannot interrupt.
 #
-# Usage:  tools/run_chaos.sh [extra pytest args...]
+# Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
+#         lane: chaos (default) | integrity | all
 # Env:    CHAOS_TEST_TIMEOUT  per-test seconds   (default 120)
 #         CHAOS_LANE_TIMEOUT  whole-lane seconds (default 600)
 set -o pipefail
@@ -18,8 +22,15 @@ cd "$(dirname "$0")/.."
 PER_TEST="${CHAOS_TEST_TIMEOUT:-120}"
 LANE="${CHAOS_LANE_TIMEOUT:-600}"
 
+MARK="chaos"
+case "${1:-}" in
+    chaos)     MARK="chaos"; shift ;;
+    integrity) MARK="integrity"; shift ;;
+    all)       MARK="chaos or integrity"; shift ;;
+esac
+
 exec timeout -k 15 "$LANE" \
-    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$MARK" \
     -p tools.chaos_timeout_plugin --chaos-timeout "$PER_TEST" \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     "$@"
