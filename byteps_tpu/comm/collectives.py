@@ -28,11 +28,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .mesh import CommContext, DCN_AXIS, ICI_AXIS
 from ..common import jax_compat as _jax_compat
+from ..common.telemetry import counters
 from ..fault import injector as _fault
 
 
@@ -46,13 +48,68 @@ def _cached(comm: CommContext, key, builder):
     # accumulate dead meshes in a module-level cache).
     fn = comm.jit_cache.get(key)
     if fn is None:
+        # Miss counting is unconditional: the zero-new-compiles-after-
+        # warmup contract (tests/test_aot_planner.py) reads this counter.
+        counters.inc("engine.compile_cache_miss")
         built = builder()
         # legacy-runtime serial mode (jax_compat): executions of compiled
         # programs hold the process lock; identity on modern runtimes.
         # Scalar cache entries are arrays, not programs — left bare.
         fn = comm.jit_cache[key] = (
             _jax_compat.serialize(built) if callable(built) else built)
+    else:
+        # Hit counting rides the dispatch hot path (several lookups per
+        # push); one uncontended mutex inc is ~0.5 µs against ~1 ms of
+        # dispatch work per program — cheaper than any config lookup
+        # that could gate it.
+        counters.inc("engine.compile_cache_hit")
     return fn
+
+
+def aot_compile(comm: CommContext, key, arg_structs) -> bool:
+    """AOT-compile the cached program under ``key`` for one concrete
+    signature and install a guarded fast path in ``comm.jit_cache``
+    (declare-time warm: the first dispatch then runs without a compile
+    stall, and calls matching the warmed signature go straight to the
+    executable, skipping the jit dispatch machinery — ~35% lower
+    per-call host overhead measured on the CPU mesh).
+
+    ``arg_structs``: ``jax.ShapeDtypeStruct`` per argument, sharding
+    included — exactly the concrete layout the dispatch path will pass.
+
+    Some cache keys are shape-GENERIC by design (the single-chunk
+    collectives serve every parts-mode tensor through one jit wrapper
+    that retraces per shape), so the executable must never simply
+    replace the entry: a guard compares each call's shapes/dtypes
+    against the warmed signature and falls back to the lazy wrapper on
+    mismatch — correctness identical, only the warm's speedup scoped to
+    the signature it compiled.  Returns False (leaving the lazy wrapper
+    untouched) when the runtime cannot lower ahead of time.
+    """
+    fn = comm.jit_cache.get(key)
+    if fn is None:
+        return False
+    if getattr(fn, "_bps_aot", False) or not hasattr(fn, "lower"):
+        return True                    # already warmed (or a scalar)
+    try:
+        compiled = _jax_compat.serialize(fn.lower(*arg_structs).compile())
+    except Exception:  # noqa: BLE001 — legacy runtimes / odd shardings
+        counters.inc("engine.aot_compile_failed")
+        return False
+    sig = tuple((tuple(s.shape), np.dtype(s.dtype)) for s in arg_structs)
+    lazy = fn
+
+    def dispatch(*args):
+        if len(args) == len(sig) and all(
+                tuple(a.shape) == s and a.dtype == d
+                for a, (s, d) in zip(args, sig)):
+            return compiled(*args)
+        return lazy(*args)             # off-signature: jit as before
+
+    dispatch._bps_aot = True
+    comm.jit_cache[key] = dispatch
+    counters.inc("engine.aot_compiled")
+    return True
 
 
 def _cached_scalar(comm: CommContext, value, dtype):
@@ -241,6 +298,33 @@ def stage_local_replicated(comm: CommContext, flat) -> jax.Array:
     return jax.device_put(jax.device_put(flat, d0), rep)
 
 
+def stage_local_sharded(comm: CommContext, flat, n_pad: int):
+    """Stage a single-process local contribution [n] block-sharded over
+    the whole mesh: ONE n-byte host->device transfer (each device
+    receives only its 1/R block) instead of the R-replica fan-out of
+    :func:`stage_local_replicated`.  The chunk program re-materializes
+    every rank's full view with an in-graph all-gather
+    (``local="sharded"``), so the collective's wire movement — gather +
+    reduce-scatter — is exactly an all-reduce's, while host staging drops
+    from R*n to n bytes.  Padding to the scatter layout happens on the
+    host (one memcpy) so the device never runs a separate pad program.
+
+    Only valid when ``n_pad`` divides evenly over the ranks (the mesh
+    cannot hold an uneven 1-D block sharding), and only worth it when
+    the tensor dispatches as ONE chunk program — each dispatched run
+    re-gathers the whole flat tensor in-graph, so a multi-run push
+    would pay the gather per run where replicated staging pays its
+    device fan-out once.  The engine scopes this to single-chunk
+    layouts; callers fall back to replicated staging otherwise.
+    """
+    host = np.ascontiguousarray(np.asarray(flat).reshape(-1))
+    if host.shape[0] != n_pad:
+        host = np.pad(host, (0, n_pad - host.shape[0]))
+    from jax.sharding import NamedSharding
+    return jax.device_put(host,
+                          NamedSharding(comm.mesh, P(comm.dp_axes)))
+
+
 def all_reduce(comm: CommContext, stacked, op: str = "sum",
                keep_acc: bool = False) -> jax.Array:
     """Sum (or average) rank-stacked tensors; returns the replicated result.
@@ -325,6 +409,151 @@ def push_pull_array_scaled(comm: CommContext, stacked, scale: float,
 
 
 # ---------------------------------------------------------------------------
+# Declare-time AOT warm (ISSUE 5 tentpole part 1)
+#
+# The dispatch path's program set for one tensor is finite and knowable at
+# declare time: one chunk-scatter executable per (merge width, init) pair,
+# the pad program, the assembly program, the single-chunk collective, and
+# the device scalars for each column offset.  Pre-lowering and compiling
+# them here — and caching the *executables* in comm.jit_cache, which the
+# dispatch path then calls directly, skipping the jit dispatch machinery —
+# means a steady-state push_pull stream compiles nothing (the regression
+# test's contract) and the first push pays no compile stall.
+# ---------------------------------------------------------------------------
+
+
+def _acc_dtype(np_dtype):
+    """Accumulation dtype of a chunk program's buffer (see _acc)."""
+    if np_dtype == jnp.float16 or str(np_dtype) == "bfloat16":
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(np_dtype)
+
+
+def _struct(shape, dtype, sharding):
+    return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+
+
+def aot_warm_buffer_programs(comm: CommContext, *, col_layout, C: int,
+                             n: int, out_shape, dtype_name: str,
+                             local: bool, scaled: bool, denom: int,
+                             shard_out: bool, scale_value=None,
+                             merge_widths=(), max_programs: int = 24
+                             ) -> int:
+    """Pre-compile the persistent program set for one buffer-mode tensor;
+    returns the number of executables AOT-compiled.  ``merge_widths``:
+    the run widths the dispatcher can form (engine-supplied: pow2 splits
+    in drain mode, 1..group_size otherwise)."""
+    from jax.sharding import NamedSharding
+    np_dtype = np.dtype(dtype_name)
+    acc = _acc_dtype(np_dtype)
+    n_ici, R = comm.n_ici, comm.num_ranks
+    n_pad = C * n_ici
+    rep = comm.replicated_sharding()
+    if local == "sharded":
+        flat_struct = _struct((n_pad,), np_dtype,
+                              NamedSharding(comm.mesh, P(comm.dp_axes)))
+    elif local:
+        flat_struct = _struct((n_pad,), np_dtype, rep)
+    else:
+        flat_struct = _struct((R, n_pad), np_dtype,
+                              comm.stacked_sharding(extra_dims=1))
+    off_struct = _struct((), jnp.int32, rep)
+    buf_struct = _struct((n_ici, C), acc,
+                         NamedSharding(comm.mesh, P(ICI_AXIS)))
+    nchunks = len(col_layout)
+    tail_w = col_layout[-1][1]
+    body_ws = sorted({w for _, w in col_layout[:-1]})
+    # A tail whose width matches the body merges into body runs, so the
+    # longest run then spans ALL chunks; otherwise the tail always rides
+    # its own width-1 run.
+    uniform = nchunks == 1 or body_ws == [tail_w]
+    max_run = nchunks if uniform else nchunks - 1
+    widths = sorted({tail_w} if uniform else set(body_ws))
+    compiled = 0
+    # Chunk-scatter executables.  init=True serves the first-dispatched
+    # run of a push (accumulator creation); with priority order that run
+    # starts at chunk 0, so every reachable width needs both variants
+    # except a distinct tail (always dispatched last unless the tensor is
+    # a single chunk).
+    want = []
+    for w in widths:
+        for k in sorted(set(merge_widths) or {1}):
+            if k <= max_run:
+                want.append((w, k, True))
+                if nchunks > 1:
+                    want.append((w, k, False))
+    if not uniform:
+        want.append((tail_w, 1, False))
+    seen = set()
+    want = [x for x in want if not (x in seen or seen.add(x))]
+    for w, k, init in want[:max_programs]:
+        _chunk_scatter_program(comm, w, k, C, init, local)
+        args = [flat_struct, off_struct] + ([] if init else [buf_struct])
+        compiled += aot_compile(
+            comm, ("chunk_scatter", w, k, C, init, local), args)
+    # Pad program (scatter layout needs n divisible by the mesh).  The
+    # sharded staging pads on the host inside its one memcpy, so only
+    # the replicated/stacked layouts dispatch a device pad.
+    if n != n_pad and local != "sharded":
+        unpadded = (_struct((n,), np_dtype, rep) if local
+                    else _struct((R, n), np_dtype,
+                                 comm.stacked_sharding(extra_dims=1)))
+        _pad_program(comm, n, n_pad, local)
+        compiled += aot_compile(comm, ("pad_flat", n, n_pad, local),
+                                [unpadded])
+    # Assembly program (donated accumulator in, declared dtype/shape out).
+    _assemble_program(comm, n, C, tuple(out_shape), dtype_name, scaled,
+                      denom, shard_out=shard_out)
+    asm_args = [buf_struct]
+    if scaled:
+        asm_args.append(_struct((), acc, rep))
+    compiled += aot_compile(
+        comm, ("assemble", n, C, tuple(out_shape), dtype_name, scaled,
+               denom, shard_out), asm_args)
+    # Device scalars: one transfer per column offset / fused scale now,
+    # zero per dispatch later.  The scale's cache key carries the jnp
+    # class, exactly as assemble_scatter passes it at dispatch.
+    for col_off, _ in col_layout:
+        _cached_scalar(comm, int(col_off), jnp.int32)
+    if scaled and scale_value is not None:
+        _cached_scalar(comm, float(scale_value),
+                       jnp.float64 if acc == np.float64 else jnp.float32)
+    return compiled
+
+
+def aot_warm_single_program(comm: CommContext, *, n: int, dtype_name: str,
+                            scaled: bool, local: bool,
+                            scale_value=None) -> int:
+    """Pre-compile the single-chunk collective a parts-mode tensor
+    dispatches (scaled float fast path, or the keep-acc sum)."""
+    np_dtype = np.dtype(dtype_name)
+    acc = _acc_dtype(np_dtype)
+    rep = comm.replicated_sharding()
+    x_struct = (_struct((n,), np_dtype, rep) if local
+                else _struct((comm.num_ranks, n), np_dtype,
+                             comm.stacked_sharding(extra_dims=1)))
+    hierarchical = comm.n_dcn > 1
+    if scaled:
+        key_head = "hierarchical" if hierarchical else "all_reduce"
+        fn_args = (False, False, True, local)   # average, keep_acc, scaled
+        builder = _hierarchical_fn if hierarchical else _all_reduce_fn
+        builder(comm, False, False, scaled=True, local=local)
+        args = [x_struct, _struct((), acc, rep)]
+        compiled = aot_compile(comm, (key_head,) + fn_args, args)
+        if scale_value is not None:
+            # same jnp-class cache key push_pull_array_scaled uses
+            _cached_scalar(comm, float(scale_value),
+                           jnp.float64 if acc == np.float64
+                           else jnp.float32)
+        return compiled
+    key_head = "hierarchical" if hierarchical else "all_reduce"
+    builder = _hierarchical_fn if hierarchical else _all_reduce_fn
+    builder(comm, False, True, scaled=False, local=local)
+    return aot_compile(comm, (key_head, False, True, False, local),
+                       [x_struct])
+
+
+# ---------------------------------------------------------------------------
 # Fused chunk programs (engine hot path)
 #
 # Round-2 VERDICT "What's weak" #1: the engine paid ~10x rent over the bare
@@ -382,7 +611,7 @@ def scatter_layout(chunk_bounds, n_ici: int):
 
 
 def _chunk_scatter_program(comm: CommContext, w: int, k: int, C: int,
-                           init: bool, local: bool = False):
+                           init: bool, local=False):
     """Chunk-group reduce-scatter program over a column slab.
 
     Handles ``k`` contiguous equal-width (``w`` columns) chunks in one
@@ -391,10 +620,20 @@ def _chunk_scatter_program(comm: CommContext, w: int, k: int, C: int,
     init=True:  (flat [R, n_pad], col_off) -> (buf [n_ici, C], token)
     init=False: (flat [R, n_pad], col_off, buf) -> (buf, token), donated.
 
-    ``local=True``: flat is a *replicated* [n_pad] local contribution
-    (single-process path, :func:`stage_local_replicated`) — every rank
-    reads the same array as its row; the collective and the accumulator
-    layout are identical.
+    ``local`` selects the single-process local-contribution staging:
+
+    - ``True``: flat is a *replicated* [n_pad] array
+      (:func:`stage_local_replicated`) — every rank reads the same array
+      as its row.
+    - ``"sharded"``: flat is *block-sharded* [n_pad] over the whole mesh
+      (:func:`stage_local_sharded`, ONE n-byte host->device transfer
+      instead of R replicas); the program all-gathers it in-graph before
+      the reduce-scatter.  Gather + scatter is exactly an all-reduce's
+      wire movement, so the emulated collective stays honest while the
+      host stops paying an R-way staging fan-out.
+
+    All three modes feed bit-identical slab values to the psum_scatter,
+    so staging choice can never change a result.
 
     The token is a tiny ICI-sharded array from the reduced shard: blocking
     on it awaits the program without touching buf (which a later program
@@ -405,7 +644,10 @@ def _chunk_scatter_program(comm: CommContext, w: int, k: int, C: int,
 
     def build():
         def body(x, col_off, *maybe_buf):
-            row = x if local else x[0]
+            if local == "sharded":
+                row = lax.all_gather(x, (DCN_AXIS, ICI_AXIS), tiled=True)
+            else:
+                row = x if local else x[0]
             xr = row.reshape(n_ici, C)           # free: row is contiguous
             slab = lax.dynamic_slice(
                 xr, (jnp.zeros((), col_off.dtype), col_off),
@@ -424,7 +666,13 @@ def _chunk_scatter_program(comm: CommContext, w: int, k: int, C: int,
             # only blocked on
             return buf, s[:1, :1]
 
-        specs = [P() if local else P(comm.dp_axes), P()]
+        if local == "sharded":
+            x_spec = P(comm.dp_axes)   # 1-D block-sharded contribution
+        elif local:
+            x_spec = P()
+        else:
+            x_spec = P(comm.dp_axes)
+        specs = [x_spec, P()]
         if not init:
             specs.append(P(ICI_AXIS))
         fn = jax.shard_map(
@@ -438,16 +686,20 @@ def _chunk_scatter_program(comm: CommContext, w: int, k: int, C: int,
 
 
 def push_pull_chunk_scatter(comm: CommContext, flat, buf, col_off: int,
-                            w: int, k: int, C: int):
+                            w: int, k: int, C: int, local=None):
     """Dispatch one chunk-group: reduce-scatter ``k`` contiguous ``w``-column
     slabs of ``flat`` (viewed as [R, n_ici, C]) starting at column
     ``col_off`` into the block-sharded accumulator.  ``buf=None`` creates
-    the accumulator.  A 1-D ``flat`` is a replicated local contribution
-    (:func:`stage_local_replicated`).  Returns (buf, token)."""
+    the accumulator.  ``local`` as in :func:`_chunk_scatter_program`;
+    ``None`` infers replicated-local from a 1-D ``flat`` (callers using
+    the sharded staging pass ``"sharded"`` explicitly — the two are both
+    1-D).  Returns (buf, token)."""
     if _fault.ENABLED:
         _fault.fire("dcn")
+    if local is None:
+        local = flat.ndim == 1
     fn = _chunk_scatter_program(comm, w, k, C, init=buf is None,
-                                local=flat.ndim == 1)
+                                local=local)
     offa = _cached_scalar(comm, int(col_off), jnp.int32)
     if buf is None:
         return fn(flat, offa)
@@ -556,11 +808,32 @@ def pad_stacked(comm: CommContext, flat, n_pad: int):
     return _pad_program(comm, n, n_pad, local)(flat)
 
 
+def assemble_shardable(comm: CommContext, out_shape) -> bool:
+    """Can the assembled tensor stay block-sharded over the mesh?  True
+    when axis 0 divides evenly across the ranks — XLA then materializes
+    the all-gather only if and where a consumer needs replicated values
+    (the EQuARX-style layout-copy saving: the accumulator's shards map
+    onto the output's shards with no cross-device traffic when the flat
+    length was already mesh-aligned).  Uneven axis-0 shapes fall back to
+    the replicated epilogue (this runtime rejects uneven jit
+    out_shardings)."""
+    return (len(tuple(out_shape)) >= 1
+            and out_shape[0] % comm.num_ranks == 0)
+
+
 def _assemble_program(comm: CommContext, n: int, C: int, out_shape,
-                      dtype_name: str, scaled: bool, denom: int):
-    """Order-identical assembly: all-gather the block-sharded accumulator,
+                      dtype_name: str, scaled: bool, denom: int,
+                      shard_out: bool = False):
+    """Order-identical assembly: gather the block-sharded accumulator,
     drop the pad, apply the fused scale (dynamic scalar) or integer
-    divisor, restore the declared dtype, reshape.  One fused pass."""
+    divisor, restore the declared dtype, reshape.  One fused pass.
+
+    ``shard_out=True`` keeps the result block-sharded on axis 0 (deferred
+    gather): when the flat length is mesh-aligned the accumulator's shard
+    d IS the output's shard d, so assembly is a device-local
+    reshape/scale/cast with zero cross-device movement.  The accumulator
+    is donated either way — it is dead after its one assembly, and
+    donation lets XLA reuse its pages for the output."""
     n_ici = comm.n_ici
 
     def build():
@@ -575,18 +848,33 @@ def _assemble_program(comm: CommContext, n: int, C: int, out_shape,
                        else out // denom)
             return out.astype(dtype_name).reshape(out_shape)
 
-        return jax.jit(fn, out_shardings=comm.replicated_sharding())
+        if shard_out:
+            from jax.sharding import NamedSharding
+            sharding = NamedSharding(
+                comm.mesh,
+                P((DCN_AXIS, ICI_AXIS), *([None] * (len(out_shape) - 1))))
+        else:
+            sharding = comm.replicated_sharding()
+        # Donation is opportunistic: the accumulator is dead after its one
+        # assembly, and on backends that can alias it (TPU) XLA reuses its
+        # pages for the output.  The CPU emitter can't alias through the
+        # reshape/scale and would warn "donated buffers were not usable"
+        # at every compile, so donation is only requested where it works.
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        return jax.jit(fn, out_shardings=sharding, donate_argnums=donate)
 
     return _cached(comm, ("assemble", n, C, out_shape, dtype_name, scaled,
-                          denom), build)
+                          denom, shard_out), build)
 
 
 def assemble_scatter(comm: CommContext, buf, n: int, C: int, out_shape,
-                     dtype_name: str, scale=None, denom: int = 1):
-    """Final assembly of a scattered push_pull: one program, replicated
-    output of the declared dtype and shape."""
+                     dtype_name: str, scale=None, denom: int = 1,
+                     shard_out: bool = False):
+    """Final assembly of a scattered push_pull: one program consuming the
+    (donated) accumulator; output in the declared dtype and shape —
+    replicated, or block-sharded when ``shard_out`` (deferred gather)."""
     fn = _assemble_program(comm, n, C, tuple(out_shape), dtype_name,
-                           scale is not None, denom)
+                           scale is not None, denom, shard_out=shard_out)
     if scale is not None:
         acc = jnp.float64 if buf.dtype == jnp.float64 else jnp.float32
         return fn(buf, _cached_scalar(comm, float(scale), acc))

@@ -71,6 +71,12 @@ def _env_float(name: str, default: float) -> float:
 # 512 lanes * 4 bytes so chunk boundaries respect (8,128) tiling of f32.
 ALIGN_BYTES = 4096
 
+# Reference default for BYTEPS_PARTITION_BYTES (global.cc:134-144).  ONE
+# copy: the dataclass default, the env fallback, and the auto-tuner's
+# pin detection (__post_init__) must agree, or changing the default
+# would silently pin the planner.
+PARTITION_BYTES_DEFAULT = 4096000
+
 
 @dataclasses.dataclass
 class Config:
@@ -85,7 +91,7 @@ class Config:
     force_distributed: bool = False  # BYTEPS_FORCE_DISTRIBUTED
 
     # --- partitioning / scheduling ---
-    partition_bytes: int = 4096000   # BYTEPS_PARTITION_BYTES (default as reference)
+    partition_bytes: int = PARTITION_BYTES_DEFAULT  # BYTEPS_PARTITION_BYTES
     scheduling_credit: int = 0       # BYTEPS_SCHEDULING_CREDIT; 0 = unlimited window
     enable_priority: bool = True     # priority ordering of chunk dispatch
     group_size: int = 4              # BYTEPS_GROUP_SIZE: chunks per device
@@ -94,6 +100,32 @@ class Config:
     #                                  -1 = drain mode: every dispatch empties
     #                                  the whole eligible credit window into
     #                                  the fewest programs (engine._plan_batch)
+    autotune: bool = True            # BYTEPS_AUTOTUNE: online chunk-size /
+    #                                  credit-window planner
+    #                                  (common/scheduler.py ChunkPlanner).
+    #                                  Pinning an explicit
+    #                                  BYTEPS_PARTITION_BYTES or
+    #                                  BYTEPS_SCHEDULING_CREDIT (env or a
+    #                                  non-default Config value) disables
+    #                                  tuning of that knob for
+    #                                  reproducibility; multi-process runs
+    #                                  never tune (SPMD processes must
+    #                                  dispatch identical programs).
+    buffer_min_bytes: int = 1 << 20  # BYTEPS_BUFFER_MIN_BYTES: single-chunk
+    #                                  uncompressed tensors at or above this
+    #                                  ride the reduce-scatter accumulator
+    #                                  path (one RS program + one assemble)
+    #                                  instead of the flat-psum parts path;
+    #                                  smaller tensors keep parts mode, whose
+    #                                  cross-tensor group batching wins for
+    #                                  bursts of small gradients
+    deferred_gather: bool = True     # BYTEPS_DEFERRED_GATHER: buffer-mode
+    #                                  assembly emits the reduced tensor
+    #                                  block-sharded over the mesh (XLA
+    #                                  materializes the all-gather only
+    #                                  where a consumer needs replicated
+    #                                  values) when the output shape admits
+    #                                  it; 0 = always replicate at assembly
 
     # --- compression ---
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES
@@ -153,6 +185,16 @@ class Config:
     #                                  every host-crossing payload (server
     #                                  pushes, KV deltas, membership bus,
     #                                  rejoin state); 0 = zero-overhead off
+    integrity_loopback: bool = True  # BYTEPS_INTEGRITY_LOOPBACK: skip the
+    #                                  seal->CRC->open round-trip on
+    #                                  in-process hops when no chaos is
+    #                                  armed (a CRC over the caller's own
+    #                                  memory verifies bytes against
+    #                                  themselves); the receiver still
+    #                                  snapshots the contribution — one
+    #                                  plain copy instead of frame build +
+    #                                  two CRC passes; 0 forces the full
+    #                                  envelope on every hop
     integrity_max_retransmits: int = 3
     #                                  BYTEPS_INTEGRITY_MAX_RETRANSMITS:
     #                                  bounded retransmit budget after a
@@ -196,9 +238,23 @@ class Config:
     trace_jax: bool = False          # BYTEPS_TRACE_JAX (device profiler)
     telemetry_on: bool = True        # BYTEPS_TELEMETRY_ON
 
+    # Pin markers for the auto-tuned planner (resolved in __post_init__
+    # when left None): a knob explicitly set — env var present, or a
+    # non-default value passed to Config(...) — stays exactly as given
+    # and the planner never touches it (reproducibility contract).
+    partition_pinned: Optional[bool] = None
+    credit_pinned: Optional[bool] = None
+
     def __post_init__(self):
         if self.partition_bytes <= 0:
             raise ValueError("partition_bytes must be positive")
+        if self.partition_pinned is None:
+            self.partition_pinned = (self.partition_bytes
+                                     != PARTITION_BYTES_DEFAULT)
+        if self.credit_pinned is None:
+            self.credit_pinned = self.scheduling_credit != 0
+        if self.buffer_min_bytes < 0:
+            raise ValueError("buffer_min_bytes must be >= 0")
         # Round partition bound up to alignment so chunk boundaries stay tiled.
         r = self.partition_bytes % ALIGN_BYTES
         if r and self.partition_bytes < 2**31 - ALIGN_BYTES:
@@ -247,11 +303,22 @@ class Config:
             local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
             coordinator_address=coord,
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED", False),
-            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
+            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES",
+                                     PARTITION_BYTES_DEFAULT),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
             enable_priority=_env_bool("BYTEPS_ENABLE_PRIORITY", True),
             group_size=_env_int("BYTEPS_GROUP_SIZE",
                                 _env_int("BYTEPS_NCCL_GROUP_SIZE", 4)),
+            autotune=_env_bool("BYTEPS_AUTOTUNE", True),
+            buffer_min_bytes=_env_int("BYTEPS_BUFFER_MIN_BYTES", 1 << 20),
+            deferred_gather=_env_bool("BYTEPS_DEFERRED_GATHER", True),
+            # presence of the env var IS the pin, whatever its value —
+            # a launch script exporting the reference default must still
+            # get exactly that value
+            partition_pinned=("BYTEPS_PARTITION_BYTES" in os.environ
+                              or None),
+            credit_pinned=("BYTEPS_SCHEDULING_CREDIT" in os.environ
+                           or None),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             use_native=_env_bool("BYTEPS_NATIVE", True),
             use_pallas=_env_bool("BYTEPS_PALLAS", True),
@@ -277,6 +344,7 @@ class Config:
                                            30.0),
             failure_exit_code=_env_int("BYTEPS_FAILURE_EXIT_CODE", 17),
             integrity_on=_env_bool("BYTEPS_INTEGRITY", True),
+            integrity_loopback=_env_bool("BYTEPS_INTEGRITY_LOOPBACK", True),
             integrity_max_retransmits=_env_int(
                 "BYTEPS_INTEGRITY_MAX_RETRANSMITS", 3),
             nonfinite_policy=_env_str("BYTEPS_NONFINITE_POLICY",

@@ -65,7 +65,8 @@ from .telemetry import counters
 
 __all__ = [
     "IntegrityError", "AckLost", "EnvelopeMeta", "enabled",
-    "nonfinite_policy", "max_retransmits", "crc32c", "seal_array",
+    "nonfinite_policy", "max_retransmits", "loopback_fast", "crc32c",
+    "seal_array",
     "seal_bytes", "open_array", "open_bytes", "open_frame", "is_frame",
     "wire_transmit", "screen_nonfinite", "record_span",
 ]
@@ -125,6 +126,20 @@ def max_retransmits() -> int:
     return get_config().integrity_max_retransmits
 
 
+def loopback_fast() -> bool:
+    """True when in-process hops may skip the seal->CRC->open round-trip
+    (``BYTEPS_INTEGRITY_LOOPBACK``, default on) — valid ONLY while no
+    chaos is armed: an in-process "wire" is the caller's own memory, so
+    the CRC would verify bytes against themselves.  Receivers must still
+    SNAPSHOT the payload (the envelope path's open() handed them fresh
+    memory; an async merge reading the caller's live buffer would be a
+    semantic regression) and must re-check ``fault.injector.ENABLED`` at
+    each hop; with chaos armed the full envelope path runs so injected
+    corruption is still caught."""
+    from .config import get_config
+    return get_config().integrity_loopback
+
+
 # -- CRC32C backend ---------------------------------------------------------
 
 _crc_impl: Optional[Callable[[bytes, int], int]] = None
@@ -180,7 +195,10 @@ def crc32c(data, crc: int = 0) -> int:
 # -- sealing ----------------------------------------------------------------
 
 def _seal(kind: int, key: str, worker: int, seq: int, dtype_s: str,
-          shape: Tuple[int, ...], payload: bytes) -> bytes:
+          shape: Tuple[int, ...], payload) -> bytes:
+    # ``payload`` is any C-contiguous buffer (bytes or a memoryview over
+    # the caller's array memory): the CRC runs incrementally over the
+    # view and ``join`` copies it exactly once — into the frame itself.
     kb = key.encode("utf-8")
     db = dtype_s.encode("ascii")
     head = _FIXED.pack(MAGIC, VERSION, kind, len(kb), worker, seq,
@@ -195,12 +213,17 @@ def _seal(kind: int, key: str, worker: int, seq: int, dtype_s: str,
 
 def seal_array(arr, *, key: str, seq: int = 0, worker: int = -1) -> bytes:
     """Wrap an ndarray for a host hop; shape/dtype ride the header so a
-    shape-mangled frame is as detectable as a flipped data bit."""
+    shape-mangled frame is as detectable as a flipped data bit.
+
+    Zero staging copy: the payload is CRC'd and joined straight from the
+    array's own memory through a flat memoryview (``tobytes`` used to
+    materialize a second full copy of every gradient just to hash it);
+    only a non-contiguous input pays a compaction first."""
     a = np.asarray(arr)
     shape = a.shape  # ascontiguousarray promotes 0-d to (1,): keep ours
     a = np.ascontiguousarray(a)
     return _seal(KIND_NDARRAY, key, worker, seq, a.dtype.str, shape,
-                 a.tobytes())
+                 memoryview(a).cast("B"))
 
 
 def seal_bytes(data: bytes, *, key: str, seq: int = 0,

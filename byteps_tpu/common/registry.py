@@ -85,6 +85,7 @@ class TensorRegistry:
             ctx.num_elems = num_elems
             ctx.nbytes = num_elems * np_dtype.itemsize
             ctx.chunk_bounds = bounds
+            ctx.partition_bytes = partition_bytes
             ctx.key_list = [make_key(ctx.declared_key, i)
                             for i in range(len(bounds))]
             ctx.compression_kwargs = dict(compression_kwargs or {})
@@ -94,6 +95,33 @@ class TensorRegistry:
                 len(bounds)
             )
         return ctx
+
+    @staticmethod
+    def repartition_locked(ctx: TensorContext, partition_bytes: int) -> bool:
+        """Re-carve an initialized tensor's chunk bounds under a new
+        partition bound (the auto-tuned planner's chosen chunk size).
+        Caller holds ``ctx.lock`` and has checked ``ctx.inflight == 0`` —
+        bounds must never move under an outstanding push.  Compressed
+        tensors never repartition (their per-chunk compressor state is
+        tied to the chunk geometry).  Returns True when bounds changed."""
+        if (not ctx.initialized or ctx.compressor is not None
+                or ctx.compression_kwargs
+                or partition_bytes == ctx.partition_bytes):
+            return False
+        bounds = chunk_bounds(ctx.num_elems,
+                              np.dtype(ctx.dtype_name).itemsize,
+                              partition_bytes)
+        ctx.partition_bytes = partition_bytes
+        if bounds == ctx.chunk_bounds:
+            return False
+        ctx.chunk_bounds = bounds
+        ctx.key_list = [make_key(ctx.declared_key, i)
+                        for i in range(len(bounds))]
+        ctx.scatter_layout = None   # recomputed lazily for the new bounds
+        get_logger().debug(
+            "repartitioned tensor %s: %d chunk(s) at %d B", ctx.name,
+            len(bounds), partition_bytes)
+        return True
 
     def get(self, name: str) -> Optional[TensorContext]:
         with self._lock:

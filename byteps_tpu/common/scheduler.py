@@ -24,6 +24,7 @@ import heapq
 import threading
 from typing import List, Optional
 
+from .config import ALIGN_BYTES
 from .types import ChunkTask
 
 
@@ -38,6 +39,8 @@ class ChunkScheduler:
         self._heap: List[tuple] = []
         self._seq = 0
         self._cv = threading.Condition()
+        self._interrupts = 0   # one-shot wakeups (pause handshake)
+        self._shutdown = False  # latched wake (engine teardown)
 
     # -- producer side -----------------------------------------------------
     def add_task(self, task: ChunkTask) -> None:
@@ -60,17 +63,58 @@ class ChunkScheduler:
 
     def get_task(self, block: bool = False,
                  timeout: Optional[float] = None) -> Optional[ChunkTask]:
-        """Pop the highest-priority task if the credit window allows it."""
+        """Pop the highest-priority task if the credit window allows it.
+
+        ``block=True`` with no timeout parks on the condition variable
+        until a task becomes eligible or :meth:`interrupt`/:meth:`wake`
+        fires — the dispatcher's idle wait costs zero CPU (no polling
+        quantum).  An interrupted call returns ``None``."""
         with self._cv:
             if block:
-                self._cv.wait_for(self._eligible_locked, timeout=timeout)
+                self._cv.wait_for(
+                    lambda: (self._eligible_locked() or self._shutdown
+                             or self._interrupts > 0),
+                    timeout=timeout)
+            if block and self._interrupts > 0:
+                self._interrupts -= 1
             if not self._eligible_locked():
                 return None
             _, _, task = heapq.heappop(self._heap)
             self._in_flight += task.nbytes
             return task
 
+    def interrupt(self) -> None:
+        """One-shot wakeup: the next (or currently blocked) get_task
+        returns promptly even with nothing eligible.  The pause-dispatch
+        handshake's half of the no-busy-wait design."""
+        with self._cv:
+            self._interrupts += 1
+            self._cv.notify_all()
+
+    def wake(self) -> None:
+        """Latched wakeup: every blocked and future get_task returns
+        without waiting (engine shutdown).  Queue contents survive for
+        :meth:`drain` — mirrors the native scheduler's bps_sched_wake."""
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def set_credit_bytes(self, credit_bytes: int) -> None:
+        """Retarget the credit window (the planner's tuned value); a wider
+        window may make queued tasks eligible, so waiters are notified."""
+        with self._cv:
+            self._credit_limit = int(credit_bytes)
+            self._cv.notify_all()
+
+    @property
+    def credit_bytes(self) -> int:
+        with self._cv:
+            return self._credit_limit
+
     def report_finish(self, nbytes: int) -> None:
+        """Return credits; a batched syncer passes one summed total per
+        retire sweep (one lock round-trip for the whole dispatch unit
+        batch instead of one per chunk)."""
         with self._cv:
             self._in_flight = max(0, self._in_flight - nbytes)
             self._cv.notify()
@@ -92,3 +136,175 @@ class ChunkScheduler:
             tasks = [t for _, _, t in sorted(self._heap)]
             self._heap.clear()
             return tasks
+
+
+# --------------------------------------------------------------------------
+# Auto-tuned chunk/credit planner
+# --------------------------------------------------------------------------
+
+# Per-(size-bucket, candidate) samples required before the planner moves
+# on; min-of-samples scoring rejects one-off outliers (a GC pause, a
+# first-touch compile) without needing a long exploration phase.
+_PLAN_SAMPLES = 2
+# Chunk sizes stay on the partitioner's alignment so tuned bounds keep
+# the vreg-tile guarantees; the ONE canonical constant lives in config
+# (a drifted copy here would let the planner emit bounds that violate
+# the tiling the partitioner rounds to).
+_PLAN_ALIGN = ALIGN_BYTES
+
+
+class ChunkPlanner:
+    """Online (chunk-size, credit-window) tuner for the push_pull hot path.
+
+    The reference ships BYTEPS_PARTITION_BYTES and BYTEPS_SCHEDULING_CREDIT
+    as hand-tuned deployment knobs (global.cc:134-144,
+    scheduled_queue.cc:33-45); the right values depend on the host's
+    dispatch overhead and the mesh's per-program cost, which this planner
+    measures instead of assuming.  Per tensor-size bucket (power of two of
+    nbytes) it explores a small candidate ladder — the configured bound,
+    the whole tensor, and halves down to a floor — scoring each candidate
+    by the best observed wall seconds of a completed push_pull, then locks
+    the winner.  Locking matters twice over: steady state stops paying
+    exploration dispatch patterns, and the compiled-program set stops
+    growing (the zero-new-compiles-after-warmup contract the regression
+    test enforces).
+
+    Reproducibility: a pinned knob (env var present, or a non-default
+    Config value) is never tuned; multi-process meshes never tune at all —
+    SPMD processes must dispatch identical programs in identical order,
+    and per-host timing would diverge their choices.
+
+    Known blind spot: the compile-pollution discard keys off the engine's
+    program-cache miss counter, which cannot see a RETRACE inside a
+    shape-generic jit wrapper (the single-chunk collectives serve many
+    shapes under one cache key) — a concurrent first-push of another
+    tensor can smuggle such a compile into a kept sample.  Min-of-samples
+    scoring bounds the damage (a polluted sample only mis-locks a bucket
+    if EVERY sample of the true winner was also polluted), and the
+    round-robin candidate order keeps one bad wall-clock window from
+    landing entirely on one candidate.
+    """
+
+    def __init__(self, cfg, num_procs: int = 1):
+        self._base = cfg.partition_bytes
+        self._tune_partition = (cfg.autotune and not cfg.partition_pinned
+                                and num_procs == 1)
+        self._tune_credit = (cfg.autotune and not cfg.credit_pinned
+                             and num_procs == 1)
+        self._buckets = {}          # bucket -> state dict
+        self._lock = threading.Lock()
+        self._credit = 0            # 0 = leave the scheduler unlimited
+
+    @property
+    def active(self) -> bool:
+        return self._tune_partition
+
+    # -- plan --------------------------------------------------------------
+    def _candidates(self, nbytes: int) -> List[int]:
+        def align(b):
+            b = max(_PLAN_ALIGN, int(b))
+            r = b % _PLAN_ALIGN
+            return b + (_PLAN_ALIGN - r) if r else b
+
+        ladder = [self._base, align(nbytes), align(nbytes // 2),
+                  align(nbytes // 4)]
+        out = []
+        for c in ladder:
+            if c >= _PLAN_ALIGN and c not in out:
+                out.append(c)
+        return out
+
+    def plan_partition(self, nbytes: int) -> int:
+        """Partition bound to use right now for a tensor of ``nbytes``.
+        Tensors at or under the configured bound are single-chunk either
+        way — nothing to tune.
+
+        Exploration is ROUND-ROBIN (fewest-samples candidate first, ladder
+        order on ties), not sequential blocks: a shared host's speed is
+        often bimodal on a seconds timescale, and a candidate whose whole
+        sample block landed in the slow regime would lose to one sampled
+        in the fast regime on host luck, not merit — interleaving spreads
+        every candidate across the regimes (the same reasoning as the
+        overlap bench's round interleaving)."""
+        if not self._tune_partition or nbytes <= self._base:
+            return self._base
+        bucket = nbytes.bit_length()
+        with self._lock:
+            st = self._buckets.get(bucket)
+            if st is None:
+                st = {"cands": self._candidates(nbytes),
+                      "samples": {}, "locked": None}
+                self._buckets[bucket] = st
+            if st["locked"] is not None:
+                return st["locked"]
+            return min(st["cands"],
+                       key=lambda c: len(st["samples"].get(c, ())))
+
+    # -- observe -----------------------------------------------------------
+    def observe(self, nbytes: int, partition_bytes: int, seconds: float,
+                compiled: bool = False) -> None:
+        """Record one completed push_pull.  ``compiled=True`` (a program
+        compile landed inside this push's window) discards the sample —
+        compile time must not be charged to the candidate."""
+        if (not self._tune_partition or nbytes <= self._base
+                or seconds <= 0 or compiled):
+            return
+        bucket = nbytes.bit_length()
+        with self._lock:
+            st = self._buckets.get(bucket)
+            if st is None or st["locked"] is not None:
+                return
+            if partition_bytes not in st["cands"]:
+                return  # carved under an earlier plan / repartition race
+            st["samples"].setdefault(partition_bytes, []).append(seconds)
+            if any(len(st["samples"].get(c, ())) < _PLAN_SAMPLES
+                   for c in st["cands"]):
+                return
+            # every candidate sampled: lock the winner (min-of-samples)
+            best = min(st["cands"],
+                       key=lambda c: min(st["samples"].get(c, [float("inf")]))
+                       )
+            st["locked"] = best
+            self._update_credit_locked()
+
+    def _update_credit_locked(self) -> None:
+        """Tuned credit window: enough for a handful of the largest locked
+        chunk so the dispatcher pipelines without letting one giant
+        low-priority tensor monopolize the queue (the reference's credit
+        rationale, scheduled_queue.cc:33-45)."""
+        if not self._tune_credit:
+            return
+        largest = max((st["locked"] for st in self._buckets.values()
+                       if st["locked"] is not None), default=0)
+        if largest:
+            self._credit = 4 * largest
+
+    def credit_bytes(self) -> int:
+        """The planner's current credit-window suggestion (0 = leave the
+        scheduler's window as configured)."""
+        with self._lock:
+            return self._credit
+
+    def locked(self, nbytes: int) -> bool:
+        if not self._tune_partition or nbytes <= self._base:
+            return True             # nothing left to explore
+        with self._lock:
+            st = self._buckets.get(nbytes.bit_length())
+            return st is not None and st["locked"] is not None
+
+    def snapshot(self) -> dict:
+        """Chosen knobs for the bench JSON / telemetry: per-bucket locked
+        chunk size (or exploration progress) and the credit suggestion."""
+        with self._lock:
+            buckets = {}
+            for b, st in self._buckets.items():
+                buckets[str(b)] = {
+                    "locked_partition_bytes": st["locked"],
+                    "explored": {str(k): round(min(v), 6)
+                                 for k, v in st["samples"].items() if v},
+                }
+            return {"tuning_partition": self._tune_partition,
+                    "tuning_credit": self._tune_credit,
+                    "base_partition_bytes": self._base,
+                    "credit_bytes": self._credit,
+                    "buckets": buckets}

@@ -59,6 +59,73 @@ class Counters:
 counters = Counters()
 
 
+class Gauges:
+    """Thread-safe last-value gauges (point-in-time readings, unlike the
+    monotonic :class:`Counters`): scheduler queue depth, bytes in flight,
+    the planner's current chunk choice.  Process-wide singleton below."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._g: Dict[str, float] = {}
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._g[name] = value
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._g.get(name, default)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._g)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._g.clear()
+
+
+gauges = Gauges()
+
+
+class Histograms:
+    """Power-of-two-bucketed histograms for dispatch-path distributions
+    (dispatch-unit width, per-unit sync latency).  A value v lands in
+    bucket ``2**ceil(log2(v))`` (v <= 0 lands in bucket 0), so the
+    bucket set is tiny and needs no pre-declaration.  Snapshot shape:
+    ``{name: {bucket_upper_bound: count}}``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._h: Dict[str, Dict[int, int]] = {}
+
+    def observe(self, name: str, value: float, n: int = 1) -> None:
+        if value <= 0:
+            b = 0
+        else:
+            b = 1
+            while b < value:
+                b <<= 1
+        with self._lock:
+            buckets = self._h.setdefault(name, {})
+            buckets[b] = buckets.get(b, 0) + n
+
+    def snapshot(self) -> Dict[str, Dict[int, int]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._h.items()}
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return sum(self._h.get(name, {}).values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._h.clear()
+
+
+histograms = Histograms()
+
+
 class SpeedMonitor:
     def __init__(self, window_sec: float = 10.0, history: int = 60):
         self._window = window_sec

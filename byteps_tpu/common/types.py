@@ -184,6 +184,13 @@ class TensorContext:
     # view (comm.collectives.scatter_layout), or the string "ineligible"
     # when the chunk bounds don't admit the column layout
     scatter_layout: Any = None
+    # partition bound the current chunk_bounds were carved with; the
+    # auto-tuned planner re-carves (TensorRegistry.repartition) when its
+    # plan moves and no push of this tensor is in flight
+    partition_bytes: int = 0
+    # pushes enqueued but not yet completed (guards repartition: chunk
+    # bounds must never change under an outstanding push)
+    inflight: int = 0
     # profiling
     version: int = 0
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)

@@ -191,10 +191,23 @@ def local_rank() -> int:
     return 0  # one controller process per host owns all local chips
 
 
-def declare(name: str) -> int:
+def declare(name: str, shape=None, dtype=None, op: str = "average",
+            compression: Optional[Dict[str, str]] = None,
+            local: Optional[bool] = None,
+            replicate_out: bool = False) -> int:
     """Pre-declare a tensor; returns its declared key.  Usable before init
-    (reference declare_tensor can run before byteps_lazy_init completes)."""
+    (reference declare_tensor can run before byteps_lazy_init completes).
+
+    With ``shape`` (and optionally ``dtype``, default float32) on a
+    running engine, additionally AOT-compiles the tensor's steady-state
+    program set so its first push_pull dispatches with zero compile
+    stalls (PushPullEngine.declare_tensor)."""
     if _engine is not None:
+        if shape is not None:
+            return _engine.declare_tensor(
+                name, shape, dtype if dtype is not None else "float32",
+                op=op, local=local, compression=compression,
+                replicate_out=replicate_out).declared_key
         return _engine.registry.declare(name).declared_key
     if name not in _declared_order:
         _declared_order.append(name)

@@ -21,17 +21,20 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..comm.collectives import (_as_stacked, assemble_scatter, pad_stacked,
+from ..comm.collectives import (_as_stacked, aot_warm_buffer_programs,
+                                aot_warm_single_program, assemble_scatter,
+                                assemble_shardable, pad_stacked,
                                 push_pull_array, push_pull_array_scaled,
                                 push_pull_arrays_batched,
                                 push_pull_chunk_scatter, scatter_layout,
-                                stage_local_replicated)
+                                stage_local_replicated, stage_local_sharded)
 from ..comm.compressed import compressed_all_reduce
 from ..comm.mesh import CommContext
 from ..compression import registry as compression_registry
@@ -40,8 +43,9 @@ from ..common.config import Config
 from ..common.handles import Handle, HandleManager
 from ..common.logging import get_logger
 from ..common.registry import TensorRegistry
-from ..common.scheduler import ChunkScheduler
-from ..common.telemetry import SpeedMonitor, counters
+from ..common.scheduler import ChunkPlanner, ChunkScheduler
+from ..common.telemetry import (SpeedMonitor, counters, gauges,
+                                histograms)
 from ..common.tracing import Tracer
 from ..common.types import ChunkTask, Status, StatusCode, TensorContext
 from ..fault import injector as _fault
@@ -61,10 +65,6 @@ def _stale_epoch_error(task, epoch: int) -> StaleEpochError:
         f"stale membership epoch: chunk {task.name!r} key={task.key} was "
         f"enqueued at epoch {task.pending.mepoch}, the world is now at "
         f"epoch {epoch}; chunk dropped, re-push under the new epoch")
-
-# One blocking-pop quantum: the dispatcher re-checks its run/pause flags
-# at least this often, and pause_dispatch() sizes its settle wait from it.
-_GET_TASK_TIMEOUT = 0.05
 
 
 def _pow2_split(seq):
@@ -178,7 +178,7 @@ class _PendingTensor:
 
     def __init__(self, handle: Handle, ctx: TensorContext, out_shape, op: str,
                  denom: int, use_buffer: bool = False, comm=None,
-                 scale=None):
+                 scale=None, shard_out: bool = False):
         self.handle = handle
         self.ctx = ctx
         self.out_shape = out_shape
@@ -190,6 +190,13 @@ class _PendingTensor:
         self.buf = None          # dispatcher-owned until completion
         self.comm = comm
         self.scale = scale       # fused scale, applied by assemble
+        self.shard_out = shard_out  # deferred-gather assembly
+        self.local_mode = False  # staging mode (False | True | "sharded")
+        # chunk bounds snapshot: the planner can repartition the ctx for a
+        # LATER push while this one is in flight-free... bounds are only
+        # re-carved at inflight == 0, but the snapshot keeps assemble and
+        # the bounds this push was carved with in one place regardless
+        self.scatter_layout_snap = ctx.scatter_layout
         # membership epoch at enqueue: a world change (fault/membership)
         # advances the global epoch and every chunk still carrying the
         # old one is dropped, not delivered — the whole-world analog of
@@ -208,10 +215,11 @@ class _PendingTensor:
 
     def assemble(self):
         if self.use_buffer:
-            _, C = self.ctx.scatter_layout
+            _, C = self.scatter_layout_snap
             return assemble_scatter(
                 self.comm, self.buf, self.ctx.num_elems, C, self.out_shape,
-                self.ctx.dtype_name, scale=self.scale, denom=self.denom)
+                self.ctx.dtype_name, scale=self.scale, denom=self.denom,
+                shard_out=self.shard_out)
         if self.total == 1:
             flat = self.parts[0]
         else:
@@ -255,8 +263,14 @@ class PushPullEngine:
         # dispatch amortization accounting: programs launched vs chunk
         # tasks consumed (the bench's engine_grouped_* evidence)
         self.stats = {"dispatches": 0, "chunks": 0}
+        # Auto-tuned chunk/credit planner: measures completed push_pulls
+        # and re-carves partition bounds per tensor-size bucket; inert
+        # when pinned (env/explicit config) or multi-process (SPMD
+        # processes must dispatch identical programs).
+        self.planner = ChunkPlanner(cfg, num_procs=jax.process_count())
         self._dispatch_enabled = threading.Event()
         self._dispatch_enabled.set()
+        self._parked = threading.Event()  # dispatcher pause handshake
         self._running = True
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="bps-dispatch", daemon=True)
@@ -286,6 +300,7 @@ class PushPullEngine:
                         denom: Optional[int] = None,
                         out_shape: Optional[tuple] = None,
                         local: bool = False,
+                        replicate_out: bool = False,
                         ) -> Handle:
         """Enqueue a rank-stacked tensor [R, ...] for reduction.
 
@@ -319,115 +334,359 @@ class PushPullEngine:
                     f"{self.comm.num_ranks}")
             if out_shape is None:
                 out_shape = stacked.shape[1:]
+        # Planner-chosen chunk size: for uncompressed tensors over the
+        # base bound the auto-tuner explores, then locks, a partition
+        # bytes per size bucket; an initialized tensor re-carves its
+        # bounds only between pushes (inflight == 0).
+        est_nbytes = self._est_nbytes(out_shape, stacked.dtype)
+        plan_bytes = (self.cfg.partition_bytes if compression
+                      else self.planner.plan_partition(est_nbytes))
         ctx = self.registry.init_tensor(
             name, out_shape, stacked.dtype, compression_kwargs=compression,
-            partition_bytes=self.cfg.partition_bytes)
-        if priority is None:
-            prio = -ctx.declared_key if self.cfg.enable_priority else 0
-        else:
-            prio = priority
-        handle = self.handles.allocate(name)
-        if denom is None:
-            denom = self.comm.num_ranks if op == "average" else 1
-        self._ensure_compression(ctx, stacked.dtype)
-        if local and ctx.compressor is not None:
-            # The tensor was declared WITH compression under this name by
-            # an earlier push: compressed chunks need materialized per-rank
-            # rows, so fall back to the broadcast-view stacked layout (the
-            # caller's gate only sees its own kwargs, not registry state).
-            stacked = np.broadcast_to(
-                np.asarray(stacked).reshape(-1)[None],
-                (self.comm.num_ranks, int(np.asarray(stacked).size)))
-            local = False
-        # Fused-scale fast path (float, uncompressed): the collective
-        # applies 1/denom in-graph, so assembly needs no eager divide or
-        # dtype restore — for small tensors those eager ops cost more than
-        # the collective itself.  Ints and compressed chunks keep the
-        # assembly-time division (exact // semantics / post-merge denom).
-        scale = None
-        if (denom != 1 and ctx.compressor is None
-                and jnp.issubdtype(np.dtype(stacked.dtype), jnp.inexact)):
-            scale = 1.0 / denom
-            denom = 1
-        nchunks = len(ctx.chunk_bounds)
-        # Buffer mode (the hot path): uncompressed multi-chunk tensors ride
-        # the fused slice -> reduce-scatter -> sharded-accumulator chunk
-        # programs; each dispatch consumes the previous accumulator by
-        # donation, and one assemble program gathers/scales/reshapes in a
-        # single order-identical pass.  Debug sampling needs per-chunk
-        # outputs, so it forces parts mode; so do chunk bounds the column
-        # layout can't express (non-power-of-2 meshes).
-        use_buffer = (nchunks > 1 and ctx.compressor is None
-                      and not self.cfg.debug_sample_tensor)
-        if use_buffer and ctx.scatter_layout is None:
-            with ctx.lock:
-                if ctx.scatter_layout is None:
-                    # "ineligible" is a computed-and-rejected marker so the
-                    # layout check runs once per tensor, not once per call
-                    ctx.scatter_layout = (scatter_layout(
-                        ctx.chunk_bounds, self.comm.n_ici) or "ineligible")
-        if use_buffer and ctx.scatter_layout == "ineligible":
-            use_buffer = False
-        pending = _PendingTensor(handle, ctx, out_shape, op, denom,
-                                 use_buffer, comm=self.comm, scale=scale)
+            partition_bytes=plan_bytes)
+        # Claim the push (inflight++) ATOMICALLY with the repartition
+        # decision: bounds may only move when no push holds a claim, and
+        # every geometry read below (chunk_bounds, key_list,
+        # scatter_layout) is stable only because this push already holds
+        # one — a late claim would let a concurrent push re-carve the
+        # bounds mid-read.
         with ctx.lock:
+            if ctx.inflight == 0 and ctx.partition_bytes != plan_bytes:
+                self.registry.repartition_locked(ctx, plan_bytes)
+            ctx.inflight += 1
             ctx.version += 1
             version = ctx.version
-
-        if self.tracer.enabled:
-            step = self.tracer.on_push(name)
-            t_enq = self.tracer.now()
-        else:  # keep the hot enqueue path lock-free when tracing is off
-            step, t_enq = 0, 0.0
-        if local:
-            # One n-byte host->device put + async on-device replication:
-            # replaces R host copies of the broadcast view (measured
-            # numbers in stage_local_replicated's docstring and the
-            # docs/performance.md "Host staging" table).
-            flat = stage_local_replicated(
-                self.comm, np.asarray(stacked).reshape(-1))
-        else:
-            flat = stacked.reshape(stacked.shape[0], -1)
-            if ctx.compressor is None:
-                # Stage to the mesh once; chunk programs slice in-graph (no
-                # per-chunk device_put / eager slice materialization).
-                flat = _as_stacked(self.comm, flat)
-        itemsize = np.dtype(stacked.dtype).itemsize
-        if use_buffer:
-            # Buffer-mode tasks are COLUMN slabs of the [n_ici, C] view
-            # (offset/num in columns).  nbytes below is taken from
-            # ctx.chunk_bounds (real element counts), so credit/telemetry
-            # accounting excludes the tail chunk's alignment pad.
-            col_layout, C = ctx.scatter_layout
-            flat = pad_stacked(self.comm, flat, C * self.comm.n_ici)
-            bounds = col_layout
-        else:
-            bounds = ctx.chunk_bounds
-        for part_idx, (off, ln) in enumerate(bounds):
-            # parts mode (compressed / debug-sample) needs the materialized
-            # chunk; buffer mode and single-chunk tensors pass the full flat
-            if nchunks > 1 and not use_buffer:
-                chunk = flat[off:off + ln] if local else flat[:, off:off + ln]
+        try:
+            # Per-push planner sample: wall seconds enqueue -> completion,
+            # discarded when a program compile landed inside the window.
+            # Zero overhead once the bucket locks.
+            track_plan = (not compression
+                          and not self.planner.locked(est_nbytes))
+            if track_plan:
+                t_plan0 = time.perf_counter()
+                miss0 = counters.get("engine.compile_cache_miss")
+                part_used = ctx.partition_bytes
+            if priority is None:
+                prio = -ctx.declared_key if self.cfg.enable_priority else 0
             else:
-                chunk = flat
-            task = ChunkTask(
-                name=name, key=ctx.key_list[part_idx], priority=prio,
-                version=version, offset_elems=off, num_elems=ln,
-                nbytes=ctx.chunk_bounds[part_idx][1] * itemsize,
-                total_parts=nchunks,
-                data=chunk,
-                compression=(ctx.compressor[part_idx]
-                             if ctx.compressor else None),
-                scale=scale,
-                pending=pending,
-                step=step, t_enqueue=t_enq,
-            )
-            task.callback = self._make_chunk_callback(pending, part_idx)
-            self.scheduler.add_task(task)
-        # Auto-release on completion: the manager tracks only outstanding
-        # work, so direct handle.wait() users don't leak table entries.
-        handle.add_done_callback(lambda h: self.handles.release(h.id))
-        return handle
+                prio = priority
+            handle = self.handles.allocate(name)
+            if denom is None:
+                denom = self.comm.num_ranks if op == "average" else 1
+            self._ensure_compression(ctx, stacked.dtype)
+            if local and ctx.compressor is not None:
+                # The tensor was declared WITH compression under this name by
+                # an earlier push: compressed chunks need materialized per-rank
+                # rows, so fall back to the broadcast-view stacked layout (the
+                # caller's gate only sees its own kwargs, not registry state).
+                stacked = np.broadcast_to(
+                    np.asarray(stacked).reshape(-1)[None],
+                    (self.comm.num_ranks, int(np.asarray(stacked).size)))
+                local = False
+            # Fused-scale fast path (float, uncompressed): the collective
+            # applies 1/denom in-graph, so assembly needs no eager divide or
+            # dtype restore — for small tensors those eager ops cost more than
+            # the collective itself.  Ints and compressed chunks keep the
+            # assembly-time division (exact // semantics / post-merge denom).
+            scale = None
+            if (denom != 1 and ctx.compressor is None
+                    and jnp.issubdtype(np.dtype(stacked.dtype), jnp.inexact)):
+                scale = 1.0 / denom
+                denom = 1
+            nchunks = len(ctx.chunk_bounds)
+            # Buffer mode (the hot path): uncompressed multi-chunk tensors —
+            # and large single-chunk ones (>= buffer_min_bytes, e.g. after
+            # the planner locked chunk=whole) — ride the fused slice ->
+            # reduce-scatter -> sharded-accumulator chunk programs; each
+            # dispatch consumes the previous accumulator by donation, and one
+            # assemble program scales/reshapes in a single order-identical
+            # pass.  Debug sampling needs per-chunk outputs, so it forces
+            # parts mode; so do chunk bounds the column layout can't express
+            # (non-power-of-2 meshes).
+            use_buffer = (ctx.compressor is None
+                          and not self.cfg.debug_sample_tensor
+                          and self._buffer_eligible(ctx))
+            if use_buffer and ctx.scatter_layout is None:
+                with ctx.lock:
+                    if ctx.scatter_layout is None:
+                        # "ineligible" is a computed-and-rejected marker so the
+                        # layout check runs once per tensor, not once per call
+                        ctx.scatter_layout = (scatter_layout(
+                            ctx.chunk_bounds, self.comm.n_ici) or "ineligible")
+            if use_buffer and ctx.scatter_layout == "ineligible":
+                use_buffer = False
+            # Deferred-gather assembly: the result stays block-sharded over
+            # the mesh when the output shape admits it — XLA materializes the
+            # all-gather only where a consumer needs replicated values, and
+            # mesh-aligned tensors assemble with zero cross-device movement.
+            # ``replicate_out``: callers that will immediately read the full
+            # result on host (the torch/TF adapters' np.asarray) opt OUT —
+            # eager assembly then runs the gather on the syncer thread,
+            # pipelined with other transport, instead of serializing it into
+            # the caller's wait.
+            shard_out = (use_buffer and self.cfg.deferred_gather
+                         and not replicate_out
+                         and assemble_shardable(self.comm, out_shape))
+            pending = _PendingTensor(handle, ctx, out_shape, op, denom,
+                                     use_buffer, comm=self.comm, scale=scale,
+                                     shard_out=shard_out)
+            if self.tracer.enabled:
+                step = self.tracer.on_push(name)
+                t_enq = self.tracer.now()
+            else:  # keep the hot enqueue path lock-free when tracing is off
+                step, t_enq = 0, 0.0
+            local_mode = local
+            if local:
+                if use_buffer:
+                    col_layout0, C0 = ctx.scatter_layout
+                    n_pad0 = C0 * self.comm.n_ici
+                    # Sharded staging only for SINGLE-chunk tensors (the
+                    # planner's usual locked choice for tuned buckets):
+                    # the chunk program's in-graph all-gather runs once,
+                    # so gather + reduce-scatter is exactly an
+                    # all-reduce's wire movement.  A multi-chunk tensor
+                    # can dispatch as several runs, and EACH run's
+                    # program would re-gather the whole flat tensor —
+                    # replicated staging's one device fan-out is the
+                    # cheaper wire plan there.
+                    if self._sharded_staging_ok(col_layout0, C0):
+                        # ONE n-byte host->device transfer; pad rides the
+                        # same host memcpy, so no device pad program
+                        # either.
+                        flat = stage_local_sharded(self.comm, stacked, n_pad0)
+                        local_mode = "sharded"
+                if local_mode != "sharded":
+                    # One n-byte host->device put + async on-device
+                    # replication: replaces R host copies of the broadcast
+                    # view (stage_local_replicated's docstring and the
+                    # docs/performance.md "Host staging" table).
+                    flat = stage_local_replicated(
+                        self.comm, np.asarray(stacked).reshape(-1))
+            else:
+                flat = stacked.reshape(stacked.shape[0], -1)
+                if ctx.compressor is None:
+                    # Stage to the mesh once; chunk programs slice in-graph (no
+                    # per-chunk device_put / eager slice materialization).
+                    flat = _as_stacked(self.comm, flat)
+            pending.local_mode = local_mode
+            itemsize = np.dtype(stacked.dtype).itemsize
+            if use_buffer:
+                # Buffer-mode tasks are COLUMN slabs of the [n_ici, C] view
+                # (offset/num in columns).  nbytes below is taken from
+                # ctx.chunk_bounds (real element counts), so credit/telemetry
+                # accounting excludes the tail chunk's alignment pad.
+                col_layout, C = ctx.scatter_layout
+                if local_mode != "sharded":
+                    flat = pad_stacked(self.comm, flat, C * self.comm.n_ici)
+                bounds = col_layout
+            else:
+                bounds = ctx.chunk_bounds
+            for part_idx, (off, ln) in enumerate(bounds):
+                # parts mode (compressed / debug-sample) needs the materialized
+                # chunk; buffer mode and single-chunk tensors pass the full flat
+                if nchunks > 1 and not use_buffer:
+                    chunk = flat[off:off + ln] if local else flat[:, off:off + ln]
+                else:
+                    chunk = flat
+                task = ChunkTask(
+                    name=name, key=ctx.key_list[part_idx], priority=prio,
+                    version=version, offset_elems=off, num_elems=ln,
+                    nbytes=ctx.chunk_bounds[part_idx][1] * itemsize,
+                    total_parts=nchunks,
+                    data=chunk,
+                    compression=(ctx.compressor[part_idx]
+                                 if ctx.compressor else None),
+                    scale=scale,
+                    pending=pending,
+                    step=step, t_enqueue=t_enq,
+                )
+                task.callback = self._make_chunk_callback(pending, part_idx)
+                self.scheduler.add_task(task)
+            # Auto-release on completion: the manager tracks only outstanding
+            # work, so direct handle.wait() users don't leak table entries.
+            # The same hook closes the planner's measurement window and frees
+            # the tensor for repartitioning (inflight bookkeeping).
+            def _on_done(h):
+                with ctx.lock:
+                    ctx.inflight -= 1
+                if track_plan and h.status.code == StatusCode.OK:
+                    self.planner.observe(
+                        est_nbytes, part_used,
+                        time.perf_counter() - t_plan0,
+                        compiled=counters.get("engine.compile_cache_miss")
+                        != miss0)
+                    if self.planner.locked(est_nbytes) and self.tracer.enabled:
+                        # lock transition (track_plan implies it was unlocked
+                        # at enqueue): the moment exploration ended, with the
+                        # winning chunk size, visible in the timeline
+                        t_now = time.monotonic()
+                        self.tracer.record_span(
+                            "engine.planner_locked", t_now, t_now,
+                            tensor=name,
+                            partition_bytes=self.planner.plan_partition(
+                                est_nbytes))
+                    self._apply_planned_credit()
+                self.handles.release(h.id)
+
+            handle.add_done_callback(_on_done)
+            return handle
+        except BaseException:
+            # enqueue failed before the done-hook could own the
+            # claim: release it or the tensor can never
+            # repartition again
+            with ctx.lock:
+                ctx.inflight -= 1
+            raise
+
+    @staticmethod
+    def _est_nbytes(shape, dtype) -> int:
+        """Logical payload bytes of one tensor (planner bucket key);
+        shared by push_pull_async and declare_tensor so the bucket a
+        tensor warms under is the bucket its pushes are tracked in."""
+        shape = tuple(shape)
+        return ((int(np.prod(shape)) if shape else 1)
+                * np.dtype(dtype).itemsize)
+
+    def _buffer_eligible(self, ctx: TensorContext) -> bool:
+        """Size/chunk half of the buffer-mode routing predicate —
+        shared by dispatch and AOT warm so the two cannot drift (the
+        compression/debug-sampling exclusions live at the call sites
+        that can see them)."""
+        return (len(ctx.chunk_bounds) > 1
+                or ctx.nbytes >= self.cfg.buffer_min_bytes)
+
+    def _sharded_staging_ok(self, col_layout, C: int) -> bool:
+        """Sharded local staging is worth it only for SINGLE-run
+        layouts (each dispatched run re-gathers the whole flat tensor
+        in-graph) and possible only when the padded length divides the
+        ranks (the mesh cannot hold an uneven 1-D block sharding).
+        Shared by dispatch and AOT warm: a drifted copy would warm
+        staging variants the push path never dispatches."""
+        return (len(col_layout) == 1
+                and (C * self.comm.n_ici) % self.comm.num_ranks == 0)
+
+    def _apply_planned_credit(self) -> None:
+        """Install the planner's tuned credit window on the scheduler
+        (no-op until a bucket locks, or when the window is pinned).
+        Both scheduler backends implement the full interrupt/wake/credit
+        interface — the dispatch loop already assumes it, so no partial
+        scheduler can run this engine anyway."""
+        credit = self.planner.credit_bytes()
+        if credit and self.scheduler.credit_bytes != credit:
+            self.scheduler.set_credit_bytes(credit)
+            gauges.set("engine.credit_bytes", credit)
+
+    def declare_tensor(self, name: str, shape, dtype=np.float32, *,
+                       op: str = "average", local: Optional[bool] = None,
+                       compression: Optional[Dict[str, str]] = None,
+                       replicate_out: bool = False) -> TensorContext:
+        """Declare a tensor WITH geometry and AOT-compile its steady-state
+        program set (tentpole part 1: persistent compiled chunk programs).
+
+        ``bps.declare(name)`` only reserves the key; given shape/dtype the
+        engine can additionally pre-lower and compile every program the
+        tensor's pushes will dispatch — chunk-scatter executables for each
+        reachable merge width (donated accumulator), the pad and assembly
+        programs, the single-chunk collective — and pre-stage the device
+        scalars, so the first push_pull runs at steady-state speed and a
+        declared stream compiles nothing afterwards.
+
+        ``local``: compile for the single-process local-contribution
+        staging (push_pull_local; the default when this process is the
+        whole world) or the rank-stacked layout.  Compressed tensors and
+        multi-process meshes skip the warm (per-chunk compressor state /
+        SPMD lockstep) — they compile lazily exactly as before.
+        """
+        shape = tuple(shape)
+        np_dtype = np.dtype(dtype)
+        est_nbytes = self._est_nbytes(shape, np_dtype)
+        plan_bytes = (self.cfg.partition_bytes if compression
+                      else self.planner.plan_partition(est_nbytes))
+        ctx = self.registry.init_tensor(name, shape, np_dtype,
+                                        compression_kwargs=compression,
+                                        partition_bytes=plan_bytes)
+        if (compression or ctx.compression_kwargs
+                or jax.process_count() > 1
+                or self.cfg.debug_sample_tensor):
+            return ctx
+        if local is None:
+            local = jax.process_count() == 1
+        t0 = time.monotonic()
+        try:
+            n_compiled = self._aot_warm(ctx, np_dtype, op=op, local=local,
+                                        replicate_out=replicate_out)
+            if n_compiled:
+                get_logger().debug("AOT-compiled %d program(s) for %s",
+                                   n_compiled, name)
+                if self.tracer.enabled:
+                    # compile stalls belong in the timeline at declare
+                    # time, where they were paid — not smeared over the
+                    # first push's span
+                    self.tracer.record_span(
+                        "engine.aot_warm", t0, time.monotonic(),
+                        tensor=name, programs=n_compiled)
+        except Exception:  # noqa: BLE001 — warm is an optimization only
+            counters.inc("engine.aot_compile_failed")
+            get_logger().debug("AOT warm failed for %s; programs compile "
+                               "lazily", name, exc_info=True)
+        return ctx
+
+    def _aot_warm(self, ctx: TensorContext, np_dtype, *, op: str,
+                  local: bool, replicate_out: bool = False) -> int:
+        """Compile the program set for one uncompressed tensor's pushes.
+
+        The denominator/scale model MUST mirror what push_pull will
+        actually dispatch, or the warmed keys are dead weight: a LOCAL
+        push divides out the local-replica over-count even for op="sum"
+        (push_pull_local_async's denom), and any float denom != 1 rides
+        the fused-scale fast path (scaled=True, denom folded to 1)."""
+        R = self.comm.num_ranks
+        inexact = jnp.issubdtype(np_dtype, jnp.inexact)
+        if local:
+            # single-process warm path (multi-process skips the warm):
+            # local_size == num_ranks, over-counted for sum AND average
+            base_denom = R
+        else:
+            base_denom = R if op == "average" else 1
+        scaled = inexact and base_denom != 1
+        scale_value = (1.0 / base_denom) if scaled else None
+        denom = 1 if scaled else base_denom
+        nchunks = len(ctx.chunk_bounds)
+        use_buffer = self._buffer_eligible(ctx)
+        if use_buffer:
+            with ctx.lock:
+                if ctx.scatter_layout is None:
+                    ctx.scatter_layout = (scatter_layout(
+                        ctx.chunk_bounds, self.comm.n_ici) or "ineligible")
+            use_buffer = ctx.scatter_layout != "ineligible"
+        if use_buffer:
+            col_layout, C = ctx.scatter_layout
+            # Warm the staging variant push_pull will dispatch: a
+            # SINGLE-chunk local contribution whose padded length divides
+            # the ranks rides the sharded staging (one n-byte transfer +
+            # one in-graph gather), otherwise the replicated fan-out
+            # (mirrors the staging decision in push_pull_async).
+            local_eff = local
+            if local and self._sharded_staging_ok(col_layout, C):
+                local_eff = "sharded"
+            # run widths the dispatcher can form: pow2 splits in drain
+            # mode, anything up to the group cap otherwise
+            if self._group_size < 0:
+                ks = {1 << i for i in range(max(1, nchunks).bit_length())}
+            else:
+                ks = set(range(1, self._group_size + 1))
+            return aot_warm_buffer_programs(
+                self.comm, col_layout=col_layout, C=C, n=ctx.num_elems,
+                out_shape=ctx.shape, dtype_name=ctx.dtype_name,
+                local=local_eff, scaled=scaled, denom=denom,
+                shard_out=(self.cfg.deferred_gather and not replicate_out
+                           and assemble_shardable(self.comm, ctx.shape)),
+                scale_value=scale_value, merge_widths=ks)
+        if nchunks == 1:
+            return aot_warm_single_program(
+                self.comm, n=ctx.num_elems, dtype_name=ctx.dtype_name,
+                scaled=scaled, local=local, scale_value=scale_value)
+        return 0
 
     def _ensure_compression(self, ctx: TensorContext, dtype) -> None:
         """Instantiate the per-chunk compressor chain on first use.
@@ -498,17 +757,22 @@ class PushPullEngine:
             get_logger().debug("debug sample for %s failed", task.name,
                                exc_info=True)
 
-    def pause_dispatch(self):
+    def pause_dispatch(self, timeout: float = 10.0):
         """Hold the dispatcher: tasks enqueue but nothing pops until
         :meth:`resume_dispatch`.  Used where the drain/merge width must
         be deterministic (the multichip dry-run's amortization assertion,
         tests) — merge width is otherwise a race between enqueue and
-        dispatch.  Waits out one blocking-pop quantum so a get_task call
-        already in flight when the flag flips cannot pop around the
-        pause."""
+        dispatch.  Event handshake, not a timed sleep: the gate is
+        cleared, a blocked pop is interrupted (one-shot scheduler
+        wakeup), and this call returns only once the dispatcher has
+        parked — any pop already in flight finishes its dispatch first,
+        so after return nothing pops until resume."""
         self._dispatch_enabled.clear()
-        import time
-        time.sleep(2 * _GET_TASK_TIMEOUT)
+        self.scheduler.interrupt()
+        if not self._parked.wait(timeout=timeout) and self._running:
+            get_logger().warning(
+                "pause_dispatch: dispatcher did not park within %.1fs",
+                timeout)
 
     def resume_dispatch(self):
         self._dispatch_enabled.set()
@@ -517,10 +781,16 @@ class PushPullEngine:
     def _dispatch_loop(self):
         while self._running:
             if not self._dispatch_enabled.is_set():
-                self._dispatch_enabled.wait(timeout=_GET_TASK_TIMEOUT)
+                # parked: zero-CPU wait on the resume event (the old
+                # design re-woke every poll quantum to re-check flags)
+                self._parked.set()
+                self._dispatch_enabled.wait()
+                self._parked.clear()
                 continue
-            task = self.scheduler.get_task(block=True,
-                                           timeout=_GET_TASK_TIMEOUT)
+            # Wakeup-driven blocking pop: returns when a task is
+            # eligible, or None when interrupted (pause handshake) /
+            # woken (shutdown) — the idle dispatcher burns no CPU.
+            task = self.scheduler.get_task(block=True)
             if task is None:
                 continue
             if _fault.ENABLED:
@@ -563,13 +833,23 @@ class PushPullEngine:
                     if t.pending is not None and t.pending.mepoch != ep:
                         counters.inc("membership.stale_chunks_dropped")
                         self._sync_q.put(([t], None, None,
-                                          _stale_epoch_error(t, ep)))
+                                          _stale_epoch_error(t, ep), 0.0))
                     else:
                         fresh.append(t)
                 batch = fresh
                 if not batch:
                     continue
+            if self.cfg.telemetry_on:
+                # point-in-time dispatch-path gauges (queue depth feeds
+                # the planner/overlap postmortems; sampling here costs
+                # one scheduler lock round-trip per dispatch iteration)
+                gauges.set("engine.sched_pending", self.scheduler.pending)
+                gauges.set("engine.bytes_in_flight",
+                           self.scheduler.bytes_in_flight)
             for kind, unit in _plan_batch(batch, pow2_runs=drain):
+                if self.cfg.telemetry_on:
+                    histograms.observe("engine.dispatch_unit_width",
+                                       len(unit))
                 if kind == "run":
                     self._dispatch_buffer_run(unit)
                 elif kind == "group":
@@ -589,15 +869,16 @@ class PushPullEngine:
         self.stats["dispatches"] += 1
         self.stats["chunks"] += len(run)
         try:
-            _, C = pending.ctx.scatter_layout
+            _, C = pending.scatter_layout_snap
             buf, token = push_pull_chunk_scatter(
                 self.comm, t0.data, pending.buf, t0.offset_elems,
-                t0.num_elems, len(run), C)
+                t0.num_elems, len(run), C, local=pending.local_mode)
             pending.buf = buf
-            self._sync_q.put((run, token, None, None))
+            self._sync_q.put((run, token, None, None,
+                              time.perf_counter()))
         except Exception as e:  # noqa: BLE001
             get_logger().error("dispatch failed for %s: %s", t0.name, e)
-            self._sync_q.put((run, None, None, e))
+            self._sync_q.put((run, None, None, e, 0.0))
 
     def _dispatch_parts_group(self, group: List[ChunkTask]):
         """One program for k equal-shape uncompressed chunks of distinct
@@ -614,10 +895,11 @@ class PushPullEngine:
             outs = push_pull_arrays_batched(
                 self.comm, [t.data for t in group], scale=t0.scale,
                 local=t0.data.ndim == 1)
-            self._sync_q.put((group, outs, None, None))
+            self._sync_q.put((group, outs, None, None,
+                              time.perf_counter()))
         except Exception as e:  # noqa: BLE001
             get_logger().error("dispatch failed for %s: %s", t0.name, e)
-            self._sync_q.put((group, None, None, e))
+            self._sync_q.put((group, None, None, e, 0.0))
 
     def _dispatch_single(self, task: ChunkTask):
         task.t_dispatch = self.tracer.now()
@@ -647,42 +929,72 @@ class PushPullEngine:
                 out = push_pull_array(self.comm, task.data, op="sum",
                                       keep_acc=True,
                                       local=task.data.ndim == 1)
-            self._sync_q.put(([task], out, rollback, None))
+            self._sync_q.put(([task], out, rollback, None,
+                              time.perf_counter()))
         except Exception as e:  # noqa: BLE001
             get_logger().error("dispatch failed for %s: %s", task.name, e)
-            self._sync_q.put(([task], None, None, e))
+            self._sync_q.put(([task], None, None, e, 0.0))
 
     def _sync_loop(self):
         # Exits only on the sentinel, which shutdown enqueues *after* the
         # dispatcher has joined — so a completion the dispatcher put just
         # before stopping can never be lost to a flag/empty-queue race.
-        while True:
-            item = self._sync_q.get()
-            if item is _SHUTDOWN:
-                break
-            tasks, out, rollback, err = item
-            if _fault.ENABLED:
-                # chaos site "sync": delay between completion and callback
-                _fault.fire("sync")
-            if err is None:
+        #
+        # Event-driven, per-UNIT retirement (ISSUE 5 tentpole part 2):
+        # each wakeup drains every completed-dispatch unit already queued
+        # and retires them one at a time in dispatch order — block on the
+        # unit's token, return the whole unit's scheduler credits in ONE
+        # call (the old path paid one credit lock per CHUNK), run its
+        # callbacks immediately.  Units retire as they complete, never
+        # behind a slower queue-mate: a whole-drain block_until_ready
+        # sweep measured ~15% SLOWER on the cross-barrier workload — a
+        # gate's handle sat unresolved until its batch's laggard
+        # finished, which is exactly the just-in-time latency the xb
+        # design sells.
+        shutdown = False
+        while not shutdown:
+            items = [self._sync_q.get()]
+            while True:  # opportunistic drain of everything already queued
                 try:
-                    # For buffer runs ``out`` is the completion token, not
-                    # the buffer: the buffer itself may already have been
-                    # donated into a later chunk's program.
-                    jax.block_until_ready(out)
-                except Exception as e:  # noqa: BLE001
-                    err = e
-                    if rollback is not None:
-                        slot, wst, sst = rollback
-                        slot.wstates = wst
-                        slot.sstate = sst
-            # Legacy-runtime serial mode (common/jax_compat.py): the
-            # callbacks below run eager assembly ops on this thread while
-            # the dispatcher executes programs on its own — the exact
-            # concurrency the old CPU runtime deadlocks on.  Null context
-            # on modern runtimes.
-            with jax_compat.runtime_lock():
-                self._finish_batch(tasks, out, err)
+                    items.append(self._sync_q.get_nowait())
+                except queue.Empty:
+                    break
+            for item in items:
+                if item is _SHUTDOWN:
+                    shutdown = True
+                    continue
+                if _fault.ENABLED:
+                    # chaos site "sync": delay completion -> callback
+                    _fault.fire("sync")
+                tasks, out, rollback, err, t_disp = item
+                if err is None:
+                    try:
+                        # For buffer runs ``out`` is the completion
+                        # token, not the buffer: the buffer itself may
+                        # already have been donated into a later chunk's
+                        # program.
+                        jax.block_until_ready(out)
+                    except Exception as e:  # noqa: BLE001
+                        err = e
+                        if rollback is not None:
+                            slot, wst, sst = rollback
+                            slot.wstates = wst
+                            slot.sstate = sst
+                # Unit credits back BEFORE callbacks, one lock op for the
+                # whole run: the dispatcher can launch the next window
+                # while this thread runs assembly.
+                self.scheduler.report_finish(sum(t.nbytes for t in tasks))
+                if self.cfg.telemetry_on and t_disp:
+                    histograms.observe(
+                        "engine.unit_sync_ms",
+                        (time.perf_counter() - t_disp) * 1e3)
+                # Legacy-runtime serial mode (common/jax_compat.py): the
+                # callbacks below run eager assembly ops on this thread
+                # while the dispatcher executes programs on its own — the
+                # exact concurrency the old CPU runtime deadlocks on.
+                # Null context on modern runtimes.
+                with jax_compat.runtime_lock():
+                    self._finish_batch(tasks, out, err)
 
     def _finish_batch(self, tasks, out, err):
         ep = _membership.current_epoch()
@@ -700,7 +1012,8 @@ class PushPullEngine:
             if err_t is None and not (task.pending is not None
                                       and task.pending.use_buffer):
                 self._debug_sample(task, out_t)
-            self.scheduler.report_finish(task.nbytes)
+            # credits for this task were returned in the sync loop's bulk
+            # report_finish — nothing per-chunk here
             if self.tracer.enabled:
                 t_done = self.tracer.now()
                 self.tracer.record(task.name, task.key, "queued",
@@ -739,6 +1052,10 @@ class PushPullEngine:
                 except Exception:  # noqa: BLE001
                     pass
         self._running = False
+        # wake a dispatcher blocked in the (timeout-free) pop or parked
+        # on the pause gate; the run flag is already down, so it exits
+        self._dispatch_enabled.set()
+        self.scheduler.wake()
         self._dispatcher.join(timeout=5)
         self._sync_q.put(_SHUTDOWN)
         self._syncer.join(timeout=5)

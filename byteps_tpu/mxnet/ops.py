@@ -80,7 +80,8 @@ def byteps_push_pull(tensor: Any, version: int = 0, priority: int = 0,
                               name,
                               op="average" if is_average else "sum",
                               priority=priority,
-                              compression=compression_kwargs(name))
+                              compression=compression_kwargs(name),
+                              replicate_out=True)
     tensor[:] = np.asarray(out).reshape(arr.shape)
 
 

@@ -66,7 +66,7 @@ def load() -> Optional[ctypes.CDLL]:
                 _compile(path)
                 lib = ctypes.CDLL(path)
             _declare_signatures(lib)
-            if lib.bps_native_abi_version() != 3:
+            if lib.bps_native_abi_version() != 4:
                 raise RuntimeError("native ABI mismatch")
             _lib = lib
         except Exception:
@@ -106,6 +106,10 @@ def _declare_signatures(lib: ctypes.CDLL) -> None:
                                   ctypes.POINTER(i64)]
     lib.bps_sched_report_finish.argtypes = [p, i64]
     lib.bps_sched_wake.argtypes = [p]
+    lib.bps_sched_interrupt.argtypes = [p]
+    lib.bps_sched_set_credit.argtypes = [p, i64]
+    lib.bps_sched_get_credit.restype = i64
+    lib.bps_sched_get_credit.argtypes = [p]
     lib.bps_sched_pending.restype = i64
     lib.bps_sched_pending.argtypes = [p]
     lib.bps_sched_in_flight.restype = i64
@@ -194,6 +198,17 @@ class NativeChunkScheduler:
         with self._mu:
             return [self._tasks.pop(ids[i]) for i in range(n)
                     if ids[i] in self._tasks]
+
+    def interrupt(self) -> None:
+        """One-shot wakeup of a blocked get_task (pause handshake)."""
+        self._lib.bps_sched_interrupt(self._h)
+
+    def set_credit_bytes(self, credit_bytes: int) -> None:
+        self._lib.bps_sched_set_credit(self._h, int(credit_bytes))
+
+    @property
+    def credit_bytes(self) -> int:
+        return int(self._lib.bps_sched_get_credit(self._h))
 
     def wake(self) -> None:
         """Release any blocked get_task (engine shutdown)."""

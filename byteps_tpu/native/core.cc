@@ -52,6 +52,7 @@ struct Scheduler {
   int64_t credit_limit;
   int64_t in_flight = 0;
   int64_t seq = 0;
+  int64_t interrupts = 0;  // one-shot wake tokens (pause handshake)
   bool shutdown = false;
 
   bool eligible() const {
@@ -185,13 +186,16 @@ int64_t bps_sched_get(void* p, int block, double timeout_s,
                       int64_t* out_nbytes) {
   auto* s = static_cast<Scheduler*>(p);
   std::unique_lock<std::mutex> lk(s->mu);
-  auto pred = [s] { return s->shutdown || s->eligible(); };
+  auto pred = [s] {
+    return s->shutdown || s->interrupts > 0 || s->eligible();
+  };
   if (block) {
     if (timeout_s < 0) {
       s->cv.wait(lk, pred);
     } else {
       s->cv.wait_for(lk, std::chrono::duration<double>(timeout_s), pred);
     }
+    if (s->interrupts > 0) --s->interrupts;
   }
   if (!s->eligible()) return -1;
   Task t = s->heap.top();
@@ -208,6 +212,35 @@ void bps_sched_report_finish(void* p, int64_t nbytes) {
     s->in_flight = std::max<int64_t>(0, s->in_flight - nbytes);
   }
   s->cv.notify_all();
+}
+
+// One-shot wakeup: the next (or currently blocked) bps_sched_get returns
+// promptly even with nothing eligible — the engine's pause-dispatch
+// handshake, resumable unlike the shutdown latch below.
+void bps_sched_interrupt(void* p) {
+  auto* s = static_cast<Scheduler*>(p);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    ++s->interrupts;
+  }
+  s->cv.notify_all();
+}
+
+// Retarget the credit window in place (the auto-tuned planner's value); a
+// wider window can make queued tasks eligible, so waiters are notified.
+void bps_sched_set_credit(void* p, int64_t credit_bytes) {
+  auto* s = static_cast<Scheduler*>(p);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->credit_limit = credit_bytes;
+  }
+  s->cv.notify_all();
+}
+
+int64_t bps_sched_get_credit(void* p) {
+  auto* s = static_cast<Scheduler*>(p);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->credit_limit;
 }
 
 // Wake every blocked bps_sched_get (shutdown path); queue contents survive
@@ -496,6 +529,6 @@ uint32_t bps_crc32c(const uint8_t* p, int64_t n, uint32_t crc) {
   return ~crc;
 }
 
-int bps_native_abi_version() { return 3; }
+int bps_native_abi_version() { return 4; }
 
 }  // extern "C"

@@ -297,11 +297,27 @@ class ServerEngine:
             return
         arr = np.asarray(value)
         if _integrity.enabled():
-            # the loopback wire: seal → (chaos corrupts the frame) →
-            # verify-on-receive, with bounded NACK-driven retransmit from
-            # the sealed source copy.  A frame still corrupt past the
-            # budget raises IntegrityError to the caller.
-            arr = self._wire_recv_array(key, arr, worker_id)
+            if _integrity.loopback_fast() and not _fault.ENABLED:
+                # In-process hop with no chaos armed: the "wire" is the
+                # caller's own memory, so seal -> CRC -> open would verify
+                # bytes against themselves — provably redundant.  The
+                # receiver still SNAPSHOTS the contribution (one plain
+                # copy — push() is async and the caller may reuse its
+                # gradient buffer before the engine thread merges; the
+                # envelope path always copied via seal->open too): what
+                # the fast path skips is the two CRC passes and the frame
+                # build, while every BYTEPS_INTEGRITY=1 semantic
+                # downstream — non-finite screen, quarantine, dedup —
+                # still runs.
+                counters.inc("integrity.loopback_fast")
+                arr = np.array(arr)
+                arr.flags.writeable = False
+            else:
+                # the loopback wire: seal → (chaos corrupts the frame) →
+                # verify-on-receive, with bounded NACK-driven retransmit
+                # from the sealed source copy.  A frame still corrupt past
+                # the budget raises IntegrityError to the caller.
+                arr = self._wire_recv_array(key, arr, worker_id)
         elif _fault.ENABLED:
             # integrity off: the bitflip lands silently in this worker's
             # contribution — the unprotected baseline the envelope fixes
@@ -564,13 +580,18 @@ class ServerEngine:
             return
         comp = self._codec(key).comp
         if _integrity.enabled():
-            seq = next(self._wire_seq)
-            frame = _integrity.seal_bytes(data, key=key, seq=seq,
-                                          worker=worker_id)
-            data = _integrity.wire_transmit(
-                frame, key=key, worker=worker_id, seq=seq,
-                site="server_push", opener=_integrity.open_bytes,
-                who="server engine")
+            if _integrity.loopback_fast() and not _fault.ENABLED:
+                # same in-process fast path as push(): the wire bytes are
+                # already the caller's buffer, nothing to re-CRC
+                counters.inc("integrity.loopback_fast")
+            else:
+                seq = next(self._wire_seq)
+                frame = _integrity.seal_bytes(data, key=key, seq=seq,
+                                              worker=worker_id)
+                data = _integrity.wire_transmit(
+                    frame, key=key, worker=worker_id, seq=seq,
+                    site="server_push", opener=_integrity.open_bytes,
+                    who="server engine")
             value = np.asarray(comp.decompress(comp.wire_decode(
                 bytes(data))))
             self._push_checked(key, value, worker_id, num_workers)

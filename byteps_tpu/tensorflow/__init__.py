@@ -62,7 +62,8 @@ def _engine_reduce(x: np.ndarray, name: str, op: str,
     eng = _api._require()
     out = eng.push_pull_local(np.ascontiguousarray(x), name, op=op,
                               priority=priority,
-                              compression=compression_kwargs)
+                              compression=compression_kwargs,
+                              replicate_out=True)
     return np.asarray(out)
 
 
@@ -145,9 +146,13 @@ def push_pull_async(tensor, name: Optional[str] = None, average: bool = True,
     eng = _api._require()
     arr = np.ascontiguousarray(tensor.numpy() if hasattr(tensor, "numpy")
                                else np.asarray(tensor))
+    # replicate_out: TF reads the result straight back to host memory,
+    # so eager (gathered) assembly on the syncer thread beats a deferred
+    # gather that would land in this caller's wait
     return eng.push_pull_local_async(
         arr, name or _anon_name(), op="average" if average else "sum",
-        priority=priority, compression=compression_kwargs)
+        priority=priority, compression=compression_kwargs,
+        replicate_out=True)
 
 
 # ------------------------------------------------------------ broadcast
@@ -266,7 +271,7 @@ def _reduce_grads(grads, compression, op: str, priority_by_index: bool,
             handles.append((vn.shape, eng.push_pull_local_async(
                 np.ascontiguousarray(vn), _stable_grad_name(scope, i),
                 op=opl, priority=-i if priority_by_index else None,
-                compression=compression_kwargs)))
+                compression=compression_kwargs, replicate_out=True)))
         results = []
         for shape, h in handles:
             results.append(np.asarray(h.wait()).reshape(shape))
@@ -465,7 +470,8 @@ def reduce_gradients_eager(grads, scope: Optional[str] = None,
         vn = g.numpy() if hasattr(g, "numpy") else np.asarray(g)
         handles.append((i, vn.shape, eng.push_pull_local_async(
             np.ascontiguousarray(vn), _stable_grad_name(scope, i),
-            op=op, priority=-i, compression=compression_kwargs)))
+            op=op, priority=-i, compression=compression_kwargs,
+            replicate_out=True)))
     out = list(grads)
     for i, shape, h in handles:
         out[i] = tf.constant(np.asarray(h.wait()).reshape(shape),

@@ -74,10 +74,14 @@ def push_pull_async(tensor: torch.Tensor, average: bool = True,
     """Async reduce of this process's tensor across all processes
     (reference byteps_torch_push_pull_async_*, torch/ops.py:69-76)."""
     eng = _api._require()
+    # replicate_out: the result comes straight back to host memory
+    # (_to_torch's np.array), so deferred-gather output would only move
+    # the all-gather into this caller's wait — eager assembly runs it on
+    # the syncer thread instead, pipelined with other transport.
     return eng.push_pull_local_async(
         _to_jnp(tensor), name or _anon_name(),
         op="average" if average else "sum",
-        priority=priority, compression=compression)
+        priority=priority, compression=compression, replicate_out=True)
 
 
 class BytePSPushPull(torch.autograd.Function):

@@ -25,6 +25,21 @@ from ..common.handles import Handle
 from . import push_pull_async, _to_torch
 
 
+def _declare_grad(name: str, p: torch.nn.Parameter, compression) -> None:
+    """Declare one gradient's key — with its geometry when possible, so
+    the engine AOT-compiles the steady-state program set at wrap time
+    (PushPullEngine.declare_tensor) and the first backward dispatches
+    with zero compile stalls."""
+    from ..core import api as _api
+    try:
+        import numpy as np
+        _api.declare(name, shape=tuple(p.shape),
+                     dtype=np.dtype(str(p.dtype).replace("torch.", "")),
+                     compression=compression, replicate_out=True)
+    except Exception:  # noqa: BLE001 — exotic dtype: key-only declare
+        _api.declare(name)
+
+
 class DistributedDataParallel(torch.nn.Module):
     """Drop-in DDP: gradients are engine-push_pulled during backward and
     written back before backward returns (an autograd engine callback),
@@ -41,9 +56,8 @@ class DistributedDataParallel(torch.nn.Module):
         self._lock = threading.Lock()
         self._name_of = {p: n for n, p in module.named_parameters()
                          if p.requires_grad}
-        from ..core import api as _api
-        for n in self._name_of.values():
-            _api.declare(f"ddp.grad.{n}")
+        for p, n in self._name_of.items():
+            _declare_grad(f"ddp.grad.{n}", p, compression)
         for p in self._name_of:
             p.register_post_accumulate_grad_hook(self._hook)
 
@@ -106,9 +120,8 @@ class CrossBarrier:
         self._lock = threading.Lock()
         self._name_of = {p: n for n, p in model.named_parameters()
                          if p.requires_grad}
-        from ..core import api as _api
-        for n in self._name_of.values():
-            _api.declare(f"xb.grad.{n}")
+        for p, n in self._name_of.items():
+            _declare_grad(f"xb.grad.{n}", p, compression)
         for p in self._name_of:
             p.register_post_accumulate_grad_hook(self._grad_hook)
         # forward pre-hooks: the "locks" of the reference design

@@ -733,3 +733,32 @@ def test_bf16_three_d_section_single_device():
     assert out["mesh"] == "dp=1 x pp=1 x tp=1"
     assert len(out["losses"]) == 8 and out["decreased"]
     assert "trivial at (1,1,1)" in out["note"]
+
+
+def test_bench_smoke_floor_and_gate_arithmetic(tmp_path, monkeypatch):
+    # round-6 (ISSUE 5): the bench-smoke lane gates the engine-vs-fused
+    # ratio against the checked-in floor; pin the floor file's shape and
+    # the gate arithmetic without running the (minutes-long) measurement
+    from tools import bench_smoke as bs
+    with open(bs.FLOOR_PATH) as f:
+        floor = json.load(f)
+    assert 0 < floor["engine_vs_fused_ratio"] <= 4
+    assert floor["engine_8MB_gbps"] > 0
+    measured = {"fused_8MB_gbps": 1.0, "engine_8MB_gbps": 0.5,
+                "engine_vs_fused_ratio": 0.5, "ratio_per_rep": [0.5],
+                "autotune": {}}
+    monkeypatch.setattr(bs, "_measure", lambda: dict(measured))
+    monkeypatch.setattr(bs, "setup_cpu8_mesh", lambda: None)
+    monkeypatch.setenv("BENCH_SMOKE_TOLERANCE", "0.30")
+    monkeypatch.setattr(sys, "argv", ["bench_smoke.py"])
+    gate_r = floor["engine_vs_fused_ratio"] * 0.7
+    gate_a = floor["engine_8MB_gbps"] * 0.7
+    assert bs.main() == (0 if (0.5 >= gate_r or 0.5 >= gate_a) else 1)
+    # a fast-regime run: ratio structurally low, absolute honest — passes
+    measured.update(engine_vs_fused_ratio=0.35,
+                    engine_8MB_gbps=floor["engine_8MB_gbps"] * 2)
+    assert bs.main() == 0
+    # a round-5-style machinery collapse tanks BOTH floors — fails
+    measured.update(engine_vs_fused_ratio=0.2,
+                    engine_8MB_gbps=floor["engine_8MB_gbps"] * 0.3)
+    assert bs.main() == 1

@@ -697,6 +697,130 @@ def test_engine_pull_after_reset_key_parks_not_none():
         eng.shutdown()
 
 
+# -- in-process loopback fast path (ISSUE 5 tentpole part 4) ----------------
+
+def test_engine_loopback_fast_path_skips_envelope(monkeypatch):
+    """No chaos armed: the in-process push snapshots with one plain copy
+    — no seal, no CRC, no frame build — while every BYTEPS_INTEGRITY=1
+    semantic downstream still runs."""
+    calls = {"seal": 0}
+    real_seal = integrity.seal_array
+
+    def spy(*a, **kw):
+        calls["seal"] += 1
+        return real_seal(*a, **kw)
+
+    monkeypatch.setattr(integrity, "seal_array", spy)
+    eng = _engine()
+    try:
+        assert integrity.enabled() and integrity.loopback_fast()
+        for r in range(2):
+            eng.push("g", np.full(8, r + 1.0, np.float32), worker_id=r,
+                     num_workers=2)
+        np.testing.assert_array_equal(eng.pull("g", timeout=5), 3.0)
+    finally:
+        eng.shutdown()
+    assert calls["seal"] == 0
+    assert counters.get("integrity.loopback_fast") == 2
+    assert counters.get("integrity.crc_reject") == 0
+
+
+def test_engine_loopback_fast_path_snapshots_contribution():
+    """push() is async: a caller that reuses its gradient buffer after
+    push returns must not corrupt the merge — the fast path snapshots
+    the contribution exactly as the envelope path's seal->open did."""
+    eng = _engine()
+    try:
+        a = np.ones(64, np.float32)
+        eng.push("g", a, worker_id=0, num_workers=2)
+        a[:] = 999.0          # caller reuse, before the round completes
+        eng.push("g", np.ones(64, np.float32), worker_id=1, num_workers=2)
+        np.testing.assert_array_equal(eng.pull("g", timeout=5), 2.0)
+    finally:
+        eng.shutdown()
+
+
+def test_engine_loopback_fast_path_still_screens_nonfinite():
+    """The fast path must not bypass the non-finite screen — the raise
+    policy still names the blamed worker on a skipped envelope."""
+    eng = _engine()
+    try:
+        with pytest.raises(ValueError, match="worker 1"):
+            eng.push("g", _nan_delta(), worker_id=1, num_workers=2)
+        assert counters.get("integrity.loopback_fast") == 1
+    finally:
+        eng.shutdown()
+
+
+def test_engine_loopback_disabled_forces_envelope(monkeypatch):
+    """BYTEPS_INTEGRITY_LOOPBACK=0 pins the full seal->CRC->open path on
+    every hop, chaos or not."""
+    monkeypatch.setenv("BYTEPS_INTEGRITY_LOOPBACK", "0")
+    reset_config()
+    calls = {"seal": 0}
+    real_seal = integrity.seal_array
+
+    def spy(*a, **kw):
+        calls["seal"] += 1
+        return real_seal(*a, **kw)
+
+    monkeypatch.setattr(integrity, "seal_array", spy)
+    eng = _engine()
+    try:
+        eng.push("g", np.ones(8, np.float32), worker_id=0, num_workers=1)
+        np.testing.assert_array_equal(eng.pull("g", timeout=5), 1.0)
+    finally:
+        eng.shutdown()
+        reset_config()
+    assert calls["seal"] == 1
+    assert counters.get("integrity.loopback_fast") == 0
+
+
+def test_engine_loopback_chaos_reroutes_through_envelope():
+    """Arming chaos mid-run flips the SAME engine from the fast path to
+    the verifying envelope: the corruption is caught, retransmitted, and
+    the merge converges exactly (the fast path can never mask a fault
+    the chaos harness injects)."""
+    eng = _engine()
+    try:
+        eng.push("g", np.ones(8, np.float32), worker_id=0, num_workers=2)
+        assert counters.get("integrity.loopback_fast") == 1
+        inj.arm("bitflip:site=server_push:p=0.5", seed=3, rank=0)
+        eng.push("g", np.ones(8, np.float32), worker_id=1, num_workers=2)
+        inj.disarm()
+        np.testing.assert_array_equal(eng.pull("g", timeout=5), 2.0)
+        # the armed push went through the wire, not the fast path
+        assert counters.get("integrity.loopback_fast") == 1
+    finally:
+        inj.disarm()
+        eng.shutdown()
+
+
+def test_seal_array_zero_copy_matches_tobytes():
+    """The memoryview seal is byte-identical to the old tobytes seal,
+    including 0-d, empty, non-contiguous, and read-only inputs."""
+    rng = np.random.RandomState(5)
+    cases = [
+        np.float32(rng.randn()),                    # 0-d
+        np.zeros((0,), np.float32),                 # empty
+        rng.randn(7, 5).astype(np.float16),
+        rng.randn(8, 8).astype(np.float64)[::2, 1::2],  # non-contiguous
+    ]
+    ro = rng.randn(16).astype(np.float32)
+    ro.setflags(write=False)
+    cases.append(ro)
+    for arr in cases:
+        a = np.ascontiguousarray(np.asarray(arr))
+        frame = integrity.seal_array(arr, key="k", seq=7, worker=2)
+        expect = integrity._seal(integrity.KIND_NDARRAY, "k", 2, 7,
+                                 a.dtype.str, np.asarray(arr).shape,
+                                 a.tobytes())
+        assert frame == expect
+        out, meta = integrity.open_array(frame)
+        np.testing.assert_array_equal(out, np.asarray(arr))
+        assert meta.seq == 7 and meta.worker == 2
+
+
 def test_engine_compressed_wire_push_rejects_corrupt_frame():
     """push_compressed with every transmission corrupted: bounded
     retransmit, then a loud failure — the codec never decodes unverified

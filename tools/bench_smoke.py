@@ -1,0 +1,143 @@
+"""Bench-smoke gate: the 8 MB engine micro-bench as a CI lane.
+
+Measures the engine and fused push_pull paths at 8 MB on the virtual
+8-device CPU mesh and FAILS (exit 1) when the engine-vs-fused ratio
+regresses more than ``BENCH_SMOKE_TOLERANCE`` (default 30%) below the
+checked-in floor (``tools/bench_smoke_floor.json``).
+
+Why the RATIO gates and not raw GB/s: absolute throughput on a shared
+CI host measures the host (round-to-round fused figures here span
+0.23–0.47 GB/s on identical code).  The fused path is measured in the
+same run, on the same load, so engine/fused cancels host speed and
+isolates what this lane exists to catch — a regression in the engine
+machinery (ISSUE 5's headline was exactly this ratio collapsing to
+0.30x).  Raw engine GB/s is still printed and recorded for the trend.
+
+Usage:  python tools/bench_smoke.py [--update-floor]
+        --update-floor: re-measure and rewrite the floor file (use after
+        an intentional perf change; review the diff like any artifact).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools._bench_util import quantile_stats_raw, setup_cpu8_mesh  # noqa: E402
+
+FLOOR_PATH = os.path.join(REPO, "tools", "bench_smoke_floor.json")
+MB = 1024 * 1024
+
+
+def _measure(nbytes=8 * MB, reps=9):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from byteps_tpu.comm.collectives import push_pull_array
+    from byteps_tpu.comm.mesh import CommContext, _build_mesh
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.core.engine import PushPullEngine
+
+    devices = jax.devices()
+    n = len(devices)
+    comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1, n_ici=n)
+
+    def med(xs):
+        m, _, _ = quantile_stats_raw(xs)
+        return m
+
+    # fused ceiling: the exact collective the engine dispatches
+    x_dev = jax.device_put(jnp.zeros((n, nbytes // 4), jnp.float32),
+                           comm.stacked_sharding(extra_dims=1))
+    push_pull_array(comm, x_dev, op="sum").block_until_ready()
+
+    # engine path, host-staged (the product's own metric), warmed to the
+    # planner's locked steady state exactly as bench.py measures it
+    cfg = Config(telemetry_on=False, trace_on=False)
+    eng = PushPullEngine(comm, cfg)
+    try:
+        x = np.random.RandomState(0).randn(nbytes // 4).astype(np.float32)
+        eng.declare_tensor("smoke.pp", x.shape, np.float32)
+        for _ in range(24):
+            eng.push_pull_local(x, "smoke.pp")
+            if eng.planner.locked(nbytes):
+                break
+        # INTERLEAVED timed reps: fused and engine adjacent within each
+        # rep, ratio taken PER REP, median across reps.  The two paths
+        # measured a minute apart see different host regimes (this host's
+        # step speed is bimodal, ~2x swing) and their ratio then measures
+        # the host, not the engine — adjacent pairs see the same regime,
+        # so the per-rep ratio isolates what this gate exists to catch.
+        fused_t, eng_t, ratios = [], [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            push_pull_array(comm, x_dev, op="sum").block_until_ready()
+            tf = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            eng.push_pull_local(x, "smoke.pp")
+            te = time.perf_counter() - t0
+            fused_t.append(tf)
+            eng_t.append(te)
+            ratios.append(tf / te)   # engine/fused throughput ratio
+        snap = eng.planner.snapshot()
+    finally:
+        eng.shutdown(wait=False)
+    return {"fused_8MB_gbps": round(nbytes / med(fused_t) / 1e9, 3),
+            "engine_8MB_gbps": round(nbytes / med(eng_t) / 1e9, 3),
+            "engine_vs_fused_ratio": round(med(ratios), 3),
+            "ratio_per_rep": [round(r, 3) for r in sorted(ratios)],
+            "autotune": snap}
+
+
+def main() -> int:
+    setup_cpu8_mesh()
+    tol = float(os.environ.get("BENCH_SMOKE_TOLERANCE", "0.30"))
+    out = _measure()
+    if "--update-floor" in sys.argv:
+        floor = {"engine_vs_fused_ratio": out["engine_vs_fused_ratio"],
+                 "engine_8MB_gbps": out["engine_8MB_gbps"],
+                 "note": "measured floor; the lane fails below "
+                         "ratio * (1 - tolerance)"}
+        with open(FLOOR_PATH, "w") as f:
+            json.dump(floor, f, indent=1)
+            f.write("\n")
+        out["floor_updated"] = floor
+        print(json.dumps(out))
+        return 0
+    with open(FLOOR_PATH) as f:
+        floor = json.load(f)
+    # Either/or gate, because the two floors fail in OPPOSITE host
+    # regimes: when the shared host runs slow, the fused denominator
+    # collapses and the ratio is honest while raw GB/s measures the
+    # host; when it runs fast, fused scales with memory speed but the
+    # engine is capped by fixed per-push host latency, so the ratio
+    # structurally drops (measured ~1.0 slow vs ~0.35 fast on identical
+    # code) while raw GB/s is honest.  An engine-machinery regression
+    # tanks BOTH; a legitimate run in either regime passes one.
+    gate_r = floor["engine_vs_fused_ratio"] * (1.0 - tol)
+    gate_a = floor["engine_8MB_gbps"] * (1.0 - tol)
+    out["floor"] = {k: floor[k] for k in ("engine_vs_fused_ratio",
+                                          "engine_8MB_gbps")}
+    out["gate_ratio"] = round(gate_r, 3)
+    out["gate_gbps"] = round(gate_a, 3)
+    out["ok"] = (out["engine_vs_fused_ratio"] >= gate_r
+                 or out["engine_8MB_gbps"] >= gate_a)
+    print(json.dumps(out))
+    if not out["ok"]:
+        print(f"bench-smoke FAIL: engine_vs_fused_ratio "
+              f"{out['engine_vs_fused_ratio']} < gate {gate_r:.3f} AND "
+              f"engine_8MB_gbps {out['engine_8MB_gbps']} < gate "
+              f"{gate_a:.3f} (floor {out['floor']}, tolerance {tol:.0%})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

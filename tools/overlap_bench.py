@@ -102,15 +102,30 @@ def one_mode_pass(mode: str, steps=6, warmup=2, width=512, depth=8,
 def _measure(width=512, rounds=4):
     """Interleave modes at round granularity: slow load drift on a shared
     host then hits every mode equally instead of whichever mode ran last
-    (the round-3 artifact's failure mode)."""
+    (the round-3 artifact's failure mode).
+
+    The headline ``overlap_fraction`` is the MEDIAN OF PER-ROUND PAIRED
+    fractions — each round's xb measured against its own temporally
+    adjacent sync/nocomm passes — not the fraction of pooled medians.
+    Pooling completes only half the interleaving logic: on a host whose
+    step time is bimodal (this one swings ~130↔180 ms), the pooled
+    per-mode medians land on either cluster edge essentially at random
+    and the derived fraction flips sign run to run, while adjacent
+    passes inside one round see the same regime and their difference is
+    stable.  The pooled figure is kept as ``overlap_fraction_pooled``
+    for continuity with rounds ≤ 5."""
     modes = ("nocomm", "sync", "xb")
     all_times = {m: [] for m in modes}
     all_losses = {m: None for m in modes}
+    round_meds = []
     for _ in range(rounds):
+        meds = {}
         for m in modes:
             ts, ls = one_mode_pass(m, width=width)
             all_times[m] += ts
             all_losses[m] = ls
+            meds[m] = sorted(ts)[len(ts) // 2]
+        round_meds.append(meds)
 
     res = {}
     for m in modes:
@@ -120,12 +135,20 @@ def _measure(width=512, rounds=4):
                   "loss_last": round(all_losses[m][-1], 5)}
     t_no, t_sync, t_xb = (res[m]["step_ms"] for m in modes)
     comm_share = max(t_sync - t_no, 0.0)
+    paired = [(r["sync"] - r["xb"]) / (r["sync"] - r["nocomm"])
+              for r in round_meds if r["sync"] - r["nocomm"] > 1e-6]
+    paired.sort()
+    import statistics
+    frac = round(statistics.median(paired), 3) if paired else None
     return {
         "modes": res,
         "gain_sync_over_xb": round(t_sync / max(t_xb, 1e-9), 3),
         "comm_share_ms": round(comm_share, 1),
-        "overlap_fraction": (round((t_sync - t_xb) / comm_share, 3)
-                             if comm_share > 1e-6 else None),
+        "overlap_fraction": frac,
+        "overlap_fraction_rounds": [round(f, 3) for f in paired],
+        "overlap_fraction_pooled": (
+            round((t_sync - t_xb) / comm_share, 3)
+            if comm_share > 1e-6 else None),
         # structural ceiling: overlap can hide at most min(compute, comm)
         # of the comm share — when comm >> compute (CPU-mesh transport is
         # slow), even perfect overlap moves the needle by only this much

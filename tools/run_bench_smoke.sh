@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# bench-smoke lane: the 8 MB engine micro-bench on the virtual 8-device
+# CPU mesh, gated against the checked-in floor
+# (tools/bench_smoke_floor.json) — fails on a >30% regression of the
+# engine-vs-fused ratio (see tools/bench_smoke.py for why the ratio and
+# not raw GB/s is what gates on a shared host).
+#
+# Usage:  tools/run_bench_smoke.sh            # measure + gate
+#         tools/run_bench_smoke.sh --update-floor   # rewrite the floor
+# Env:    BENCH_SMOKE_TOLERANCE  allowed fractional regression (0.30)
+#         BENCH_SMOKE_TIMEOUT    whole-lane seconds (default 420)
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+LANE="${BENCH_SMOKE_TIMEOUT:-420}"
+
+exec timeout -k 15 "$LANE" \
+    env JAX_PLATFORMS=cpu python tools/bench_smoke.py "$@"
