@@ -38,4 +38,6 @@ from byteps_tpu.core.api import (  # noqa: F401
     declare,
     get_pushpull_speed,
     membership_epoch,
+    metrics_snapshot,
+    cluster_metrics,
 )

@@ -237,6 +237,35 @@ class Config:
     trace_dir: str = "."             # BYTEPS_TRACE_DIR
     trace_jax: bool = False          # BYTEPS_TRACE_JAX (device profiler)
     telemetry_on: bool = True        # BYTEPS_TELEMETRY_ON
+    obs_port: Optional[int] = None   # BYTEPS_OBS_PORT: per-process HTTP
+    #                                  observability endpoint (/metrics,
+    #                                  /healthz, /debug/state); unset =
+    #                                  off, 0 = OS-assigned ephemeral
+    #                                  port.  Survives suspend/resume —
+    #                                  one server per process lifetime.
+    obs_host: str = "127.0.0.1"      # BYTEPS_OBS_HOST: bind address for
+    #                                  the obs endpoint (0.0.0.0 to
+    #                                  expose cluster-wide)
+    flight_recorder_on: bool = True  # BYTEPS_FLIGHT_RECORDER: bounded
+    #                                  in-memory ring of recent events,
+    #                                  dumped to JSON on crash/SIGTERM/
+    #                                  detector trip/quarantine/chaos
+    #                                  kill (common/flight_recorder.py)
+    flight_capacity: int = 4096      # BYTEPS_FLIGHT_CAPACITY: ring size
+    flight_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("BYTEPS_FLIGHT_DIR", "."))
+    #                                  BYTEPS_FLIGHT_DIR: dump directory.
+    #                                  The env var backs the DEFAULT even
+    #                                  for explicitly constructed
+    #                                  Config(...) objects: a crash dump
+    #                                  must land where the operator (or
+    #                                  the test harness) pointed, not in
+    #                                  whatever cwd a Config() happened
+    #                                  to be built in
+    flight_dump_on_exit: bool = False
+    #                                  BYTEPS_FLIGHT_DUMP_ON_EXIT: also
+    #                                  dump on engine shutdown / normal
+    #                                  interpreter exit (once)
 
     # Pin markers for the auto-tuned planner (resolved in __post_init__
     # when left None): a knob explicitly set — env var present, or a
@@ -290,6 +319,10 @@ class Config:
             raise ValueError("integrity_max_retransmits must be >= 0")
         if self.bus_max_frame <= 0:
             raise ValueError("bus_max_frame must be positive")
+        if self.obs_port is not None and not 0 <= self.obs_port < 65536:
+            raise ValueError("obs_port must be in 0..65535 (0 = ephemeral)")
+        if self.flight_capacity <= 0:
+            raise ValueError("flight_capacity must be positive")
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -364,6 +397,15 @@ class Config:
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "."),
             trace_jax=_env_bool("BYTEPS_TRACE_JAX", False),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
+            obs_port=(_env_int("BYTEPS_OBS_PORT", 0)
+                      if os.environ.get("BYTEPS_OBS_PORT") not in (None, "")
+                      else None),
+            obs_host=_env_str("BYTEPS_OBS_HOST", "127.0.0.1"),
+            flight_recorder_on=_env_bool("BYTEPS_FLIGHT_RECORDER", True),
+            flight_capacity=_env_int("BYTEPS_FLIGHT_CAPACITY", 4096),
+            flight_dir=_env_str("BYTEPS_FLIGHT_DIR", "."),
+            flight_dump_on_exit=_env_bool("BYTEPS_FLIGHT_DUMP_ON_EXIT",
+                                          False),
         )
 
 

@@ -359,6 +359,10 @@ def wire_transmit(frame: bytes, *, key: str, worker: int, seq: int,
             payload, _meta = opener(wire)
         except IntegrityError as e:
             counters.inc("integrity.crc_reject")
+            from . import flight_recorder as _flight
+            _flight.record("integrity.crc_reject", key=key, seq=seq,
+                           worker=worker, site=site,
+                           attempt=attempts["n"])
             if on_reject is not None:
                 on_reject()
             get_logger().warning(
@@ -393,6 +397,9 @@ def screen_nonfinite(arr: np.ndarray, *, what: str, key: str,
         return arr
     n_bad = int(arr.size - np.count_nonzero(finite))
     policy = nonfinite_policy()
+    from . import flight_recorder as _flight
+    _flight.record("integrity.nonfinite", what=what, key=key,
+                   worker=worker, n_bad=n_bad, policy=policy)
     if policy == "zero":
         counters.inc("integrity.nonfinite_zeroed")
         get_logger().warning(

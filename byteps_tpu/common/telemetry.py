@@ -1,160 +1,266 @@
-"""PushPull speed telemetry + process-wide event counters.
+"""PushPull speed telemetry + process-wide event metrics.
 
 Reference: a rolling MB/s gauge updated every 10s, surfaced as
 ``bps.get_pushpull_speed()`` (reference global.cc:697-752,
 common/__init__.py:130-139); off switch BYTEPS_TELEMETRY_ON.
 
-:class:`Counters` is the observability sink for the fault-tolerance
-subsystem: injected faults (``fault.kill`` / ``fault.delay`` /
-``fault.bitflip`` / ``fault.straggler`` / ``fault.drop``), retry
-attempts (``retry.attempt`` / ``retry.gave_up``), recovery stages
-(``recovery.attempt`` / ``recovery.completed`` / ``recovery.failed``),
-elastic-membership transitions (``membership.shrink_started`` /
-``shrink_agreed`` / ``shrink`` / ``grow`` / ``rejoin_requested`` /
-``rejoin_admitted`` / ``rejoined`` / ``shrink_failed`` plus the epoch
-guards ``membership.stale_chunks_dropped`` /
-``membership.stale_pushes_dropped``), and the data-integrity layer
-(``integrity.crc_reject`` — frames NACKed by a CRC32C/shape check,
-``integrity.retransmit`` — envelope retransmissions,
-``integrity.dup_dropped`` — idempotence dedup hits, and the non-finite
-quarantine ``integrity.nonfinite_rejected`` / ``nonfinite_skipped`` /
-``nonfinite_zeroed`` / ``quarantine_dropped`` — late same-round pushes
-discarded after their round was quarantined) all increment the module
-singleton
-:data:`counters`, so a chaos run is inspectable after the fact.
+The event sinks — :class:`Counters` / :class:`Gauges` /
+:class:`Histograms` and their process singletons ``counters`` /
+``gauges`` / ``histograms`` — now live in ``common/metrics.py`` as
+views over one :class:`~byteps_tpu.common.metrics.MetricsRegistry`
+(labels, one consistent snapshot, Prometheus exposition for the
+``common/obs_server.py`` endpoint); this module re-exports them so
+every established call site and metric name keeps working unchanged.
+The established names: injected faults (``fault.kill`` /
+``fault.delay`` / ``fault.bitflip`` / ``fault.straggler`` /
+``fault.drop``), retry attempts (``retry.attempt`` /
+``retry.gave_up``), recovery stages (``recovery.attempt`` /
+``recovery.completed`` / ``recovery.failed``), elastic-membership
+transitions (``membership.*`` plus the epoch guards
+``membership.stale_chunks_dropped`` /
+``membership.stale_pushes_dropped``), the data-integrity layer
+(``integrity.crc_reject`` / ``retransmit`` / ``dup_dropped`` /
+``nonfinite_*`` / ``quarantine_dropped``), and the engine dispatch
+path (``engine.*`` counters/gauges/histograms) — the full table with
+types and meanings is ``docs/observability.md``.
+
+This module keeps the wall-clock-shaped pieces: :class:`SpeedMonitor`
+(the rolling-window rate) and :class:`StepStatsTracker` (per-step
+bytes/stall/retransmit/overlap accounting the engine feeds).
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
 import threading
 import time
-from typing import Dict, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-
-class Counters:
-    """Thread-safe named monotonic counters (process-wide singleton below)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._c: Dict[str, int] = {}
-
-    def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._c[name] = self._c.get(name, 0) + n
-
-    def get(self, name: str) -> int:
-        with self._lock:
-            return self._c.get(name, 0)
-
-    def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._c)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._c.clear()
-
-
-counters = Counters()
-
-
-class Gauges:
-    """Thread-safe last-value gauges (point-in-time readings, unlike the
-    monotonic :class:`Counters`): scheduler queue depth, bytes in flight,
-    the planner's current chunk choice.  Process-wide singleton below."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._g: Dict[str, float] = {}
-
-    def set(self, name: str, value: float) -> None:
-        with self._lock:
-            self._g[name] = value
-
-    def get(self, name: str, default: float = 0.0) -> float:
-        with self._lock:
-            return self._g.get(name, default)
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return dict(self._g)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._g.clear()
-
-
-gauges = Gauges()
-
-
-class Histograms:
-    """Power-of-two-bucketed histograms for dispatch-path distributions
-    (dispatch-unit width, per-unit sync latency).  A value v lands in
-    bucket ``2**ceil(log2(v))`` (v <= 0 lands in bucket 0), so the
-    bucket set is tiny and needs no pre-declaration.  Snapshot shape:
-    ``{name: {bucket_upper_bound: count}}``."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._h: Dict[str, Dict[int, int]] = {}
-
-    def observe(self, name: str, value: float, n: int = 1) -> None:
-        if value <= 0:
-            b = 0
-        else:
-            b = 1
-            while b < value:
-                b <<= 1
-        with self._lock:
-            buckets = self._h.setdefault(name, {})
-            buckets[b] = buckets.get(b, 0) + n
-
-    def snapshot(self) -> Dict[str, Dict[int, int]]:
-        with self._lock:
-            return {k: dict(v) for k, v in self._h.items()}
-
-    def count(self, name: str) -> int:
-        with self._lock:
-            return sum(self._h.get(name, {}).values())
-
-    def reset(self) -> None:
-        with self._lock:
-            self._h.clear()
-
-
-histograms = Histograms()
+from .metrics import (Counters, Gauges, Histograms,  # noqa: F401
+                      counters, gauges, histograms, registry)
 
 
 class SpeedMonitor:
-    def __init__(self, window_sec: float = 10.0, history: int = 60):
+    """Rolling-window byte-rate monitor (MB/s over ``window_sec``).
+
+    ``clock`` is injectable for deterministic tests.  :meth:`speed`
+    rolls a stale window on read (a paused ``record()`` stream cannot
+    freeze the figure) and never answers with a near-zero partial rate
+    from a *just-rolled* window: a partial younger than 10% of the
+    period defers to the last closed window's figure — the previous
+    implementation could report ~0 MB/s the instant after a window
+    closed on full-rate traffic."""
+
+    # partial windows younger than this fraction of the period are too
+    # noisy to report when a closed window exists
+    _MIN_PARTIAL_FRACTION = 0.1
+
+    def __init__(self, window_sec: float = 10.0, history: int = 60,
+                 clock: Callable[[], float] = time.monotonic):
         self._window = window_sec
+        self._clock = clock
         self._lock = threading.Lock()
         self._bytes = 0
-        self._t0 = time.monotonic()
-        self._records = collections.deque(maxlen=history)
+        self._t0 = clock()
+        self._records: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=history)
+
+    def _roll_locked(self, now: float) -> None:
+        dt = now - self._t0
+        # wall-clock timestamp for cross-host correlation (the
+        # reference reports real timestamps for the same reason)
+        self._records.append((time.time(), self._bytes / dt / 2**20))
+        self._bytes = 0
+        self._t0 = now
 
     def record(self, nbytes: int) -> None:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             self._bytes += nbytes
-            dt = now - self._t0
-            if dt >= self._window:
-                # wall-clock timestamp for cross-host correlation (the
-                # reference reports real timestamps for the same reason)
-                self._records.append((time.time(), self._bytes / dt / 2**20))
-                self._bytes = 0
-                self._t0 = now
+            if now - self._t0 >= self._window:
+                self._roll_locked(now)
 
     def speed(self) -> Tuple[float, float]:
-        """(wall-clock timestamp, MB/s) of the latest closed window, else
-        the live partial window."""
+        """(wall-clock timestamp, MB/s) of the freshest meaningful
+        window: the live partial once it has matured past 10% of the
+        period, otherwise the latest closed window (rolled on read when
+        the partial has outlived the period — an idle monitor honestly
+        reports 0, not its last busy figure)."""
         with self._lock:
+            now = self._clock()
+            dt = now - self._t0
+            if dt >= self._window:
+                self._roll_locked(now)
+                return self._records[-1]
+            if self._records and (
+                    self._bytes == 0
+                    or dt < self._window * self._MIN_PARTIAL_FRACTION):
+                # just-rolled (or byte-less) partial: the closed window
+                # is the honest figure
+                return self._records[-1]
+            if self._bytes and dt > 0:
+                return (time.time(), self._bytes / dt / 2**20)
             if self._records:
                 return self._records[-1]
-            dt = time.monotonic() - self._t0
-            return (time.time(), self._bytes / dt / 2**20 if dt > 0 else 0.0)
+            return (time.time(), 0.0)
 
     def total_windows(self) -> int:
         with self._lock:
             return len(self._records)
+
+
+# -- per-step stats ---------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class StepStats:
+    """One completed training step as the engine saw it.
+
+    ``overlap_fraction`` is the share of the step's wall time the
+    syncer did NOT spend blocked on device completion — communication
+    that finished under compute instead of stalling it (1.0 = fully
+    hidden; the per-model bench figure in ``tools/overlap_bench.py`` is
+    the end-to-end counterpart)."""
+
+    step: int
+    bytes_pushed: int
+    pushes: int
+    sync_stall_ms: float
+    retransmits: int
+    wall_ms: float
+    overlap_fraction: float
+
+    def as_dict(self) -> Dict[str, float]:
+        return dataclasses.asdict(self)
+
+
+class StepStatsTracker:
+    """Accumulates per-step engine stats (ISSUE 6 tentpole part 4).
+
+    A "step" is defined exactly as the tracer defines it: per-tensor
+    push counts, the max of which is the global step — when any
+    tensor's count advances past the current step, the previous step is
+    finalized.  The dispatcher/enqueue side feeds :meth:`on_push`
+    (bytes), the syncer feeds :meth:`add_stall` (ms spent blocked in
+    ``block_until_ready``); retransmits are deltas of the established
+    ``integrity.retransmit`` counter.  Finalized steps land in three
+    places at once: the gauge set (``step.*`` — the ``/metrics``
+    surface), the flight recorder (``step_stats`` events), and a
+    bounded in-process history for bench summaries."""
+
+    def __init__(self, history: int = 64, recorder=None):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._step = 0
+        self._t0 = time.perf_counter()
+        self._bytes = 0
+        self._pushes = 0
+        self._stall_ms = 0.0
+        self._retx0 = counters.get("integrity.retransmit")
+        self._history: Deque[StepStats] = collections.deque(maxlen=history)
+        if recorder is None:
+            from . import flight_recorder as _flight
+            recorder = _flight.recorder
+        self._recorder = recorder
+
+    # -- feeding -----------------------------------------------------------
+
+    def on_push(self, name: str, nbytes: int) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + 1
+            step = self._counts[name]
+            if step > self._step:
+                if self._step > 0 and self._pushes:
+                    # published under the lock: two concurrent pushers
+                    # finalizing steps N and N+1 must land their gauge
+                    # writes and flight events in step order (the gauge
+                    # and recorder locks never take this one, so there
+                    # is no ordering cycle to invert)
+                    self._publish(self._finalize_locked())
+                self._step = step
+                self._t0 = time.perf_counter()
+            self._bytes += int(nbytes)
+            self._pushes += 1
+
+    def add_stall(self, ms: float) -> None:
+        with self._lock:
+            self._stall_ms += ms
+
+    # -- finalization ------------------------------------------------------
+
+    def _finalize_locked(self) -> StepStats:
+        wall_ms = max((time.perf_counter() - self._t0) * 1e3, 1e-6)
+        retx = counters.get("integrity.retransmit")
+        stats = StepStats(
+            step=self._step,
+            bytes_pushed=self._bytes,
+            pushes=self._pushes,
+            sync_stall_ms=round(self._stall_ms, 3),
+            retransmits=retx - self._retx0,
+            wall_ms=round(wall_ms, 3),
+            overlap_fraction=round(
+                1.0 - min(1.0, self._stall_ms / wall_ms), 4),
+        )
+        self._bytes = 0
+        self._pushes = 0
+        self._stall_ms = 0.0
+        self._retx0 = retx
+        self._history.append(stats)
+        return stats
+
+    def _publish(self, stats: StepStats) -> None:
+        gauges.set("step.bytes_pushed", stats.bytes_pushed)
+        gauges.set("step.pushes", stats.pushes)
+        gauges.set("step.sync_stall_ms", stats.sync_stall_ms)
+        gauges.set("step.retransmits", stats.retransmits)
+        gauges.set("step.wall_ms", stats.wall_ms)
+        gauges.set("step.overlap_fraction", stats.overlap_fraction)
+        counters.inc("step.completed")
+        self._recorder.record("step_stats", **stats.as_dict())
+
+    def flush(self) -> Optional[StepStats]:
+        """Finalize the in-progress step (engine shutdown: the tail step
+        must not be silently lost)."""
+        with self._lock:
+            if self._step > 0 and self._pushes:
+                done = self._finalize_locked()
+                self._publish(done)
+                return done
+        return None
+
+    # -- reading -----------------------------------------------------------
+
+    @property
+    def current_step(self) -> int:
+        with self._lock:
+            return self._step
+
+    def last(self) -> Optional[StepStats]:
+        with self._lock:
+            return self._history[-1] if self._history else None
+
+    def history(self) -> List[StepStats]:
+        with self._lock:
+            return list(self._history)
+
+    def summary(self) -> Dict[str, float]:
+        """Median-of-history digest for bench artifacts."""
+        hist = self.history()
+        if not hist:
+            return {"steps": 0}
+
+        def med(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        return {
+            "steps": hist[-1].step,
+            "bytes_pushed_med": med([s.bytes_pushed for s in hist]),
+            "sync_stall_ms_med": round(
+                med([s.sync_stall_ms for s in hist]), 3),
+            "wall_ms_med": round(med([s.wall_ms for s in hist]), 3),
+            "overlap_fraction_med": round(
+                med([s.overlap_fraction for s in hist]), 4),
+            "retransmits_total": sum(s.retransmits for s in hist),
+        }

@@ -87,6 +87,27 @@ def init(config: Optional[Config] = None,
                 engine.shutdown(wait=False)
                 mesh_mod.shutdown_comm()
                 raise
+        # Observability plane: flight-recorder knobs + crash/SIGTERM/
+        # atexit dump hooks, and (when BYTEPS_OBS_PORT is set) the
+        # per-process HTTP endpoint.  The endpoint outlives the engine —
+        # an elastic suspend/resume keeps it (ensure_started is a
+        # process-lifetime idempotent singleton), so /healthz can report
+        # the transition instead of going dark.
+        from ..common import flight_recorder as flight_recorder_mod
+        from ..common import obs_server as obs_server_mod
+        flight_recorder_mod.configure_from_config(cfg)
+        flight_recorder_mod.install_hooks()
+        try:
+            obs_server_mod.ensure_started(cfg)
+        except Exception:
+            # the operator explicitly asked for the endpoint: a bind
+            # failure fails init() loudly, never a silently-dark plane
+            if _heartbeat is not None:
+                _heartbeat.stop()
+                _heartbeat = None
+            engine.shutdown(wait=False)
+            mesh_mod.shutdown_comm()
+            raise
         _engine = engine
         for name in _declared_order:
             _engine.registry.declare(name)
@@ -242,3 +263,69 @@ def synchronize(handle: Handle, timeout: Optional[float] = None) -> Any:
 def get_pushpull_speed() -> tuple:
     """(timestamp, MB/s) telemetry (reference byteps_get_pushpull_speed)."""
     return _require().speed.speed()
+
+
+def metrics_snapshot(light: bool = False) -> Dict[str, Any]:
+    """This process's observability snapshot: counters + gauges (one
+    consistent registry view), membership epoch, push_pull speed, and
+    the last completed :class:`~byteps_tpu.common.telemetry.StepStats`.
+    ``light=True`` drops the histogram buckets — the compact form the
+    membership bus piggybacks on every ``step_sync`` so the coordinator
+    always holds a fresh per-rank view."""
+    import os
+    import time
+
+    from ..common import metrics as _metrics
+    from ..fault import membership as _membership
+    reg = _metrics.registry.snapshot()
+    snap: Dict[str, Any] = {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "rank": get_config().host_id,
+        "epoch": _membership.current_epoch(),
+        "counters": reg["counters"],
+        "gauges": reg["gauges"],
+    }
+    if not light:
+        snap["histograms"] = reg["histograms"]
+    eng = _engine
+    if eng is not None:
+        snap["speed_mbps"] = round(eng.speed.speed()[1], 3)
+        snap["sched_pending"] = eng.scheduler.pending
+        snap["bytes_in_flight"] = eng.scheduler.bytes_in_flight
+        last = eng.step_stats.last()
+        snap["step"] = last.as_dict() if last is not None else None
+        if not light:
+            snap["planner"] = eng.planner.snapshot()
+    return snap
+
+
+def cluster_metrics(bus: Optional[str] = None,
+                    timeout: float = 10.0) -> Dict[str, Any]:
+    """Every live rank's metrics snapshot in ONE round-trip to the
+    membership bus (the ``metrics`` verb, fault/membership.py): returns
+    ``{"epoch", "world", "ranks": {rank: {"age_s", "metrics"}}}`` where
+    each rank's entry is the snapshot it last attached to a
+    ``step_sync`` (or pushed with ``metrics_put``), stamped with its
+    age.  ``bus`` is ``host:port`` of the membership bus; default is the
+    same resolution :class:`~byteps_tpu.fault.membership.ElasticMembership`
+    uses (DMLC root + BYTEPS_MEMBERSHIP_PORT).
+
+    A run with no bus at all (single process, non-elastic) falls back
+    to a local-only view — rank → this process's own snapshot — so
+    ``tools/bps_top.py`` works against anything."""
+    from ..fault import membership as _membership
+    addr = _membership.resolve_bus_addr(bus)
+    try:
+        reply = _membership.bus_request(
+            addr, {"op": "metrics"}, timeout=timeout)
+    except ConnectionError:
+        snap = metrics_snapshot()
+        return {"epoch": _membership.current_epoch(),
+                "world": [snap["rank"]],
+                "ranks": {snap["rank"]: {"age_s": 0.0, "metrics": snap}},
+                "local_only": True}
+    if not reply.get("ok"):
+        raise RuntimeError(f"cluster_metrics failed: {reply!r}")
+    return {"epoch": reply["epoch"], "world": reply["world"],
+            "ranks": {int(r): v for r, v in reply["ranks"].items()}}

@@ -44,8 +44,9 @@ from ..common.handles import Handle, HandleManager
 from ..common.logging import get_logger
 from ..common.registry import TensorRegistry
 from ..common.scheduler import ChunkPlanner, ChunkScheduler
-from ..common.telemetry import (SpeedMonitor, counters, gauges,
-                                histograms)
+from ..common import flight_recorder as _flight
+from ..common.telemetry import (SpeedMonitor, StepStatsTracker, counters,
+                                gauges, histograms)
 from ..common.tracing import Tracer
 from ..common.types import ChunkTask, Status, StatusCode, TensorContext
 from ..fault import injector as _fault
@@ -251,6 +252,10 @@ class PushPullEngine:
         self.scheduler = self._make_scheduler(cfg)
         self.speed = SpeedMonitor()
         self.tracer = Tracer()
+        # Per-step stats (bytes pushed, sync stall, retransmits, overlap
+        # fraction) — surfaced through /metrics (step.* gauges), the
+        # flight recorder, and the bench tools (ISSUE 6).
+        self.step_stats = StepStatsTracker()
         self._sync_q: "queue.Queue" = queue.Queue()
         # group_size < 0 = drain mode (VERDICT r4 task 3): every dispatch
         # iteration empties the whole eligible credit window and executes
@@ -278,6 +283,8 @@ class PushPullEngine:
             target=self._sync_loop, name="bps-sync", daemon=True)
         self._dispatcher.start()
         self._syncer.start()
+        _flight.record("engine.init", ranks=comm.num_ranks,
+                       epoch=_membership.current_epoch())
 
     @staticmethod
     def _make_scheduler(cfg: Config):
@@ -435,6 +442,10 @@ class PushPullEngine:
                 t_enq = self.tracer.now()
             else:  # keep the hot enqueue path lock-free when tracing is off
                 step, t_enq = 0, 0.0
+            if self.cfg.telemetry_on:
+                # per-step accounting: same per-tensor step definition as
+                # the tracer, independent of the trace window
+                self.step_stats.on_push(name, est_nbytes)
             local_mode = local
             if local:
                 if use_buffer:
@@ -832,6 +843,9 @@ class PushPullEngine:
                 for t in batch:
                     if t.pending is not None and t.pending.mepoch != ep:
                         counters.inc("membership.stale_chunks_dropped")
+                        _flight.record("engine.stale_chunk", tensor=t.name,
+                                       key=t.key, enq_epoch=t.pending.mepoch,
+                                       epoch=ep)
                         self._sync_q.put(([t], None, None,
                                           _stale_epoch_error(t, ep), 0.0))
                     else:
@@ -878,6 +892,8 @@ class PushPullEngine:
                               time.perf_counter()))
         except Exception as e:  # noqa: BLE001
             get_logger().error("dispatch failed for %s: %s", t0.name, e)
+            _flight.record("engine.dispatch_failed", tensor=t0.name,
+                           error=str(e))
             self._sync_q.put((run, None, None, e, 0.0))
 
     def _dispatch_parts_group(self, group: List[ChunkTask]):
@@ -899,6 +915,8 @@ class PushPullEngine:
                               time.perf_counter()))
         except Exception as e:  # noqa: BLE001
             get_logger().error("dispatch failed for %s: %s", t0.name, e)
+            _flight.record("engine.dispatch_failed", tensor=t0.name,
+                           error=str(e))
             self._sync_q.put((group, None, None, e, 0.0))
 
     def _dispatch_single(self, task: ChunkTask):
@@ -933,6 +951,8 @@ class PushPullEngine:
                               time.perf_counter()))
         except Exception as e:  # noqa: BLE001
             get_logger().error("dispatch failed for %s: %s", task.name, e)
+            _flight.record("engine.dispatch_failed", tensor=task.name,
+                           error=str(e))
             self._sync_q.put(([task], None, None, e, 0.0))
 
     def _sync_loop(self):
@@ -968,6 +988,7 @@ class PushPullEngine:
                     _fault.fire("sync")
                 tasks, out, rollback, err, t_disp = item
                 if err is None:
+                    t_blk = time.perf_counter()
                     try:
                         # For buffer runs ``out`` is the completion
                         # token, not the buffer: the buffer itself may
@@ -980,6 +1001,12 @@ class PushPullEngine:
                             slot, wst, sst = rollback
                             slot.wstates = wst
                             slot.sstate = sst
+                    if self.cfg.telemetry_on:
+                        # time this thread spent BLOCKED on device
+                        # completion — the step's sync-stall share (the
+                        # un-overlapped remainder of communication)
+                        self.step_stats.add_stall(
+                            (time.perf_counter() - t_blk) * 1e3)
                 # Unit credits back BEFORE callbacks, one lock op for the
                 # whole run: the dispatcher can launch the next window
                 # while this thread runs assembly.
@@ -1060,7 +1087,17 @@ class PushPullEngine:
         self._sync_q.put(_SHUTDOWN)
         self._syncer.join(timeout=5)
         self.handles.clear()
+        # Tail preservation on a NORMAL exit (ISSUE 6 satellite): the
+        # in-progress step's stats land, the comm trace flushes, and the
+        # flight recorder dumps if BYTEPS_FLIGHT_DUMP_ON_EXIT asked
+        # (same hooks also run from atexit for runs that never call
+        # shutdown — both are idempotent).
+        self.step_stats.flush()
         self.tracer.flush()
+        _flight.record("engine.shutdown",
+                       dispatches=self.stats["dispatches"],
+                       chunks=self.stats["chunks"])
+        _flight.maybe_exit_dump()
 
     def push_pull(self, stacked, name: str, **kw):
         """Synchronous push_pull; returns the reduced array."""

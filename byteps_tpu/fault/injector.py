@@ -231,6 +231,14 @@ class FaultInjector:
                 get_logger().error(
                     "fault injector: kill at step %d (rank %d) — exiting %d",
                     step, self.rank, r.code)
+                # black-box parity with a real crash: the flight
+                # recorder's tail (the events leading into this kill)
+                # hits disk BEFORE the hard exit — os._exit runs no
+                # atexit hooks, so this is the only chance
+                from ..common import flight_recorder as _flight
+                _flight.record("fault.kill", step=step, rank=self.rank,
+                               code=r.code)
+                _flight.dump("chaos_kill")
                 _exit(r.code)
 
     def fire(self, site: str) -> None:
@@ -272,6 +280,8 @@ class FaultInjector:
             raw = a.view(np.uint8).reshape(-1)
             byte = r.rng.randrange(raw.size)
             raw[byte] ^= np.uint8(1 << r.rng.randrange(8))
+            from ..common import flight_recorder as _flight
+            _flight.record("fault.bitflip", site=site, byte=byte)
             get_logger().warning(
                 "fault injector: bit flipped at %s (byte %d)", site, byte)
             return a

@@ -66,6 +66,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
 
+from ..common import flight_recorder as _flight
 from ..common.logging import get_logger
 from ..common.retry import RetryPolicy
 from ..common.telemetry import counters
@@ -73,6 +74,7 @@ from ..common.telemetry import counters
 __all__ = [
     "MembershipView", "ElasticMembership", "WorldChanged", "Evicted",
     "MembershipTimeout", "current_epoch", "advance_epoch", "set_epoch",
+    "resolve_bus_addr", "bus_request",
 ]
 
 
@@ -246,6 +248,47 @@ def _recv_obj(sock: socket.socket) -> Any:
         raise _BusFrameError(f"bus frame failed to unpickle: {e}") from None
 
 
+def resolve_bus_addr(bus: Optional[str] = None) -> Tuple[str, int]:
+    """``host:port`` of the membership bus — explicit arg, or the same
+    DMLC-root + BYTEPS_MEMBERSHIP_PORT resolution
+    :class:`ElasticMembership` uses."""
+    from ..common.config import get_config
+    if bus is not None:
+        host, port_s = bus.rsplit(":", 1)
+        return host, int(port_s)
+    cfg = get_config()
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = cfg.membership_port or (
+        int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 2)
+    return host, port
+
+
+def bus_request(addr: Tuple[str, int], msg: dict,
+                timeout: float = 10.0) -> dict:
+    """One single-attempt request/reply round trip to the bus (no
+    backoff — read-only observability callers like
+    ``core/api.cluster_metrics`` / ``tools/bps_top.py`` decide their own
+    retry cadence).  Raises :class:`_BusUnreachable` (a
+    ``ConnectionError``) when nothing answers."""
+    try:
+        s = socket.create_connection(addr, timeout=min(timeout, 3.0))
+    except OSError as e:
+        raise _BusUnreachable(f"bus {addr}: {e}") from None
+    try:
+        s.settimeout(timeout)
+        _send_obj(s, msg)
+        return _recv_obj(s)
+    except socket.timeout:
+        raise MembershipTimeout(
+            f"bus {msg.get('op')} timed out after {timeout:.1f}s") from None
+    except _BusUnreachable:
+        raise
+    except OSError as e:
+        raise _BusUnreachable(f"bus {addr}: {e}") from None
+    finally:
+        s.close()
+
+
 class _BusServer:
     """The coordinator-side membership endpoint.
 
@@ -274,6 +317,11 @@ class _BusServer:
         self._hellos: Dict[int, Dict[int, frozenset]] = {}
         # rank -> None (parked) | admission info dict
         self._join_wait: Dict[int, Optional[dict]] = {}
+        # rank -> (wall time, metrics snapshot): the cross-rank
+        # observability cache — members attach a compact snapshot to
+        # every sync (and may metrics_put explicitly); the metrics verb
+        # answers from here in one round-trip (core/api.cluster_metrics)
+        self._metrics: Dict[int, Tuple[float, Any]] = {}
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -322,6 +370,10 @@ class _BusServer:
                 reply = self._do_hello(msg)
             elif op == "rejoin":
                 reply = self._do_rejoin(msg)
+            elif op == "metrics_put":
+                reply = self._do_metrics_put(msg)
+            elif op == "metrics":
+                reply = self._do_metrics()
             else:
                 reply = {"ok": False, "error": f"unknown op {op!r}"}
             try:
@@ -356,6 +408,11 @@ class _BusServer:
         rank, epoch, step = msg["rank"], msg["epoch"], msg["step"]
         deadline = time.monotonic() + self._sync_timeout
         with self._cv:
+            if msg.get("metrics") is not None:
+                # observability piggyback: cached even for a stale-epoch
+                # sync — a rank mid-transition is exactly one an operator
+                # wants to see
+                self._metrics[rank] = (time.time(), msg["metrics"])
             if epoch != self.epoch:
                 return self._stale_reply()
             key = (epoch, step)
@@ -502,6 +559,29 @@ class _BusServer:
                 self._cv.wait(min(remaining, 0.25))
         return {"ok": False, "timeout": True}
 
+    # -- verbs: metrics (cross-rank observability) -------------------------
+
+    def _do_metrics_put(self, msg: dict) -> dict:
+        """Store one rank's snapshot (the explicit form of the sync
+        piggyback — background publishers and one-shot tools)."""
+        with self._cv:
+            self._metrics[msg["rank"]] = (time.time(), msg.get("metrics"))
+            return {"ok": True, "epoch": self.epoch,
+                    "world": sorted(self.world)}
+
+    def _do_metrics(self) -> dict:
+        """Every live rank's latest snapshot in one reply.  Ranks outside
+        the current world are pruned (their cache entries are residue of
+        a shrink); age lets the caller judge freshness."""
+        now = time.time()
+        with self._cv:
+            self._metrics = {r: v for r, v in self._metrics.items()
+                             if r in self.world}
+            return {"ok": True, "epoch": self.epoch,
+                    "world": sorted(self.world),
+                    "ranks": {r: {"age_s": round(now - t, 3), "metrics": m}
+                              for r, (t, m) in self._metrics.items()}}
+
 
 # -- the per-process membership object --------------------------------------
 
@@ -547,14 +627,7 @@ class ElasticMembership:
         if self.rank not in self._view.world:
             raise ValueError(f"rank {self.rank} not in world "
                              f"{list(self._view.world)}")
-        if bus is None:
-            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-            port = cfg.membership_port or (
-                int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 2)
-        else:
-            host, port_s = bus.rsplit(":", 1)
-            port = int(port_s)
-        self.bus_addr = (host, port)
+        self.bus_addr = resolve_bus_addr(bus)
         self.devices = devices
         self.assigner = assigner
         self.server_engine = server_engine
@@ -667,6 +740,35 @@ class ElasticMembership:
             return api._require().registry.names_in_declaration_order()
         return list(api._declared_order)
 
+    # -- cross-rank observability ------------------------------------------
+
+    def _local_metrics(self) -> Optional[dict]:
+        """The compact snapshot every sync piggybacks (None when
+        telemetry is off or the snapshot itself fails — observability
+        must never fail a step barrier)."""
+        try:
+            from ..common.config import get_config
+            if not get_config().telemetry_on:
+                return None
+            from ..core import api
+            return api.metrics_snapshot(light=True)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def publish_metrics(self) -> bool:
+        """Best-effort explicit snapshot push (``metrics_put``) for
+        processes between step barriers; returns False instead of
+        raising when the bus is unreachable."""
+        try:
+            from ..core import api
+            bus_request(self.bus_addr,
+                        {"op": "metrics_put", "rank": self.rank,
+                         "metrics": api.metrics_snapshot(light=True)},
+                        timeout=5.0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
     # -- the step barrier / all-gather ------------------------------------
 
     def step_sync(self, step: int, payload: Any = None,
@@ -695,7 +797,8 @@ class ElasticMembership:
         view = self._view
         msg: Dict[str, Any] = {"op": "sync", "rank": self.rank,
                                "epoch": view.epoch, "step": step,
-                               "payload": payload}
+                               "payload": payload,
+                               "metrics": self._local_metrics()}
         if state is not None and self._join_hint:
             if not isinstance(state, bytes):
                 from ..utils.checkpoint import pack_state
@@ -761,6 +864,9 @@ class ElasticMembership:
             raise Evicted(f"rank {self.rank} was declared stale by its "
                           "own detector input")
         counters.inc("membership.shrink_started")
+        _flight.record("membership.shrink_started", stale=sorted(stale),
+                       proposed_epoch=proposed_epoch,
+                       proposed_world=list(proposed_world))
         t0 = time.monotonic()
         get_logger().error(
             "membership: rank(s) %s lost — shrinking to %s (epoch %d)",
@@ -832,6 +938,8 @@ class ElasticMembership:
                 self.kv_store.set_membership_epoch(view.epoch)
             self._ensure_bus(view)
             counters.inc("membership.grow" if grew else "membership.shrink")
+            _flight.record("membership.applied", epoch=view.epoch,
+                           world=list(view.world), grew=grew)
             self._record_span("rejoin" if grew else "shrink", t0, view)
             get_logger().warning(
                 "membership: now epoch %d, world %s (%d worker(s))",
@@ -916,6 +1024,9 @@ class ElasticMembership:
             from ..utils.checkpoint import unpack_state
             state = unpack_state(reply["state"])
         counters.inc("membership.rejoined")
+        _flight.record("membership.rejoined", rank=int(rank),
+                       epoch=view.epoch, world=list(view.world),
+                       step=reply.get("step"))
         probe._record_span("rejoin", t0, view)
         get_logger().warning(
             "membership: rank %d rejoined at epoch %d, world %s, step %s",

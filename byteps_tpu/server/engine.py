@@ -229,6 +229,10 @@ class ServerEngine:
             for i, q in enumerate(self.queues)]
         for t in self._threads:
             t.start()
+        # /debug/state reachability (weakly held — registration must not
+        # keep a shut-down engine alive)
+        from ..common import metrics as _metrics
+        _metrics.register_component("server_engine", self)
 
     # -- assignment --------------------------------------------------------
 
@@ -275,6 +279,28 @@ class ServerEngine:
     @property
     def membership_epoch(self) -> int:
         return self._membership_epoch
+
+    def debug_state(self) -> dict:
+        """Postmortem internals for ``/debug/state``
+        (common/obs_server.py): per-key merge round, version, poison
+        flag, and the quarantined-round set."""
+        with self._states_lock:
+            items = list(self._states.items())
+        keys = {}
+        for key, st in items:
+            with st.lock:
+                keys[key] = {
+                    "version": st.version,
+                    "round_no": st.round_no,
+                    "count": st.count,
+                    "poisoned": st.poisoned,
+                    "quarantined_rounds": sorted(st.quarantined_rounds),
+                    "drop_once": sorted(st.drop_once),
+                }
+        return {"kind": "server_engine",
+                "membership_epoch": self._membership_epoch,
+                "threads": self.num_threads,
+                "keys": keys}
 
     def push(self, key: str, value, worker_id: int,
              num_workers: int, mepoch: Optional[int] = None) -> None:
@@ -473,6 +499,11 @@ class ServerEngine:
             fulfill(np.array(out, copy=True), version)
         _integrity.record_span("quarantine", t0, key=key,
                                republished_version=version)
+        # quarantines are exactly the "what was it doing when it broke"
+        # moment the flight recorder exists for: dump the black box
+        from ..common import flight_recorder as _flight
+        _flight.record("quarantine", key=key, republished_version=version)
+        _flight.dump("quarantine")
         get_logger().error(
             "server engine: round for key %r quarantined — previous merge "
             "version %d republished", key, version)

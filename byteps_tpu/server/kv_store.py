@@ -67,6 +67,33 @@ class KVStore:
         # force the one-time native build/load here, NOT under self._lock in
         # push_delta (the first load may g++-compile core.cc for seconds)
         _native_load()
+        # /debug/state reachability (weakly held)
+        from ..common import metrics as _metrics
+        _metrics.register_component("kv_store", self)
+
+    def _account_wire(self, nbytes: int, wasted: bool = False) -> None:
+        """Caller holds the lock.  Wire accounting lands BOTH on the
+        instance attributes (the established per-store figures) and the
+        process-wide metrics registry (``wire_bytes`` /
+        ``wire_bytes_wasted`` counters — the /metrics surface)."""
+        if wasted:
+            self.wire_bytes_wasted += nbytes
+            counters.inc("wire_bytes_wasted", nbytes)
+        else:
+            self.wire_bytes += nbytes
+            counters.inc("wire_bytes", nbytes)
+
+    def debug_state(self) -> dict:
+        """Postmortem internals for ``/debug/state``: dedup floors, wire
+        accounting, key count."""
+        with self._lock:
+            return {"kind": "kv_store",
+                    "membership_epoch": self._membership_epoch,
+                    "keys": len(self._store),
+                    "wire_bytes": self.wire_bytes,
+                    "wire_bytes_wasted": self.wire_bytes_wasted,
+                    "dedup_floors": {f"{k}:{w}": s
+                                     for (k, w), s in self._seen.items()}}
 
     def set_membership_epoch(self, epoch: int) -> None:
         """Adopt a new membership epoch (monotonic); see ServerEngine.
@@ -193,7 +220,7 @@ class KVStore:
         chaos site ``kv_push``, with every rejected transmission
         accounting ``wasted_nbytes`` into :attr:`wire_bytes_wasted`."""
         def wasted():
-            self.wire_bytes_wasted += wasted_nbytes
+            self._account_wire(wasted_nbytes, wasted=True)
 
         return _integrity.wire_transmit(
             frame, key=key, worker=worker_id, seq=seq, site="kv_push",
@@ -282,7 +309,7 @@ class KVStore:
             if codec is None:
                 raise KeyError(f"key {key!r} has no registered compression")
             if self._dup(key, worker_id, seq):
-                self.wire_bytes_wasted += len(data)
+                self._account_wire(len(data), wasted=True)
                 version = self._versions.get(key, -1)
                 self._maybe_drop_ack(key, version, seq)
                 return version
@@ -307,16 +334,16 @@ class KVStore:
                 delta = _integrity.screen_nonfinite(
                     delta, what="delta", key=key, worker=worker_id)
                 if delta is None:  # skip policy: dropped, bytes wasted
-                    self.wire_bytes_wasted += len(data)
+                    self._account_wire(len(data), wasted=True)
                     self._mark_seen(key, worker_id, seq)  # fate is final
                     return self._versions.get(key, -1)
             before = self._versions.get(key, -1)
             version = self._push_delta_locked(key, delta)
             self._mark_seen(key, worker_id, seq)
             if version != before:
-                self.wire_bytes += len(data)
+                self._account_wire(len(data))
             else:  # merged-screen skip: the delta did not land
-                self.wire_bytes_wasted += len(data)
+                self._account_wire(len(data), wasted=True)
             self._maybe_drop_ack(key, version, seq)
             return version
 

@@ -189,11 +189,22 @@ class HeartbeatMonitor:
 
     # -- internals ---------------------------------------------------------
 
+    def last_beat_age(self) -> float:
+        """Seconds since this rank last heard a healthy reply from the
+        heartbeat endpoint — the ``/healthz`` liveness figure
+        (``common/obs_server.py``)."""
+        return time.monotonic() - self._last_reply
+
     def _fire(self, stale: Set[int]) -> None:
         with self._lock:
             if self._fired:
                 return
             self._fired = True
+        # the trip is postmortem material whatever on_failure does next
+        # (shrink, recovery, or exit): dump the black box first
+        from ..common import flight_recorder as _flight
+        _flight.record("failure_detector.trip", stale=sorted(stale))
+        _flight.dump("failure_detector")
         self.on_failure(stale)
 
     def _stale_ranks(self) -> Set[int]:

@@ -22,6 +22,16 @@ if "xla_force_host_platform_device_count" not in flags:
 # then this only exercises the Config parsing path.
 os.environ.setdefault("BYTEPS_MIN_COMPRESS_BYTES", "0")
 
+# Flight-recorder dumps default to the cwd; under pytest that is the repo
+# root, and every chaos test that trips a detector/quarantine/kill would
+# shed a JSON file into it.  Route them to one session-scoped temp dir
+# (tests that assert on dumps set BYTEPS_FLIGHT_DIR explicitly anyway).
+if "BYTEPS_FLIGHT_DIR" not in os.environ:
+    import tempfile
+
+    os.environ["BYTEPS_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="bps_flight_test_")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -72,3 +82,20 @@ def _fresh_config():
     reset_config()
     yield
     reset_config()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Reset the process-wide observability singletons BETWEEN tests:
+    the metrics registry (counters/gauges/histograms), the flight
+    recorder's ring, and any leaked obs HTTP endpoint.  Without this,
+    ``counters`` leaks across test files and every assertion on an
+    absolute count is order-dependent (ISSUE 6 satellite)."""
+    yield
+    from byteps_tpu.common import flight_recorder as _flight
+    from byteps_tpu.common import metrics as _metrics
+    from byteps_tpu.common import obs_server as _obs
+    _obs.stop_server()
+    _metrics.registry.reset()
+    _metrics._reset_components_for_tests()
+    _flight._reset_for_tests()

@@ -150,6 +150,13 @@ def main() -> int:
                 rank, len(world), "127.0.0.1:" + hb_port,
                 interval=0.08, timeout=0.7, grace=60.0,
                 on_failure=on_failure).start()
+    # observability plane (test_observability.py): announce the obs
+    # endpoint's resolved port when BYTEPS_OBS_PORT armed one — the
+    # server outlives suspend/resume, so the port stays valid across
+    # elastic transitions
+    from byteps_tpu.common import obs_server as _obs
+    if _obs.get_server() is not None:
+        print("OBS", rank, _obs.get_server().port, flush=True)
     print("START", rank, flush=True)
 
     step = start_step

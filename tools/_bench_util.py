@@ -116,3 +116,20 @@ def conditions_block(pinned=None, note: str = "") -> dict:
                        if hasattr(os, "getloadavg") else None),
         "note": note,
     }
+
+
+def metrics_diag() -> dict:
+    """Diagnostics counters embedded in bench artifacts (bench_smoke,
+    overlap_bench): a regression record arrives with its own evidence —
+    did the compile cache stop hitting, did AOT warm fail, did the wire
+    start retransmitting.  ONE copy, so the benches cannot drift in
+    which counters they snapshot."""
+    from byteps_tpu.common.telemetry import counters
+    return {
+        "compile_cache_hit": counters.get("engine.compile_cache_hit"),
+        "compile_cache_miss": counters.get("engine.compile_cache_miss"),
+        "aot_compiled": counters.get("engine.aot_compiled"),
+        "aot_compile_failed": counters.get("engine.aot_compile_failed"),
+        "retransmits": counters.get("integrity.retransmit"),
+        "crc_rejects": counters.get("integrity.crc_reject"),
+    }

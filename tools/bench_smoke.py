@@ -88,11 +88,17 @@ def _measure(nbytes=8 * MB, reps=9):
         snap = eng.planner.snapshot()
     finally:
         eng.shutdown(wait=False)
+    # Diagnostics snapshot riding the bench record (ISSUE 6 satellite):
+    # a ratio regression arrives with its own evidence instead of
+    # needing a rerun under a profiler.
+    from tools._bench_util import metrics_diag
+    diag = metrics_diag()
     return {"fused_8MB_gbps": round(nbytes / med(fused_t) / 1e9, 3),
             "engine_8MB_gbps": round(nbytes / med(eng_t) / 1e9, 3),
             "engine_vs_fused_ratio": round(med(ratios), 3),
             "ratio_per_rep": [round(r, 3) for r in sorted(ratios)],
-            "autotune": snap}
+            "autotune": snap,
+            "metrics": diag}
 
 
 def main() -> int:

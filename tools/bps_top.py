@@ -1,0 +1,125 @@
+"""bps_top: live terminal view of a running byteps_tpu cluster.
+
+Polls the membership bus's ``metrics`` verb (one round-trip returns
+every live rank's latest snapshot — ``core/api.py:cluster_metrics()``)
+and renders a per-rank table: push_pull GB/s, scheduler queue depth,
+sync-stall %, retransmits, and the membership epoch — the "what is the
+cluster doing RIGHT NOW" companion to the flight recorder's "what was
+it doing when it died".  Works against anything from a 3-process chaos
+run to a single local engine (no bus → a local-only view).
+
+Usage:
+    python tools/bps_top.py [--bus HOST:PORT] [--interval SEC]
+                            [--once] [--json]
+
+    --bus       membership bus address (default: DMLC_PS_ROOT_URI +
+                BYTEPS_MEMBERSHIP_PORT, the ElasticMembership default)
+    --interval  refresh period, seconds (default 2)
+    --once      print one frame and exit (scripting / tests)
+    --json      print raw cluster_metrics() JSON instead of the table
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "RETX",
+            "EPOCH", "STEP", "AGE")
+
+
+def _rank_row(rank: int, entry: dict) -> tuple:
+    """One table row from a rank's cached snapshot (missing fields render
+    as '-': a rank mid-transition posts partial snapshots)."""
+    m = entry.get("metrics") or {}
+    gauges = m.get("gauges") or {}
+    counters = m.get("counters") or {}
+    step = m.get("step") or {}
+
+    def fmt(v, spec="{}"):
+        return "-" if v is None else spec.format(v)
+
+    mbps = m.get("speed_mbps")   # MiB/s (SpeedMonitor's 2**20 unit)
+    stall = None
+    if step.get("wall_ms"):
+        stall = 100.0 * min(1.0, (step.get("sync_stall_ms") or 0.0)
+                            / step["wall_ms"])
+    return (
+        str(rank),
+        # decimal GB/s, the same unit the bench tools' *_gbps report —
+        # an operator comparing a row against the bench floor must not
+        # eat a silent 7.4% MiB/GiB discrepancy
+        fmt(None if mbps is None else mbps * 2**20 / 1e9, "{:.3f}"),
+        fmt(m.get("sched_pending",
+                  gauges.get("engine.sched_pending"))),
+        fmt(m.get("bytes_in_flight")),
+        fmt(stall, "{:.0f}"),
+        fmt(counters.get("integrity.retransmit", 0)),
+        fmt(m.get("epoch")),
+        fmt(step.get("step")),
+        fmt(entry.get("age_s"), "{:.1f}s"),
+    )
+
+
+def render(cluster: dict) -> str:
+    """The table for one cluster_metrics() reply (pure; unit-tested)."""
+    rows = [_COLUMNS]
+    for rank in sorted(cluster.get("ranks", {})):
+        rows.append(_rank_row(rank, cluster["ranks"][rank]))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(_COLUMNS))]
+    lines = [
+        "byteps_tpu cluster — epoch %s, world %s%s" % (
+            cluster.get("epoch"), cluster.get("world"),
+            " (local-only view: no membership bus)"
+            if cluster.get("local_only") else ""),
+        "  ".join(c.rjust(w) for c, w in zip(rows[0], widths)),
+    ]
+    for row in rows[1:]:
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+    missing = sorted(set(cluster.get("world", []))
+                     - set(cluster.get("ranks", {})))
+    if missing:
+        lines.append(f"(no snapshot yet from rank(s) {missing} — they "
+                     "report on their next step_sync)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--bus", default=None, help="membership bus host:port")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from byteps_tpu.core.api import cluster_metrics
+
+    while True:
+        try:
+            cluster = cluster_metrics(bus=args.bus)
+        except Exception as e:  # noqa: BLE001 — a dead bus mid-watch
+            print(f"bps_top: cluster_metrics failed: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.json:
+            print(json.dumps(cluster, default=str))
+        else:
+            if not args.once:
+                # clear + home, like top (plain ANSI, no curses dep)
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(render(cluster), flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -221,8 +221,12 @@ def main() -> int:
 
     width = 512
     # ~2 layers' worth of gradient bytes in flight: the credit window that
-    # makes priority meaningful (docs/performance.md, mechanism section)
-    cfg = Config(telemetry_on=False, trace_on=False,
+    # makes priority meaningful (docs/performance.md, mechanism section).
+    # telemetry stays ON (unlike bench_smoke): the engine-side StepStats
+    # (sync stall / overlap fraction per step) are part of this bench's
+    # OUTPUT; the accounting is a few dict ops per push, identical across
+    # the three modes, so the mode comparison is unaffected.
+    cfg = Config(telemetry_on=True, trace_on=False,
                  enable_priority=True,
                  scheduling_credit=2 * width * width * 4)
     api.init(cfg)
@@ -239,6 +243,15 @@ def main() -> int:
             pinned = _measure(width=width)
             pinned["pinning"] = info
             out["pinned_disjoint"] = pinned
+        # Engine-side evidence beside the end-to-end figures (ISSUE 6):
+        # the engine's own per-step view (bytes pushed, sync stall ms,
+        # overlap fraction = un-stalled share of step wall) and the
+        # diagnostics a regression needs to explain itself.
+        from tools._bench_util import metrics_diag
+        eng = api._require()
+        out["engine_step_stats"] = eng.step_stats.summary()
+        out["metrics"] = dict(metrics_diag(),
+                              planner=eng.planner.snapshot())
     finally:
         api.shutdown()
     out["conditions"] = conditions_block(

@@ -12,7 +12,13 @@
 #      anything the in-process alarm cannot interrupt.
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
-#         lane: chaos (default) | integrity | all
+#         lane: chaos (default) | integrity | obs | all
+#         obs: the observability-under-chaos slice — every rank of a
+#              3-process chaos run serves /metrics//healthz, the
+#              membership bus answers cluster_metrics, and a
+#              chaos-killed worker leaves a flight-recorder dump whose
+#              tail holds the events leading into the kill
+#              (tests/test_observability.py)
 # Env:    CHAOS_TEST_TIMEOUT  per-test seconds   (default 120)
 #         CHAOS_LANE_TIMEOUT  whole-lane seconds (default 600)
 set -o pipefail
@@ -23,14 +29,17 @@ PER_TEST="${CHAOS_TEST_TIMEOUT:-120}"
 LANE="${CHAOS_LANE_TIMEOUT:-600}"
 
 MARK="chaos"
+KEXPR=""
 case "${1:-}" in
     chaos)     MARK="chaos"; shift ;;
     integrity) MARK="integrity"; shift ;;
+    obs)       MARK="chaos"; KEXPR="flight_recorder or obs_cluster"; shift ;;
     all)       MARK="chaos or integrity"; shift ;;
 esac
 
 exec timeout -k 15 "$LANE" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$MARK" \
+    ${KEXPR:+-k "$KEXPR"} \
     -p tools.chaos_timeout_plugin --chaos-timeout "$PER_TEST" \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     "$@"
