@@ -159,6 +159,17 @@ class Config:
     #                                  launchers' --restart supervision
     #                                  treats exactly this code as worth
     #                                  restarting (a crash exits 1)
+    sync_deadline_s: float = 0.0     # BYTEPS_SYNC_DEADLINE_S: per-unit
+    #                                  deadline in the engine's sync loop
+    #                                  (0 = off).  A unit blocked past it
+    #                                  (the wedged-collective TPU failure
+    #                                  mode: a dead peer blocks survivors
+    #                                  silently) is reported as data-path
+    #                                  failure evidence to the installed
+    #                                  failure action (shrink/recover);
+    #                                  os._exit stays the escalation of
+    #                                  last resort when nothing is
+    #                                  installed
 
     # --- elastic membership (fault/membership.py) ---
     elastic: bool = False            # BYTEPS_ELASTIC: elastic-membership
@@ -168,6 +179,16 @@ class Config:
     membership_port: int = 0         # BYTEPS_MEMBERSHIP_PORT: membership
     #                                  bus TCP port on the coordinator host
     #                                  (0 = DMLC_PS_ROOT_PORT + 2)
+    membership_hosts: str = ""       # BYTEPS_MEMBERSHIP_HOSTS: per-rank
+    #                                  "host[:port]" list (comma-separated,
+    #                                  indexed by rank) making the bus
+    #                                  address VIEW-aware on multi-host:
+    #                                  after a coordinator change the bus
+    #                                  is re-resolved to the new
+    #                                  coordinator's entry instead of the
+    #                                  static env-derived address; empty =
+    #                                  single fixed address (single-host
+    #                                  failover re-binds the same one)
     membership_rendezvous_timeout_s: float = 10.0
     #                                  BYTEPS_MEMBERSHIP_RENDEZVOUS_TIMEOUT:
     #                                  how long the shrink rendezvous waits
@@ -309,6 +330,8 @@ class Config:
         if (self.membership_rendezvous_timeout_s <= 0
                 or self.membership_sync_timeout_s <= 0):
             raise ValueError("membership timeouts must be positive")
+        if self.sync_deadline_s < 0:
+            raise ValueError("sync_deadline_s must be >= 0 (0 = off)")
         if not 0 <= self.membership_port < 65536:
             raise ValueError("membership_port must be in 0..65535")
         if self.nonfinite_policy not in ("raise", "skip", "zero"):
@@ -376,6 +399,8 @@ class Config:
             heartbeat_timeout_s=_env_float("BYTEPS_HEARTBEAT_TIMEOUT",
                                            30.0),
             failure_exit_code=_env_int("BYTEPS_FAILURE_EXIT_CODE", 17),
+            sync_deadline_s=_env_float("BYTEPS_SYNC_DEADLINE_S", 0.0),
+            membership_hosts=_env_str("BYTEPS_MEMBERSHIP_HOSTS", ""),
             integrity_on=_env_bool("BYTEPS_INTEGRITY", True),
             integrity_loopback=_env_bool("BYTEPS_INTEGRITY_LOOPBACK", True),
             integrity_max_retransmits=_env_int(

@@ -69,6 +69,11 @@ def healthz() -> dict:
     from ..fault import membership as _membership
     eng = api._engine
     hb = api._heartbeat
+    m = _membership.active_membership()
+    if m is not None and m.heartbeat is not None:
+        # the membership-managed monitor (re-hosted per world change)
+        # supersedes the static auto-armed one
+        hb = m.heartbeat
     doc = {
         "ok": True,
         "ts": time.time(),
@@ -77,6 +82,21 @@ def healthz() -> dict:
         "last_heartbeat_age_s": (round(hb.last_beat_age(), 3)
                                  if hb is not None else None),
     }
+    if m is not None:
+        # who hosts the control plane RIGHT NOW (coordinator failover
+        # visibility: bps_top and operators read this)
+        v = m.view()
+        doc["membership"] = {
+            "rank": m.rank,
+            "world": list(v.world),
+            "coordinator": v.coordinator,
+            "standby": m.standby_rank,
+            "is_coordinator": m.is_coordinator,
+            "hosting_bus": m.hosting_bus,
+            "bus_addr": "%s:%d" % tuple(m.bus_addr),
+            "heartbeat_server_rank": (hb.server_rank
+                                      if hb is not None else None),
+        }
     if eng is not None:
         ts, mbps = eng.speed.speed()
         doc["pushpull_mbps"] = round(mbps, 3)
@@ -90,6 +110,7 @@ def debug_state() -> dict:
     per-component quarantine/dedup state, flight-recorder fill."""
     from . import flight_recorder as _flight
     from ..core import api
+    from ..fault import membership as _membership
     eng = api._engine
     doc: dict = {
         "engine": None,
@@ -103,6 +124,21 @@ def debug_state() -> dict:
             "capacity": _flight.recorder._ring.maxlen,
         },
     }
+    m = _membership.active_membership()
+    if m is not None:
+        v = m.view()
+        doc["membership"] = {
+            "epoch": v.epoch,
+            "world": list(v.world),
+            "coordinator": v.coordinator,
+            "standby": m.standby_rank,
+            "hosting_bus": m.hosting_bus,
+            "bus_addr": "%s:%d" % tuple(m.bus_addr),
+            # failover readiness: does this rank hold a replica to seed
+            # a successor bus from, and how fresh is it?
+            "replica": {"held": m._replica is not None,
+                        "epoch": (m._replica or {}).get("epoch")},
+        }
     if eng is not None:
         try:
             doc["engine"] = {

@@ -145,16 +145,20 @@ def membership_epoch() -> int:
     return _membership.current_epoch()
 
 
-def suspend() -> None:
+def suspend(wait: bool = True) -> None:
     """Elastic-training pause: drain and stop (reference byteps_suspend,
     operations.cc:96-105).  Declared tensor order is retained so resume()
     reproduces identical key assignment.  Under elastic membership this
     is the drain half of a shrink/rejoin transition
-    (fault/membership.py)."""
+    (fault/membership.py).  ``wait=False`` skips the handle drain — for
+    transitions driven by a WEDGED data path, where the drain would
+    block on the very unit that is stuck (the epoch guard already
+    protects correctness: the wedged unit's late result is dropped as
+    stale)."""
     global _declared_order
     eng = _require()
     _declared_order = eng.registry.names_in_declaration_order()
-    shutdown(wait=True)
+    shutdown(wait=wait)
 
 
 def resume(config: Optional[Config] = None,
@@ -311,21 +315,48 @@ def cluster_metrics(bus: Optional[str] = None,
     same resolution :class:`~byteps_tpu.fault.membership.ElasticMembership`
     uses (DMLC root + BYTEPS_MEMBERSHIP_PORT).
 
-    A run with no bus at all (single process, non-elastic) falls back
-    to a local-only view — rank → this process's own snapshot — so
-    ``tools/bps_top.py`` works against anything."""
+    The bus address is re-resolved from the ACTIVE membership view
+    (``fault.membership.active_membership()``) so a coordinator change
+    re-points the query at the successor instead of the static
+    env-derived address.  While an elastic world's bus is not answering
+    (a failover in progress), the answer degrades gracefully to a
+    local-only view flagged ``failover_in_progress`` instead of
+    raising; a run with no bus at all (single process, non-elastic)
+    falls back to the plain local-only view — so ``tools/bps_top.py``
+    works against anything."""
     from ..fault import membership as _membership
-    addr = _membership.resolve_bus_addr(bus)
+    m = _membership.active_membership()
+    view = m.view() if (bus is None and m is not None) else None
+    if view is not None:
+        # the live membership already tracks the bus through failovers
+        # (including explicitly-constructed addresses no env resolution
+        # could re-derive)
+        addr = m.bus_addr
+    else:
+        addr = _membership.resolve_bus_addr(bus, view)
     try:
         reply = _membership.bus_request(
             addr, {"op": "metrics"}, timeout=timeout)
     except ConnectionError:
         snap = metrics_snapshot()
-        return {"epoch": _membership.current_epoch(),
-                "world": [snap["rank"]],
-                "ranks": {snap["rank"]: {"age_s": 0.0, "metrics": snap}},
-                "local_only": True}
+        out: Dict[str, Any] = {
+            "epoch": _membership.current_epoch(),
+            "world": (list(view.world) if view is not None
+                      else [snap["rank"]]),
+            "ranks": {snap["rank"]: {"age_s": 0.0, "metrics": snap}},
+            "local_only": True}
+        if view is not None and view.num_workers > 1:
+            # an elastic world exists but its bus is not answering: the
+            # standby is (or should be) rebinding right now
+            out["failover_in_progress"] = True
+            out["coordinator"] = view.coordinator
+            out["standby"] = m.standby_rank
+        return out
     if not reply.get("ok"):
         raise RuntimeError(f"cluster_metrics failed: {reply!r}")
-    return {"epoch": reply["epoch"], "world": reply["world"],
-            "ranks": {int(r): v for r, v in reply["ranks"].items()}}
+    out = {"epoch": reply["epoch"], "world": reply["world"],
+           "ranks": {int(r): v for r, v in reply["ranks"].items()}}
+    for k in ("coordinator", "standby", "bus_rank"):
+        if reply.get(k) is not None:
+            out[k] = reply[k]
+    return out

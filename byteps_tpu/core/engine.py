@@ -277,12 +277,32 @@ class PushPullEngine:
         self._dispatch_enabled.set()
         self._parked = threading.Event()  # dispatcher pause handshake
         self._running = True
+        # Data-path sync deadline (BYTEPS_SYNC_DEADLINE_S, off by
+        # default): a unit the syncer stays blocked on past the deadline
+        # — the wedged-collective TPU failure mode, where a dead peer
+        # blocks survivors inside block_until_ready without erroring
+        # them — is converted into failure evidence for the installed
+        # failure action (failure_detector.data_path_stalled) instead of
+        # wedging silently until the step watchdog's last-resort exit.
+        # The watchdog must be a SEPARATE thread: the captive syncer
+        # cannot observe its own wedge.
+        self._block = jax.block_until_ready  # patch point: tests wedge it
+        self._deadline_on = cfg.sync_deadline_s > 0
+        self._sync_block_lock = threading.Lock()
+        self._sync_block: Optional[tuple] = None  # (t0, [tensor names])
+        self._deadline_stop = threading.Event()
+        self._deadline_thread: Optional[threading.Thread] = None
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="bps-dispatch", daemon=True)
         self._syncer = threading.Thread(
             target=self._sync_loop, name="bps-sync", daemon=True)
         self._dispatcher.start()
         self._syncer.start()
+        if cfg.sync_deadline_s > 0:
+            self._deadline_thread = threading.Thread(
+                target=self._deadline_loop, name="bps-sync-deadline",
+                daemon=True)
+            self._deadline_thread.start()
         _flight.record("engine.init", ranks=comm.num_ranks,
                        epoch=_membership.current_epoch())
 
@@ -983,30 +1003,46 @@ class PushPullEngine:
                 if item is _SHUTDOWN:
                     shutdown = True
                     continue
-                if _fault.ENABLED:
-                    # chaos site "sync": delay completion -> callback
-                    _fault.fire("sync")
                 tasks, out, rollback, err, t_disp = item
-                if err is None:
-                    t_blk = time.perf_counter()
-                    try:
-                        # For buffer runs ``out`` is the completion
-                        # token, not the buffer: the buffer itself may
-                        # already have been donated into a later chunk's
-                        # program.
-                        jax.block_until_ready(out)
-                    except Exception as e:  # noqa: BLE001
-                        err = e
-                        if rollback is not None:
-                            slot, wst, sst = rollback
-                            slot.wstates = wst
-                            slot.sstate = sst
-                    if self.cfg.telemetry_on:
-                        # time this thread spent BLOCKED on device
-                        # completion — the step's sync-stall share (the
-                        # un-overlapped remainder of communication)
-                        self.step_stats.add_stall(
-                            (time.perf_counter() - t_blk) * 1e3)
+                # Per-unit data-path deadline: stamp the unit under
+                # retirement so _deadline_loop can observe how long this
+                # thread has been captive (a wedged block_until_ready
+                # never returns, so the observation must be out-of-band).
+                # The stamp covers the chaos "sync" site too — chaos
+                # delays are the test double for a wedged collective.
+                if self._deadline_on:
+                    with self._sync_block_lock:
+                        self._sync_block = (time.monotonic(),
+                                            [t.name for t in tasks])
+                try:
+                    if _fault.ENABLED:
+                        # chaos site "sync": delay completion -> callback
+                        _fault.fire("sync")
+                    if err is None:
+                        t_blk = time.perf_counter()
+                        try:
+                            # For buffer runs ``out`` is the completion
+                            # token, not the buffer: the buffer itself may
+                            # already have been donated into a later
+                            # chunk's program.
+                            self._block(out)
+                        except Exception as e:  # noqa: BLE001
+                            err = e
+                            if rollback is not None:
+                                slot, wst, sst = rollback
+                                slot.wstates = wst
+                                slot.sstate = sst
+                        if self.cfg.telemetry_on:
+                            # time this thread spent BLOCKED on device
+                            # completion — the step's sync-stall share
+                            # (the un-overlapped remainder of
+                            # communication)
+                            self.step_stats.add_stall(
+                                (time.perf_counter() - t_blk) * 1e3)
+                finally:
+                    if self._deadline_on:
+                        with self._sync_block_lock:
+                            self._sync_block = None
                 # Unit credits back BEFORE callbacks, one lock op for the
                 # whole run: the dispatcher can launch the next window
                 # while this thread runs assembly.
@@ -1022,6 +1058,47 @@ class PushPullEngine:
                 # Null context on modern runtimes.
                 with jax_compat.runtime_lock():
                     self._finish_batch(tasks, out, err)
+
+    def _deadline_loop(self):
+        """Per-unit sync-deadline watchdog (BYTEPS_SYNC_DEADLINE_S): a
+        unit the syncer has been blocked on past the deadline becomes
+        data-path failure evidence (``failure_detector.
+        data_path_stalled`` → the installed failure action — an elastic
+        shrink/reconcile — with ``os._exit`` only as the uninstalled
+        last resort).  One report per wedged unit: the action's own
+        recovery (epoch guard up, suspend/resume) takes over from
+        there."""
+        deadline = self.cfg.sync_deadline_s
+        period = max(0.05, min(1.0, deadline / 4.0))
+        reported = None
+        while not self._deadline_stop.wait(period):
+            if not self._running:
+                return
+            with self._sync_block_lock:
+                blk = self._sync_block
+            if blk is None:
+                reported = None
+                continue
+            t0, names = blk
+            gap = time.monotonic() - t0
+            if gap <= deadline or reported == t0:
+                continue
+            reported = t0
+            counters.inc("engine.sync_deadline_trips")
+            _flight.record("engine.sync_deadline", gap_s=round(gap, 3),
+                           deadline_s=deadline, tensors=names[:8])
+            get_logger().error(
+                "engine: sync unit %s blocked %.1fs > "
+                "BYTEPS_SYNC_DEADLINE_S=%.1f — reporting data-path "
+                "failure evidence", names[:4], gap, deadline)
+            try:
+                from ..utils.failure_detector import data_path_stalled
+                data_path_stalled(gap, detail=f"sync unit {names[:4]}")
+            except Exception:  # noqa: BLE001 — the failure action owns
+                # its own escalation; a raise through here (e.g. Evicted)
+                # was already logged/handled there
+                get_logger().error("sync-deadline failure action raised",
+                                   exc_info=True)
 
     def _finish_batch(self, tasks, out, err):
         ep = _membership.current_epoch()
@@ -1072,13 +1149,24 @@ class PushPullEngine:
     # ---------------------------------------------------------- lifecycle
     def shutdown(self, wait: bool = True):
         if wait:
-            # drain: wait for all outstanding handles
+            # drain: wait for all outstanding handles — under ONE total
+            # budget, not a per-handle 60s.  With a sync deadline armed
+            # the operator has declared a unit blocked past it dead, so
+            # the drain honors the same declaration: a reconcile after a
+            # deadline trip must not stall its recovery behind the very
+            # handle that is wedged (it resolves, if ever, as a
+            # stale-epoch ABORT once the block returns).
+            budget = (60.0 if self.cfg.sync_deadline_s <= 0
+                      else max(5.0, self.cfg.sync_deadline_s))
+            deadline = time.monotonic() + budget
             for h in self.handles.outstanding():
                 try:
-                    h.wait(timeout=60)
+                    h.wait(timeout=max(0.1,
+                                       deadline - time.monotonic()))
                 except Exception:  # noqa: BLE001
                     pass
         self._running = False
+        self._deadline_stop.set()
         # wake a dispatcher blocked in the (timeout-free) pop or parked
         # on the pause gate; the run flag is already down, so it exits
         self._dispatch_enabled.set()

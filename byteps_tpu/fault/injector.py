@@ -12,6 +12,18 @@ Spec grammar (``BYTEPS_FAULT_SPEC``, ``;``- or ``,``-separated faults)::
 
     kill:rank=1:step=40            die (os._exit) when this process's
                                    push_pull counter reaches step 40
+    kill:site=coordinator:step=40  die at step 40 ONLY if this process
+                                   is currently the membership
+                                   coordinator (hosts the control
+                                   plane) — chaos lanes kill "whoever
+                                   coordinates" without hardcoding a
+                                   rank.  Matches the PROCESS-LIFETIME
+                                   push counter (which survives the
+                                   disarm/re-arm of an elastic
+                                   suspend/resume): a successor whose
+                                   lifetime counter is already past the
+                                   step is never cascade-killed by the
+                                   re-armed schedule
     delay:site=dcn:p=0.01:ms=200   sleep 200ms with prob 0.01 per visit
     bitflip:site=server_push:p=0.001   flip one random bit of the pushed
                                    value with prob 0.001
@@ -67,12 +79,24 @@ from ..common.telemetry import counters
 ENABLED = False
 _active: Optional["FaultInjector"] = None
 
+# Process-lifetime push counter: unlike FaultInjector._step it survives
+# the disarm/re-arm cycle of an elastic suspend/resume.  site=coordinator
+# kills match THIS counter — with the per-incarnation counter, the
+# surviving successor's re-armed schedule would re-approach the same step
+# from zero and cascade-kill the new coordinator.
+_lifetime_step = 0
+
+
+def _reset_lifetime_for_tests() -> None:
+    global _lifetime_step
+    _lifetime_step = 0
+
 # monkeypatch point for tests (a real os._exit would take pytest with it)
 _exit = os._exit
 
 VALID_KINDS = ("bitflip", "delay", "drop", "kill", "straggler")
-VALID_SITES = ("dcn", "dispatch", "heartbeat", "kv_push", "server_pull",
-               "server_push", "sync")
+VALID_SITES = ("coordinator", "dcn", "dispatch", "heartbeat", "kv_push",
+               "server_pull", "server_push", "sync")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
 CORRUPT_SITES = ("kv_push", "server_push")
@@ -81,7 +105,7 @@ _FIELDS = ("rank", "step", "site", "p", "ms", "code")
 # silently ignored (kill:p=0.1 must fail loudly, not kill
 # deterministically while the operator believes it is probabilistic)
 _KIND_FIELDS = {
-    "kill": ("rank", "step", "code"),
+    "kill": ("rank", "step", "site", "code"),
     "delay": ("rank", "site", "p", "ms"),
     "straggler": ("rank", "site", "ms"),
     "drop": ("rank", "site", "p"),
@@ -112,6 +136,21 @@ class FaultRule:
             if v is not None:
                 parts.append(f"{f}={v}")
         return ":".join(parts)
+
+
+def _is_coordinator() -> bool:
+    """The ``kill:site=coordinator`` predicate: does THIS process
+    currently host the membership control plane (the coordinator of the
+    active :class:`~byteps_tpu.fault.membership.ElasticMembership`'s
+    view)?  False when no elastic membership is running — the rule then
+    never fires, matching "kill the coordinator" semantics for worlds
+    that have none."""
+    try:
+        from .membership import active_membership
+        m = active_membership()
+        return m is not None and m.is_coordinator
+    except Exception:  # noqa: BLE001 — the injector must never crash
+        return False
 
 
 def _fail(spec: str, clause: str, msg: str) -> ValueError:
@@ -171,6 +210,14 @@ def parse_spec(spec: str) -> List[FaultRule]:
         if kind == "kill" and step is None:
             raise _fail(spec, clause, "kill needs step=N (the push_pull "
                                       "count at which the process dies)")
+        if kind == "kill" and site is not None and site != "coordinator":
+            raise _fail(spec, clause,
+                        "kill supports only site=coordinator (die only "
+                        "while hosting the membership control plane)")
+        if kind != "kill" and site == "coordinator":
+            raise _fail(spec, clause,
+                        "site=coordinator is a kill-only predicate, not a "
+                        "woven code site")
         if kind in ("delay", "drop") and site is None:
             raise _fail(spec, clause,
                         f"{kind} needs site=S; valid sites: "
@@ -220,26 +267,41 @@ class FaultInjector:
     # -- site hooks --------------------------------------------------------
 
     def on_step(self) -> None:
-        """Advance the step counter (one per push_pull enqueue) and honor
-        any matching kill rule — the simulated hard crash."""
+        """Advance the step counters (one per push_pull enqueue) and
+        honor any matching kill rule — the simulated hard crash."""
+        global _lifetime_step
         with self._lock:
             self._step += 1
             step = self._step
+            _lifetime_step += 1
+            life = _lifetime_step
         for r in self._kills:
-            if (r.rank is None or r.rank == self.rank) and step == r.step:
-                counters.inc("fault.kill")
-                get_logger().error(
-                    "fault injector: kill at step %d (rank %d) — exiting %d",
-                    step, self.rank, r.code)
-                # black-box parity with a real crash: the flight
-                # recorder's tail (the events leading into this kill)
-                # hits disk BEFORE the hard exit — os._exit runs no
-                # atexit hooks, so this is the only chance
-                from ..common import flight_recorder as _flight
-                _flight.record("fault.kill", step=step, rank=self.rank,
-                               code=r.code)
-                _flight.dump("chaos_kill")
-                _exit(r.code)
+            if r.rank is not None and r.rank != self.rank:
+                continue
+            # coordinator kills count process-lifetime pushes (see the
+            # module docstring: the per-incarnation counter restarts on
+            # an elastic re-arm and would cascade-kill the successor)
+            matched = life if r.site == "coordinator" else step
+            if matched != r.step:
+                continue
+            if r.site == "coordinator" and not _is_coordinator():
+                continue
+            counters.inc("fault.kill")
+            # log/record the counter the rule MATCHED (the lifetime one
+            # for coordinator kills) so a postmortem can correlate the
+            # black box with the spec's step=N
+            get_logger().error(
+                "fault injector: kill at step %d (rank %d) — exiting %d",
+                matched, self.rank, r.code)
+            # black-box parity with a real crash: the flight
+            # recorder's tail (the events leading into this kill)
+            # hits disk BEFORE the hard exit — os._exit runs no
+            # atexit hooks, so this is the only chance
+            from ..common import flight_recorder as _flight
+            _flight.record("fault.kill", step=matched, rank=self.rank,
+                           code=r.code)
+            _flight.dump("chaos_kill")
+            _exit(r.code)
 
     def fire(self, site: str) -> None:
         """Visit a site: apply delay/straggler sleeps scheduled there."""

@@ -46,13 +46,35 @@ member that finds itself outside the agreed world raises
 :class:`Evicted` — under ``bpslaunch-dist --elastic`` it exits
 restartable and comes back through the rejoin path.
 
-Single-host note: the bus address is fixed (``BYTEPS_MEMBERSHIP_PORT``,
-default coordinator port + 2), so coordinator failover — the next
-lowest rank re-binding the same address — works wherever the survivors
-share that address (the CPU chaos tests, single-host multi-process
-runs).  A multi-host deployment keeps the bus on a supervised host
-(worker 0 under launcher ``--elastic`` restart) exactly as the DMLC
-root already must be.
+Coordinator survival (ISSUE 8): the coordinator is no longer a single
+point of state.  Every bus mutation — the agreed (epoch, world),
+per-step sync payloads, parked rejoin requests, and the cross-rank
+metrics cache — is replicated to a **standby** (the
+next-lowest live rank) by piggybacking a ``replica`` snapshot on every
+reply the bus sends that rank (plus an explicit ``replicate`` verb for
+a rank that just *became* standby).  When the coordinator dies, the
+standby re-binds the bus — same address on a single host, or its own
+``BYTEPS_MEMBERSHIP_HOSTS`` entry on multi-host (``resolve_bus_addr``
+is view-aware) — **seeded with the replicated state**, so a mid-step
+sync round and a parked joiner survive the failover instead of wedging
+until timeout.  The heartbeat plane moves with it: under
+:meth:`ElasticMembership.host_heartbeat` every applied world change
+rebuilds the monitors with ``server_rank = view.coordinator``, so
+"coordinator down" flows through the ordinary shrink path and detection
+of *subsequent* failures keeps working.  If the would-be coordinator of
+a shrink never serves the bus inside the rendezvous window, the
+proposing survivor drops it too and escalates down the rank ladder
+until it either reaches a live coordinator or hosts the bus itself —
+a double failure during failover converges instead of wedging.
+
+Failure evidence without a named suspect — a data-path deadline trip
+(``BYTEPS_SYNC_DEADLINE_S``, core/engine.py), a step-watchdog stall —
+arrives as :meth:`ElasticMembership.on_failure` with an *empty* stale
+set and becomes a :meth:`reconcile`: a rendezvous over the CURRENT
+world at the next epoch.  Members parked in a step sync are released
+with ``reconcile=True`` and join it; whoever is wedged-dead never
+hellos and is dropped by the rendezvous timeout.  The bus turns
+"something is stuck" into "exactly who is gone".
 """
 
 from __future__ import annotations
@@ -64,6 +86,7 @@ import socket
 import struct
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..common import flight_recorder as _flight
@@ -74,8 +97,24 @@ from ..common.telemetry import counters
 __all__ = [
     "MembershipView", "ElasticMembership", "WorldChanged", "Evicted",
     "MembershipTimeout", "current_epoch", "advance_epoch", "set_epoch",
-    "resolve_bus_addr", "bus_request",
+    "resolve_bus_addr", "bus_request", "active_membership",
 ]
+
+
+# The process's started ElasticMembership (weak: stop()/GC must not be
+# blocked by observability readers).  cluster_metrics / the obs endpoint
+# / the injector's kill:site=coordinator predicate read the CURRENT view
+# through this instead of re-deriving a stale env-derived address.
+_active_ref: Optional["weakref.ref[ElasticMembership]"] = None
+
+
+def active_membership() -> Optional["ElasticMembership"]:
+    """The live :class:`ElasticMembership` of this process, if one was
+    started (None otherwise) — the handle observability callers use to
+    re-resolve the bus from the current view after a coordinator
+    change."""
+    ref = _active_ref
+    return ref() if ref is not None else None
 
 
 # -- the process-wide membership epoch --------------------------------------
@@ -248,19 +287,46 @@ def _recv_obj(sock: socket.socket) -> Any:
         raise _BusFrameError(f"bus frame failed to unpickle: {e}") from None
 
 
-def resolve_bus_addr(bus: Optional[str] = None) -> Tuple[str, int]:
-    """``host:port`` of the membership bus — explicit arg, or the same
-    DMLC-root + BYTEPS_MEMBERSHIP_PORT resolution
-    :class:`ElasticMembership` uses."""
+def _membership_host_map() -> List[Tuple[str, Optional[int]]]:
+    """BYTEPS_MEMBERSHIP_HOSTS parsed into per-rank ``(host, port)``
+    entries (port None = use the default membership port).  Empty list
+    when unset — the single-fixed-address deployments."""
+    from ..common.config import get_config
+    out: List[Tuple[str, Optional[int]]] = []
+    for entry in get_config().membership_hosts.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" in entry:
+            host, port_s = entry.rsplit(":", 1)
+            out.append((host, int(port_s)))
+        else:
+            out.append((entry, None))
+    return out
+
+
+def resolve_bus_addr(bus: Optional[str] = None,
+                     view: Optional[MembershipView] = None) -> Tuple[str, int]:
+    """``host:port`` of the membership bus — explicit arg, or resolved
+    **from the view**: with ``BYTEPS_MEMBERSHIP_HOSTS`` set, the bus
+    lives at the CURRENT coordinator's entry (so a coordinator failover
+    moves the address with the coordinator); otherwise the static
+    DMLC-root + BYTEPS_MEMBERSHIP_PORT resolution (single host: the
+    successor re-binds the same address)."""
     from ..common.config import get_config
     if bus is not None:
         host, port_s = bus.rsplit(":", 1)
         return host, int(port_s)
     cfg = get_config()
-    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
     port = cfg.membership_port or (
         int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 2)
-    return host, port
+    if view is not None and view.world:
+        hosts = _membership_host_map()
+        coord = min(view.world)
+        if hosts and coord < len(hosts):
+            host, entry_port = hosts[coord]
+            return host, (entry_port if entry_port is not None else port)
+    return os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"), port
 
 
 def bus_request(addr: Tuple[str, int], msg: dict,
@@ -299,11 +365,21 @@ class _BusServer:
     wakes everyone and each waiter re-evaluates its own predicate —
     the same pop-time re-evaluation discipline as the server engine's
     PriorityQueue.
+
+    ``seed`` is a replica snapshot from a dead predecessor
+    (:meth:`_replica_snapshot`): a bus born from one resumes at the
+    replicated epoch/world with the replicated sync rounds,
+    parked-joiner set, and metrics cache — the failover is a
+    resumption, not a restart.  A stale seed (older epoch than the
+    view being hosted) is ignored.
     """
 
     def __init__(self, addr: Tuple[str, int], view: MembershipView,
-                 rendezvous_timeout_s: float, sync_timeout_s: float):
+                 rendezvous_timeout_s: float, sync_timeout_s: float,
+                 seed: Optional[dict] = None,
+                 host_rank: Optional[int] = None):
         self.addr = addr
+        self.host_rank = host_rank
         self.epoch = view.epoch
         self.world: Set[int] = set(view.world)
         self._rdv_timeout = rendezvous_timeout_s
@@ -322,6 +398,23 @@ class _BusServer:
         # every sync (and may metrics_put explicitly); the metrics verb
         # answers from here in one round-trip (core/api.cluster_metrics)
         self._metrics: Dict[int, Tuple[float, Any]] = {}
+        if seed and seed.get("epoch", -1) >= view.epoch:
+            self.epoch = int(seed["epoch"])
+            self.world = set(int(r) for r in (seed.get("world")
+                                              or view.world))
+            self._sync = {tuple(k): dict(v)
+                          for k, v in (seed.get("sync") or {}).items()}
+            # parked joiners re-park as None: their connections died with
+            # the predecessor, but the ADMISSION intent survives — the
+            # next sync reply advertises join_waiting and the retried
+            # rejoin request lands on an already-armed bus.  (State
+            # snapshots are deliberately not replicated — see
+            # _replica_snapshot — so admission waits for the successor's
+            # first state-carrying quorum.)
+            self._join_wait = {int(r): None
+                               for r in (seed.get("join_wait") or ())}
+            self._metrics = {int(r): tuple(v)
+                             for r, v in (seed.get("metrics") or {}).items()}
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -344,6 +437,34 @@ class _BusServer:
     def view(self) -> MembershipView:
         with self._cv:
             return MembershipView(self.epoch, tuple(sorted(self.world)))
+
+    # -- replication -------------------------------------------------------
+
+    def _standby_rank(self) -> Optional[int]:
+        """The next-lowest live rank — the replica target (caller holds
+        the condition)."""
+        w = sorted(self.world)
+        return w[1] if len(w) > 1 else None
+
+    def _replica_snapshot(self) -> dict:
+        """Everything a successor needs to resume this bus (caller holds
+        the condition) — a few KB of sync digests, the parked-joiner
+        set, and the metrics cache.  Deliberately NOT the packed
+        rejoin-state payloads (``_snapshots``): a whole model's
+        parameters riding every standby reply would make a large state
+        trip ``BYTEPS_BUS_MAX_FRAME`` and fail the healthy standby's
+        step sync.  The successor instead re-advertises ``join_waiting``
+        from the replicated park set and re-collects state at its next
+        state-carrying quorum — the admission moves one boundary later,
+        nothing is lost."""
+        return {
+            "epoch": self.epoch,
+            "world": sorted(self.world),
+            "sync": {k: dict(v) for k, v in self._sync.items()},
+            "join_wait": sorted(r for r, v in self._join_wait.items()
+                                if v is None),
+            "metrics": dict(self._metrics),
+        }
 
     # -- serving -----------------------------------------------------------
 
@@ -374,8 +495,23 @@ class _BusServer:
                 reply = self._do_metrics_put(msg)
             elif op == "metrics":
                 reply = self._do_metrics()
+            elif op == "replicate":
+                reply = self._do_replicate()
+            elif op == "ping":
+                reply = self._do_ping()
             else:
                 reply = {"ok": False, "error": f"unknown op {op!r}"}
+            # replication piggyback: every reply to the STANDBY carries a
+            # state snapshot — the standby pays one extra payload on
+            # traffic it already sends, and the coordinator never opens a
+            # connection of its own (no extra round trips, no push path
+            # to keep alive)
+            rank = msg.get("rank")
+            if rank is not None and op != "replicate":
+                with self._cv:
+                    if rank == self._standby_rank():
+                        reply = dict(reply)
+                        reply["replica"] = self._replica_snapshot()
             try:
                 _send_obj(conn, reply)
             except _BusFrameTooLarge as e:
@@ -402,6 +538,15 @@ class _BusServer:
         return {"ok": False, "stale": True, "epoch": self.epoch,
                 "world": sorted(self.world)}
 
+    def _pending_rendezvous(self) -> Optional[int]:
+        """The highest proposed epoch of an in-flight hello rendezvous
+        (caller holds the condition), or None.  Members parked in a sync
+        are released with ``reconcile=True`` so they JOIN the rendezvous
+        instead of waiting out their quorum — failure evidence propagates
+        through the bus faster than every member's own detector."""
+        pending = [e for e in self._hellos if e > self.epoch]
+        return max(pending) if pending else None
+
     # -- verb: sync (step barrier + payload all-gather + join admission) ---
 
     def _do_sync(self, msg: dict) -> dict:
@@ -415,6 +560,12 @@ class _BusServer:
                 self._metrics[rank] = (time.time(), msg["metrics"])
             if epoch != self.epoch:
                 return self._stale_reply()
+            pe = self._pending_rendezvous()
+            if pe is not None:
+                # a shrink/reconcile rendezvous is in flight: this round
+                # is doomed — tell the member to join the rendezvous now
+                return {"ok": False, "reconcile": True, "pending_epoch": pe,
+                        "epoch": self.epoch, "world": sorted(self.world)}
             key = (epoch, step)
             self._sync.setdefault(key, {})[rank] = msg.get("payload")
             if msg.get("state") is not None:
@@ -436,6 +587,11 @@ class _BusServer:
                     # round was parked: the payloads are void, retry the
                     # step at the new epoch
                     return self._stale_reply()
+                pe = self._pending_rendezvous()
+                if pe is not None:
+                    return {"ok": False, "reconcile": True,
+                            "pending_epoch": pe, "epoch": self.epoch,
+                            "world": sorted(self.world)}
                 got = self._sync.get(key, {})
                 joins_parked = any(v is None
                                    for v in self._join_wait.values())
@@ -531,7 +687,10 @@ class _BusServer:
         """Commit a shrink agreement (caller holds the condition)."""
         self.epoch = epoch
         self.world = set(world)
-        self._hellos.pop(epoch, None)
+        # drop THIS agreement's proposals and any stragglers for already-
+        # passed epochs — a lingering dead proposal would keep flagging
+        # reconcile on every future sync
+        self._hellos = {e: v for e, v in self._hellos.items() if e > epoch}
         # release every sync round parked under the dead epoch
         self._sync = {k: v for k, v in self._sync.items() if k[0] >= epoch}
         counters.inc("membership.shrink_agreed")
@@ -545,7 +704,14 @@ class _BusServer:
         rank = msg["rank"]
         deadline = time.monotonic() + self._sync_timeout
         with self._cv:
-            self._join_wait[rank] = None
+            # (re)park — but never clobber an admission that already
+            # landed: after a failover the seeded bus re-parks this
+            # joiner from the replica, and a state-carrying quorum can
+            # admit it BEFORE the retried rejoin reconnects.  The retry
+            # must collect that admission (the wait loop below returns
+            # it immediately), not overwrite it and stall the world on a
+            # member that is still parked.
+            self._join_wait.setdefault(rank, None)
             self._cv.notify_all()
             while not self._stop.is_set():
                 info = self._join_wait.get(rank)
@@ -572,15 +738,42 @@ class _BusServer:
     def _do_metrics(self) -> dict:
         """Every live rank's latest snapshot in one reply.  Ranks outside
         the current world are pruned (their cache entries are residue of
-        a shrink); age lets the caller judge freshness."""
+        a shrink); age lets the caller judge freshness.  The reply names
+        who hosts the control plane (coordinator / standby / the rank
+        actually serving this bus) so ``bps_top`` can show it."""
         now = time.time()
         with self._cv:
             self._metrics = {r: v for r, v in self._metrics.items()
                              if r in self.world}
             return {"ok": True, "epoch": self.epoch,
                     "world": sorted(self.world),
+                    "coordinator": min(self.world) if self.world else None,
+                    "standby": self._standby_rank(),
+                    "bus_rank": self.host_rank,
                     "ranks": {r: {"age_s": round(now - t, 3), "metrics": m}
                               for r, (t, m) in self._metrics.items()}}
+
+    # -- verbs: replicate / ping (coordinator-failover support) ------------
+
+    def _do_replicate(self) -> dict:
+        """Explicit replica pull: a rank that just BECAME the standby
+        (after a world change) bootstraps its copy instead of waiting for
+        the next piggybacked reply."""
+        with self._cv:
+            return {"ok": True, "epoch": self.epoch,
+                    "world": sorted(self.world),
+                    "replica": self._replica_snapshot()}
+
+    def _do_ping(self) -> dict:
+        """Cheap liveness + control-plane identity probe (used by
+        ``_ensure_bus`` to distinguish "someone already serves this
+        address" from "the world is busless", and by tooling)."""
+        with self._cv:
+            return {"ok": True, "epoch": self.epoch,
+                    "world": sorted(self.world),
+                    "coordinator": min(self.world) if self.world else None,
+                    "standby": self._standby_rank(),
+                    "bus_rank": self.host_rank}
 
 
 # -- the per-process membership object --------------------------------------
@@ -627,7 +820,8 @@ class ElasticMembership:
         if self.rank not in self._view.world:
             raise ValueError(f"rank {self.rank} not in world "
                              f"{list(self._view.world)}")
-        self.bus_addr = resolve_bus_addr(bus)
+        self._bus_arg = bus
+        self.bus_addr = resolve_bus_addr(bus, self._view)
         self.devices = devices
         self.assigner = assigner
         self.server_engine = server_engine
@@ -638,28 +832,49 @@ class ElasticMembership:
             if rendezvous_timeout_s is None else rendezvous_timeout_s)
         self.sync_timeout_s = (cfg.membership_sync_timeout_s
                                if sync_timeout_s is None else sync_timeout_s)
+        # The bus client must ride out a coordinator FAILOVER: detection
+        # (heartbeat timeout) + successor bind can span many short
+        # connect-refused attempts, so the attempt budget is raised well
+        # past the bootstrap default and the retry deadline is the real
+        # bound.
         self._retry = retry or RetryPolicy.from_config(
-            cfg, retry_on=(_BusUnreachable,))
+            cfg, retry_on=(_BusUnreachable,),
+            max_attempts=max(cfg.retry_max_attempts, 64))
         self._apply_lock = threading.Lock()
         self._ready_cv = threading.Condition()
         self._bus: Optional[_BusServer] = None
         # True once a sync reply advertised a parked joiner: the next
         # step_sync attaches the (expensive) state payload
         self._join_hint = False
+        # the latest replica snapshot piggybacked to this rank while it
+        # is the standby — the seed a failover bus resumes from
+        self._replica: Optional[dict] = None
+        # membership-managed heartbeat (host_heartbeat): rebuilt on every
+        # applied world change so the UDP server follows the coordinator
+        self._hb = None
+        self._hb_args: Optional[dict] = None
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "ElasticMembership":
         """Adopt the initial view; host the bus when this rank is the
         coordinator."""
+        global _active_ref
         set_epoch(self._view.epoch)
         self._ensure_bus(self._view)
+        _active_ref = weakref.ref(self)
         return self
 
     def stop(self) -> None:
+        global _active_ref
+        if self._hb is not None:
+            self._hb.stop()
+            self._hb = None
         if self._bus is not None:
             self._bus.close()
             self._bus = None
+        if _active_ref is not None and _active_ref() is self:
+            _active_ref = None
 
     def __enter__(self):
         return self.start()
@@ -674,44 +889,116 @@ class ElasticMembership:
     def is_coordinator(self) -> bool:
         return self.rank == self._view.coordinator
 
-    def _ensure_bus(self, view: MembershipView) -> None:
-        """Host the bus iff this rank is the coordinator of ``view``
-        and no bus is running here yet (idempotent; retried because a
-        just-dead predecessor's socket may linger in TIME_WAIT).
+    @property
+    def standby_rank(self) -> Optional[int]:
+        """The next-lowest live rank — who takes over the bus if the
+        coordinator dies (None in a solo world)."""
+        w = self._view.world
+        return w[1] if len(w) > 1 else None
 
-        A bind that stays refused is NOT fatal: after a coordinator
+    @property
+    def hosting_bus(self) -> bool:
+        """True when THIS process serves the membership bus."""
+        return self._bus is not None
+
+    @property
+    def heartbeat(self):
+        """The membership-managed :class:`HeartbeatMonitor`, if
+        :meth:`host_heartbeat` armed one (rebuilt per world change)."""
+        return self._hb
+
+    def _ensure_bus(self, view: MembershipView,
+                    prev_coordinator: Optional[int] = None) -> None:
+        """Re-resolve the bus address for ``view`` (view-aware on
+        multi-host, BYTEPS_MEMBERSHIP_HOSTS) and host the bus iff this
+        rank is the coordinator of ``view`` and no bus is running here
+        yet (idempotent; retried because a just-dead predecessor's
+        socket may linger in TIME_WAIT).
+
+        A new bus is **seeded with the replicated state** this rank
+        collected as the standby — a coordinator failover resumes the
+        mid-step sync round and the parked joiners instead of forgetting
+        them — and the takeover is recorded
+        (``membership.coordinator_failover`` counter + flight event).
+
+        A bind that stays refused is not necessarily fatal: after a
         failover the old minimum rank can rejoin a world whose bus a
-        surviving member already hosts at the fixed address — the
-        rejoiner must join as a client of that bus, not die on
-        EADDRINUSE after it was already admitted."""
+        surviving member already hosts at the fixed address — when the
+        address *answers a ping*, this rank joins as a client.  When it
+        does NOT answer, the world would be silently busless (every
+        future request doomed to time out), so the failure is loud:
+        counter + flight event + raise, letting the caller's escalation
+        path (shrink failure → restartable exit) take over."""
+        addr = resolve_bus_addr(self._bus_arg, view)
+        self.bus_addr = addr
         if self.rank != min(view.world) or self._bus is not None:
             return
+        if prev_coordinator is None:
+            prev_coordinator = self._view.coordinator
+        seed = self._replica
+
         def _bind():
-            return _BusServer(self.bus_addr, view,
+            return _BusServer(addr, view,
                               self.rendezvous_timeout_s,
-                              self.sync_timeout_s)
+                              self.sync_timeout_s,
+                              seed=seed, host_rank=self.rank)
         try:
             self._bus = RetryPolicy.from_config(
                 retry_on=(OSError,)).call(_bind,
                                           describe="membership bus bind")
-        except OSError:
+        except OSError as e:
+            try:
+                bus_request(addr, {"op": "ping"}, timeout=2.0)
+                served = True
+            except Exception:  # noqa: BLE001 — any failure means nobody
+                served = False  # is usefully serving that address
+            if served:
+                _flight.record("membership.bus_already_served",
+                               rank=self.rank, addr="%s:%d" % addr)
+                get_logger().warning(
+                    "membership: rank %d is the coordinator of %s but the "
+                    "bus address %s:%d is already served (coordinator "
+                    "failover kept it) — continuing as a bus client",
+                    self.rank, list(view.world), *addr)
+                return
+            counters.inc("membership.bus_bind_failed")
+            _flight.record("membership.bus_bind_failed", rank=self.rank,
+                           addr="%s:%d" % addr, error=str(e))
+            get_logger().error(
+                "membership: rank %d could not bind the bus at %s:%d and "
+                "nothing answers there — refusing to leave the world "
+                "busless: %s", self.rank, addr[0], addr[1], e)
+            raise
+        if prev_coordinator != self.rank:
+            counters.inc("membership.coordinator_failover")
+            _flight.record("membership.coordinator_failover",
+                           new_coordinator=self.rank,
+                           prev_coordinator=prev_coordinator,
+                           seeded=seed is not None,
+                           epoch=view.epoch, world=list(view.world))
             get_logger().warning(
-                "membership: rank %d is the coordinator of %s but the bus "
-                "address %s:%d is already served (coordinator failover "
-                "kept it) — continuing as a bus client",
-                self.rank, list(view.world), *self.bus_addr)
-            return
-        get_logger().info("membership: rank %d hosting the bus at %s:%d",
-                          self.rank, *self.bus_addr)
+                "membership: rank %d took over the bus at %s:%d from rank "
+                "%s (%s replica state)", self.rank, *addr, prev_coordinator,
+                "with" if seed is not None else "without")
+        else:
+            get_logger().info("membership: rank %d hosting the bus at "
+                              "%s:%d", self.rank, *addr)
 
     # -- bus client --------------------------------------------------------
 
-    def _request(self, msg: dict, timeout: float) -> dict:
+    def _request(self, msg: dict, timeout: float,
+                 retry: Optional[RetryPolicy] = None) -> dict:
         """One request/reply round trip.  Connection-level failures (the
         coordinator died; its successor is still binding) are retried
         with full-jitter backoff; a read that exceeds ``timeout`` is a
         :class:`MembershipTimeout` and is NOT retried — the server
-        answers its own timeouts explicitly."""
+        answers its own timeouts explicitly.  ``retry`` overrides the
+        default policy (the shrink path uses a rendezvous-bounded one so
+        a dead successor is escalated, not waited out).
+
+        Replica harvesting happens here: while this rank is the standby,
+        every reply carries a piggybacked ``replica`` snapshot — it is
+        stripped from the reply and cached as the failover seed."""
         def once():
             try:
                 s = socket.create_connection(self.bus_addr, timeout=3.0)
@@ -731,8 +1018,44 @@ class ElasticMembership:
                 raise _BusUnreachable(f"bus {self.bus_addr}: {e}") from None
             finally:
                 s.close()
-        return self._retry.call(once,
-                                describe=f"membership {msg.get('op')}")
+        reply = (retry or self._retry).call(
+            once, describe=f"membership {msg.get('op')}")
+        if isinstance(reply, dict) and "replica" in reply:
+            self._replica = reply.pop("replica")
+        return reply
+
+    def _discover_bus(self) -> bool:
+        """Multi-host rejoin helper: with BYTEPS_MEMBERSHIP_HOSTS set,
+        probe entries in rank order and point ``bus_addr`` at the first
+        one that answers a ping (the survivors' coordinator).  Returns
+        False (keeping the static resolution) when no map is configured
+        or nothing answers yet — the rejoin request's own backoff keeps
+        retrying the resolved address."""
+        _, default_port = resolve_bus_addr()   # the ONE port resolution
+        for host, port in _membership_host_map():
+            addr = (host, port if port is not None else default_port)
+            try:
+                if bus_request(addr, {"op": "ping"}, timeout=2.0).get("ok"):
+                    self.bus_addr = addr
+                    return True
+            except Exception:  # noqa: BLE001 — dead entry, try the next
+                continue
+        return False
+
+    def _pull_replica(self) -> bool:
+        """Best-effort explicit replica fetch (the ``replicate`` verb) —
+        run when this rank becomes the standby so the failover seed
+        exists even before the next piggybacked reply."""
+        try:
+            reply = bus_request(self.bus_addr,
+                                {"op": "replicate", "rank": self.rank},
+                                timeout=3.0)
+        except Exception:  # noqa: BLE001 — purely opportunistic
+            return False
+        if reply.get("ok") and reply.get("replica") is not None:
+            self._replica = reply["replica"]
+            return True
+        return False
 
     def _declared_order(self) -> List[str]:
         from ..core import api
@@ -768,6 +1091,101 @@ class ElasticMembership:
             return True
         except Exception:  # noqa: BLE001
             return False
+
+    # -- heartbeat re-hosting ----------------------------------------------
+
+    def host_heartbeat(self, interval: Optional[float] = None,
+                       timeout: Optional[float] = None,
+                       addr: Optional[str] = None,
+                       grace: Optional[float] = None,
+                       on_failure: Optional[Callable[[Set[int]], None]]
+                       = None):
+        """Arm membership-managed heartbeats: the CURRENT view's
+        coordinator hosts the UDP server, every member beats to it, and
+        after every applied world change the monitors are rebuilt for
+        the new view — the new coordinator re-hosts the server,
+        survivors re-point their beats, and the fired-once latch resets
+        so the failure AFTER the failover is detected too.
+
+        ``addr`` pins ``host:port`` (single-host deployments and tests);
+        otherwise the host follows the coordinator's
+        ``BYTEPS_MEMBERSHIP_HOSTS`` entry and the port is
+        ``BYTEPS_HEARTBEAT_PORT`` (DMLC_PS_ROOT_PORT + 1).
+        ``on_failure`` defaults to :meth:`on_failure` (shrink in
+        place).  Returns the first monitor."""
+        from ..common.config import get_config
+        cfg = get_config()
+        self._hb_args = {
+            "interval": (cfg.heartbeat_interval_s if interval is None
+                         else interval),
+            "timeout": (cfg.heartbeat_timeout_s if timeout is None
+                        else timeout),
+            "grace": grace,
+            "addr": addr,
+            "on_failure": on_failure or self.on_failure,
+        }
+        self._restart_heartbeat(self._view)
+        return self._hb
+
+    def _heartbeat_addr(self, view: MembershipView) -> Tuple[str, int]:
+        """The heartbeat endpoint for ``view``: host follows the
+        coordinator (BYTEPS_MEMBERSHIP_HOSTS when set), port from the
+        pinned ``addr`` or BYTEPS_HEARTBEAT_PORT."""
+        host = port = None
+        pinned = self._hb_args.get("addr") if self._hb_args else None
+        if pinned:
+            host, port_s = pinned.rsplit(":", 1)
+            port = int(port_s)
+        hosts = _membership_host_map()
+        if hosts and view.coordinator < len(hosts):
+            host = hosts[view.coordinator][0]
+        if host is None:
+            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        if port is None:
+            port = int(os.environ.get(
+                "BYTEPS_HEARTBEAT_PORT",
+                str(int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1)))
+        return host, port
+
+    def _restart_heartbeat(self, view: MembershipView) -> None:
+        """Rebuild the managed monitor for ``view`` (no-op unless
+        :meth:`host_heartbeat` armed one).  Safe to call from the OLD
+        monitor's own beat thread (the detector → shrink → apply path):
+        ``stop()`` skips joining the calling thread."""
+        if self._hb_args is None:
+            return
+        from ..utils.failure_detector import HeartbeatMonitor
+        old, self._hb = self._hb, None
+        if old is not None:
+            old.stop()
+        if view.num_workers < 2:
+            # a solo world has no peer to watch; a rejoin re-arms via the
+            # world-change path
+            _flight.record("membership.heartbeat_idle", epoch=view.epoch)
+            return
+        host, port = self._heartbeat_addr(view)
+        args = self._hb_args
+
+        def _arm():
+            return HeartbeatMonitor(
+                self.rank, coordinator=f"{host}:{port}",
+                interval=args["interval"], timeout=args["timeout"],
+                grace=args["grace"], on_failure=args["on_failure"],
+                ranks=view.world, server_rank=view.coordinator).start()
+        # the UDP bind races the predecessor server's teardown (a peer
+        # that has not applied the new view yet still holds the port);
+        # ride it out with a persistent bounded retry
+        self._hb = RetryPolicy.from_config(
+            retry_on=(OSError,), max_attempts=50, deadline_s=10.0).call(
+                _arm, describe="heartbeat rebind")
+        counters.inc("membership.heartbeat_rehosted")
+        _flight.record("membership.heartbeat_rehosted",
+                       server_rank=view.coordinator, epoch=view.epoch,
+                       addr=f"{host}:{port}")
+        get_logger().warning(
+            "membership: heartbeat re-hosted — rank %d serves %s:%d for "
+            "world %s (epoch %d)", view.coordinator, host, port,
+            list(view.world), view.epoch)
 
     # -- the step barrier / all-gather ------------------------------------
 
@@ -820,6 +1238,16 @@ class ElasticMembership:
                     f"{list(new.world)} (epoch {new.epoch})")
             self._maybe_apply(new)
             raise WorldChanged(new)
+        if reply.get("reconcile"):
+            # a shrink/reconcile rendezvous is already in flight on the
+            # bus: join it instead of waiting out a doomed quorum — this
+            # is how failure evidence reaches members whose own detector
+            # has not fired (or already spent its one firing)
+            get_logger().warning(
+                "membership: step %d sync found a pending rendezvous for "
+                "epoch %s — joining it", step, reply.get("pending_epoch"))
+            new = self.reconcile()
+            raise WorldChanged(new)
         if reply.get("timeout"):
             missing = set(reply.get("missing") or ())
             if missing:
@@ -834,24 +1262,43 @@ class ElasticMembership:
     # -- shrink ------------------------------------------------------------
 
     def on_failure(self, stale: Set[int]) -> None:
-        """``HeartbeatMonitor.on_failure`` action: shrink in place;
-        escalate to the restartable exit only when the shrink itself
+        """Failure-action entry point (``HeartbeatMonitor.on_failure``,
+        ``install_failure_action``): shrink in place when the evidence
+        names ranks; an EMPTY set is wedge evidence without a suspect
+        (a data-path deadline / step-watchdog trip,
+        ``failure_detector.data_path_stalled``) and becomes a
+        :meth:`reconcile` — the rendezvous identifies who is gone.
+        Escalate to the restartable exit only when the transition itself
         fails (launcher supervision is the outer loop, as with
         ``RecoveryCoordinator``)."""
         try:
-            self.shrink(stale)
+            if stale:
+                self.shrink(set(stale))
+            else:
+                self.reconcile()
         except Exception:  # noqa: BLE001 — end of the in-process line
             counters.inc("membership.shrink_failed")
             from ..utils.failure_detector import _failure_exit_code
             code = _failure_exit_code()
             get_logger().error(
-                "elastic shrink failed — exiting %d so the launcher can "
-                "restart", code, exc_info=True)
+                "elastic transition failed — exiting %d so the launcher "
+                "can restart", code, exc_info=True)
             _exit(code)
 
     def shrink(self, stale: Set[int]) -> MembershipView:
         """Drop ``stale`` ranks: epoch guard up → drain/suspend →
-        epoch-tagged rendezvous → resume at the survivor world."""
+        epoch-tagged rendezvous → resume at the survivor world.
+
+        Coordinator failover is part of the rendezvous: if the dead set
+        includes the old coordinator, the lowest surviving rank hosts
+        the bus (seeded with its standby replica) before helloing to
+        itself; everyone else's connect rides backoff until the new bus
+        is up.  If the would-be coordinator never serves the bus inside
+        the rendezvous window — it died too, mid-failover — it is
+        presumed dead, dropped from the proposal, and the ladder
+        descends until this rank either reaches a live bus or hosts one
+        itself.  (A presumed-dead rank that is merely slow self-heals:
+        its own hello marks it alive and the agreement re-admits it.)"""
         view = self._view
         stale = set(stale) & set(view.world)
         if not stale:
@@ -879,15 +1326,46 @@ class ElasticMembership:
         from ..core import api
         if api.initialized():
             api.suspend()
-        # Coordinator failover: if the dead set includes the old
-        # coordinator, the lowest surviving rank hosts the bus before
-        # helloing (to itself); everyone else's connect is retried with
-        # backoff until the new bus is up.
-        self._ensure_bus(MembershipView(view.epoch, proposed_world))
-        reply = self._request(
-            {"op": "hello", "rank": self.rank, "epoch": proposed_epoch,
-             "world": list(proposed_world)},
-            timeout=self.rendezvous_timeout_s + 15.0)
+        from ..common.config import get_config
+        while True:
+            self._ensure_bus(MembershipView(view.epoch, proposed_world),
+                             prev_coordinator=view.coordinator)
+            # bounded hello: the proposed coordinator gets one rendezvous
+            # window to serve the bus; past it, unreachability IS the
+            # evidence it died mid-failover
+            hello_retry = RetryPolicy.from_config(
+                get_config(), retry_on=(_BusUnreachable,),
+                max_attempts=64,
+                deadline_s=max(self.rendezvous_timeout_s, 2.0))
+            try:
+                reply = self._request(
+                    {"op": "hello", "rank": self.rank,
+                     "epoch": proposed_epoch,
+                     "world": list(proposed_world)},
+                    timeout=self.rendezvous_timeout_s + 15.0,
+                    retry=hello_retry)
+                break
+            except _BusUnreachable:
+                dead_coord = min(proposed_world)
+                if dead_coord == self.rank:
+                    # we host the bus ourselves and it is unreachable:
+                    # nothing left to escalate to
+                    raise
+                counters.inc("membership.coordinator_presumed_dead")
+                _flight.record("membership.coordinator_presumed_dead",
+                               rank=dead_coord,
+                               proposed_epoch=proposed_epoch)
+                get_logger().error(
+                    "membership: proposed coordinator %d never served the "
+                    "bus within the rendezvous window — presuming it dead "
+                    "too and escalating", dead_coord)
+                stale.add(dead_coord)
+                proposed_world = tuple(r for r in proposed_world
+                                       if r != dead_coord)
+                if self.rank not in proposed_world:
+                    raise Evicted(
+                        f"rank {self.rank} has no surviving world left "
+                        f"(every lower rank is unreachable)")
         agreed = MembershipView(reply["epoch"], tuple(reply["world"]))
         if self.rank not in agreed.world:
             raise Evicted(f"rank {self.rank} is outside the agreed world "
@@ -897,6 +1375,62 @@ class ElasticMembership:
             "membership: shrink complete in %.2fs — epoch %d, world %s",
             time.monotonic() - t0, out.epoch, list(out.world))
         return out
+
+    def reconcile(self) -> MembershipView:
+        """Failure evidence WITHOUT a named suspect (a data-path
+        deadline trip, a wedged collective): re-run the rendezvous over
+        the CURRENT world at the next epoch.  Every live member joins —
+        parked step_syncs are released with ``reconcile=True`` and hello
+        too — while a wedged-dead member never checks in and is dropped
+        by the rendezvous timeout.  If everyone answers (a transient
+        stall, a false alarm) the world re-agrees unchanged at the new
+        epoch and training continues.
+
+        The epoch guard goes up FIRST, so the wedged unit's eventual
+        result (if it ever lands) is dropped as stale; the engine itself
+        stays up through the rendezvous — suspending here would block on
+        the very unit that is wedged — and :meth:`_maybe_apply` performs
+        the bounded suspend/resume once the agreement is in hand.  Work
+        enqueued during the window is stamped with the proposed epoch
+        and rides the old mesh: harmless when the world re-agrees
+        unchanged, part of the same wedge when it does not."""
+        view = self._view
+        proposed_epoch = view.epoch + 1
+        if current_epoch() >= proposed_epoch:
+            # another thread (a detector shrink, a peer-driven apply) is
+            # already moving the world — follow it instead of competing
+            return self.wait_ready(
+                current_epoch(),
+                timeout=self.rendezvous_timeout_s + self.sync_timeout_s)
+        counters.inc("membership.reconcile_started")
+        _flight.record("membership.reconcile_started",
+                       epoch=proposed_epoch, world=list(view.world))
+        get_logger().error(
+            "membership: reconcile — re-agreeing world %s at epoch %d on "
+            "data-path failure evidence", list(view.world), proposed_epoch)
+        set_epoch(proposed_epoch)
+        try:
+            self._ensure_bus(view)
+            reply = self._request(
+                {"op": "hello", "rank": self.rank, "epoch": proposed_epoch,
+                 "world": list(view.world)},
+                timeout=self.rendezvous_timeout_s + 15.0)
+        except (_BusUnreachable, OSError):
+            # the bus itself is unreachable: the wedge evidence and the
+            # dead coordinator point at the same process — name it and
+            # take the shrink path (which owns the failover escalation)
+            coord = view.coordinator
+            if coord == self.rank:
+                raise
+            get_logger().error(
+                "membership: reconcile could not reach the bus — treating "
+                "coordinator %d as failed", coord)
+            return self.shrink({coord})
+        agreed = MembershipView(reply["epoch"], tuple(reply["world"]))
+        if self.rank not in agreed.world:
+            raise Evicted(f"rank {self.rank} is outside the agreed world "
+                          f"{list(agreed.world)}")
+        return self._maybe_apply(agreed)
 
     # -- applying an agreed view ------------------------------------------
 
@@ -936,7 +1470,17 @@ class ElasticMembership:
                 self.server_engine.set_membership_epoch(view.epoch)
             if self.kv_store is not None:
                 self.kv_store.set_membership_epoch(view.epoch)
-            self._ensure_bus(view)
+            self._ensure_bus(view, prev_coordinator=old.coordinator)
+            # heartbeat re-hosting: the UDP server follows the NEW
+            # coordinator and every survivor re-points its beats; fresh
+            # monitors also reset the fired-once latch, so "rank 0 down"
+            # leaves a world that still detects the NEXT failure
+            self._restart_heartbeat(view)
+            if self.rank == self.standby_rank:
+                # just became (or stayed) the standby of a changed world:
+                # bootstrap the replica now instead of waiting for the
+                # next piggybacked reply
+                self._pull_replica()
             counters.inc("membership.grow" if grew else "membership.shrink")
             _flight.record("membership.applied", epoch=view.epoch,
                            world=list(view.world), grew=grew)
@@ -1005,6 +1549,12 @@ class ElasticMembership:
         counters.inc("membership.rejoin_requested")
         t0 = time.monotonic()
         probe = cls(rank, [rank], bus, devices=devices, **kwargs)
+        if bus is None:
+            # a rejoiner does not know the current coordinator (its solo
+            # probe view resolves to its OWN host-map entry); with a host
+            # map configured, ping entries in rank order and park on the
+            # first bus that answers
+            probe._discover_bus()
         wait_s = probe.sync_timeout_s if timeout is None else timeout
         reply = probe._request({"op": "rejoin", "rank": int(rank)},
                                timeout=wait_s + 15.0)
@@ -1027,6 +1577,8 @@ class ElasticMembership:
         _flight.record("membership.rejoined", rank=int(rank),
                        epoch=view.epoch, world=list(view.world),
                        step=reply.get("step"))
+        global _active_ref
+        _active_ref = weakref.ref(probe)
         probe._record_span("rejoin", t0, view)
         get_logger().warning(
             "membership: rank %d rejoined at epoch %d, world %s, step %s",

@@ -13,16 +13,27 @@ core/api.py resume()).
 
 Two cooperating pieces:
 
-- :class:`HeartbeatMonitor` — rank 0 runs a tiny UDP server; every rank
-  (including 0) beats every ``interval``; the server's replies carry the
-  set of currently-stale ranks.  A rank that misses ``timeout`` seconds
-  of beats is reported to every survivor's ``on_failure``; a coordinator
-  that stops replying is itself reported as rank 0 down.
+- :class:`HeartbeatMonitor` — the ``server_rank`` member (default rank
+  0; elastic worlds re-point it to the CURRENT coordinator after every
+  world change, fault/membership.py ``host_heartbeat``) runs a tiny UDP
+  server; every member of ``ranks`` beats every ``interval``; the
+  server's replies carry the set of currently-stale ranks.  A rank that
+  misses ``timeout`` seconds of beats is reported to every survivor's
+  ``on_failure``; a server that stops replying is itself reported as
+  ``{server_rank}`` down — and once a client has heard the server at
+  least once, that detection is no longer gated by the startup ``grace``
+  (a coordinator killed mid-run is detected in ``timeout``, not
+  ``grace``, seconds).
 - :class:`StepWatchdog` — in-process: ``feed()`` every training step; a
-  step that exceeds ``timeout`` fires ``on_stall`` (default: log and
-  ``os._exit(BYTEPS_FAILURE_EXIT_CODE)``, default 17) — the escape hatch
+  step that exceeds ``timeout`` fires ``on_stall`` — the escape hatch
   for the wedged-collective case the heartbeat layer cannot see (process
-  alive, thread stuck).
+  alive, thread stuck).  The default stall action is
+  :func:`data_path_stalled`: the evidence goes to the *installed
+  failure action* (an elastic shrink/reconcile) first, and ``os._exit``
+  with ``BYTEPS_FAILURE_EXIT_CODE`` (default 17) is only the escalation
+  of last resort when nothing is installed.  The engine's per-unit sync
+  deadline (``BYTEPS_SYNC_DEADLINE_S``, core/engine.py) reports through
+  the same funnel.
 
 The default ``on_failure``/``on_stall`` exit code is restartable: the
 launchers' ``--restart`` supervision recognizes exactly it.  For
@@ -101,6 +112,36 @@ def _default_on_failure(stale: Set[int]) -> None:
     _exit(code)
 
 
+def data_path_stalled(gap_s: float, detail: str = "") -> None:
+    """Failure evidence from the DATA path: a sync unit
+    (``BYTEPS_SYNC_DEADLINE_S``, core/engine.py) or a whole step
+    (:class:`StepWatchdog`) made no progress inside its deadline — the
+    TPU failure mode where a dead peer wedges survivors inside a
+    collective without erroring them.
+
+    Routed to the installed failure action with an EMPTY stale set
+    ("something is wedged; no named suspect") —
+    ``ElasticMembership.on_failure`` turns that into a *reconcile*
+    rendezvous whose timeout identifies exactly who is gone
+    (fault/membership.py).  Without an installed action the restartable
+    ``os._exit`` remains the escalation of last resort: a wedged
+    collective cannot be cancelled in-process."""
+    from ..common import flight_recorder as _flight
+    _flight.record("failure_detector.data_path_stall",
+                   gap_s=round(gap_s, 3), detail=detail)
+    _flight.dump("data_path_stall")
+    action = _installed_action
+    if action is not None:
+        action(set())
+        return
+    code = _failure_exit_code()
+    get_logger().error(
+        "data path stalled for %.1fs (%s) and no in-process failure "
+        "action is installed — exiting %d so the launcher can restart",
+        gap_s, detail or "no detail", code)
+    _exit(code)
+
+
 class HeartbeatMonitor:
     """Out-of-band liveness over UDP.
 
@@ -118,13 +159,27 @@ class HeartbeatMonitor:
     interval / timeout: beat period and staleness threshold (seconds).
     on_failure: called ONCE with the set of stale ranks; defaults to
         log + os._exit(BYTEPS_FAILURE_EXIT_CODE) (default 17).
+    ranks: explicit member-rank set (elastic worlds keep ORIGINAL rank
+        numbers after a shrink, e.g. {1, 2}); default ``range(num_ranks)``.
+    server_rank: the member hosting the UDP server (default 0 for the
+        static-world behavior); ``ElasticMembership.host_heartbeat``
+        re-creates monitors with ``server_rank = view.coordinator`` after
+        every world change, so the heartbeat plane is never pinned to a
+        rank that is no longer in the world.
     """
 
-    def __init__(self, rank: int, num_ranks: int,
+    def __init__(self, rank: int, num_ranks: Optional[int] = None,
                  coordinator: Optional[str] = None,
                  interval: float = 1.0, timeout: float = 10.0,
                  on_failure: Callable[[Set[int]], None] = _default_on_failure,
-                 grace: Optional[float] = None):
+                 grace: Optional[float] = None,
+                 ranks: Optional[Set[int]] = None,
+                 server_rank: int = 0):
+        if ranks is None:
+            if num_ranks is None:
+                raise ValueError(
+                    "HeartbeatMonitor needs num_ranks or an explicit ranks set")
+            ranks = range(num_ranks)
         if coordinator is None:
             host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
             port = int(os.environ.get(
@@ -134,7 +189,9 @@ class HeartbeatMonitor:
             host, port_s = coordinator.rsplit(":", 1)
             port = int(port_s)
         self.rank = rank
-        self.num_ranks = num_ranks
+        self.ranks = frozenset(int(r) for r in ranks)
+        self.num_ranks = len(self.ranks)
+        self.server_rank = int(server_rank)
         self.addr = (host, port)
         self.interval = interval
         self.timeout = timeout
@@ -147,16 +204,19 @@ class HeartbeatMonitor:
         self._lock = threading.Lock()
         self._threads = []
         self._sock: Optional[socket.socket] = None
-        # server state (rank 0 only)
+        # server state (server_rank only)
         self._last_seen = {}
         self._started = time.monotonic()
-        # client state
+        # client state; _got_reply releases the grace gate on server-down
+        # detection (a server we have HEARD once is dead, not late, when
+        # it goes silent)
         self._last_reply = time.monotonic()
+        self._got_reply = False
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "HeartbeatMonitor":
-        if self.rank == 0:
+        if self.rank == self.server_rank:
             self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             self._sock.bind(self.addr)
             self._sock.settimeout(0.25)
@@ -189,6 +249,22 @@ class HeartbeatMonitor:
 
     # -- internals ---------------------------------------------------------
 
+    def wait_server(self, timeout: float = 60.0) -> bool:
+        """Liveness bootstrap barrier: block until this monitor has
+        heard ITS server reply at least once (the server's own monitor
+        hears itself).  Before that first reply, this rank is invisible
+        to the server — a death in the window would hide behind the
+        never-beat startup grace.  Chaos workers (and any run that wants
+        detection armed before work starts) call this after
+        ``start()``; returns False on timeout/stop instead of raising —
+        liveness bootstrap is advisory, not load-bearing."""
+        deadline = time.monotonic() + timeout
+        while not self._got_reply and not self._stop.is_set():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(0.02, self.interval))
+        return self._got_reply
+
     def last_beat_age(self) -> float:
         """Seconds since this rank last heard a healthy reply from the
         heartbeat endpoint — the ``/healthz`` liveness figure
@@ -210,7 +286,7 @@ class HeartbeatMonitor:
     def _stale_ranks(self) -> Set[int]:
         now = time.monotonic()
         stale = set()
-        for r in range(self.num_ranks):
+        for r in sorted(self.ranks):
             seen = self._last_seen.get(r)
             if seen is None:
                 if now - self._started > self.grace:
@@ -220,7 +296,7 @@ class HeartbeatMonitor:
         return stale
 
     def _serve(self) -> None:
-        """Rank 0: receive beats, answer with the stale set."""
+        """Server rank: receive beats, answer with the stale set."""
         while not self._stop.is_set():
             try:
                 data, addr = self._sock.recvfrom(512)
@@ -234,11 +310,19 @@ class HeartbeatMonitor:
                 r = int(data[len(_MAGIC):])
             except ValueError:
                 continue
-            if 0 <= r < self.num_ranks:
+            if r in self.ranks:
                 self._last_seen[r] = time.monotonic()
             try:
+                # the reply names WHO is serving: during a heartbeat
+                # re-hosting, a client pointed at the NEW server must not
+                # credit a reply from the predecessor incarnation still
+                # draining on the same port — hearing the old server once
+                # would release the grace gate and turn the predecessor's
+                # own shutdown into a phantom "new server dead" detection
                 self._sock.sendto(
-                    _MAGIC + json.dumps(sorted(self._stale_ranks())).encode(),
+                    _MAGIC + json.dumps(
+                        {"server": self.rank,
+                         "stale": sorted(self._stale_ranks())}).encode(),
                     addr)
             except OSError:
                 pass
@@ -248,9 +332,10 @@ class HeartbeatMonitor:
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         sock.settimeout(self.interval)
         # size the reply buffer for the worst case (every rank stale,
-        # ~7 chars each): a truncated datagram would otherwise kill this
-        # thread at exactly the moment it matters
-        bufsize = max(512, len(_MAGIC) + 8 * self.num_ranks + 16)
+        # ~7 chars each, plus the server-identity envelope): a truncated
+        # datagram would otherwise kill this thread at exactly the
+        # moment it matters
+        bufsize = max(512, len(_MAGIC) + 8 * self.num_ranks + 64)
         self._last_reply = time.monotonic()
         while not self._stop.is_set():
             try:
@@ -263,25 +348,36 @@ class HeartbeatMonitor:
                 data, _ = sock.recvfrom(bufsize)
                 if data.startswith(_MAGIC):
                     try:
-                        stale = set(json.loads(data[len(_MAGIC):]))
+                        reply = json.loads(data[len(_MAGIC):])
                     except ValueError:
-                        stale = None  # corrupt/truncated reply: not fatal
-                    if stale is not None:
+                        reply = None  # corrupt/truncated reply: not fatal
+                    if (isinstance(reply, dict)
+                            and reply.get("server") == self.server_rank):
+                        # a reply from any OTHER server identity (a
+                        # predecessor incarnation draining on the same
+                        # port during a re-hosting) is ignored: crediting
+                        # it would arm the grace-release latch against
+                        # the wrong server's lifetime
+                        stale = set(reply.get("stale") or ())
                         self._last_reply = time.monotonic()
+                        self._got_reply = True
                         stale.discard(self.rank)  # self = clock skew
                         if stale:
                             self._fire(stale)
                             return
             except (socket.timeout, OSError):
                 pass
-            # a silent coordinator is itself a failure — but only after
-            # the grace window, so a coordinator that starts later than
-            # this rank (the skew grace exists for) is not a false alarm
+            # a silent server is itself a failure — gated by the grace
+            # window only until the FIRST reply (a server that starts
+            # later than this rank is not a false alarm; a server we
+            # have heard once and that then goes silent is dead, and
+            # must be detected in `timeout`, not `grace`, seconds)
             now = time.monotonic()
-            if (self.rank != 0
+            if (self.rank != self.server_rank
                     and now - self._last_reply > self.timeout
-                    and now - self._started > self.grace):
-                self._fire({0})
+                    and (self._got_reply
+                         or now - self._started > self.grace)):
+                self._fire({self.server_rank})
                 return
             self._stop.wait(self.interval)
         sock.close()
@@ -289,9 +385,12 @@ class HeartbeatMonitor:
 
 class StepWatchdog:
     """In-process stall detector: ``feed()`` each step; a gap longer than
-    ``timeout`` fires ``on_stall`` (default log + os._exit(17)) — the
-    escape hatch for a collective wedged on a peer the heartbeat layer
-    still sees as alive (process up, chip blocked)."""
+    ``timeout`` fires ``on_stall`` — the escape hatch for a collective
+    wedged on a peer the heartbeat layer still sees as alive (process up,
+    chip blocked).  The default action is :func:`data_path_stalled`: an
+    installed elastic failure action gets the evidence (and shrinks or
+    reconciles in place); ``os._exit`` only when nobody in-process can
+    act on it."""
 
     def __init__(self, timeout: float = 600.0,
                  on_stall: Optional[Callable[[float], None]] = None):
@@ -305,11 +404,8 @@ class StepWatchdog:
 
     @staticmethod
     def _default(gap: float) -> None:
-        code = _failure_exit_code()
-        get_logger().error(
-            "step watchdog: no progress for %.1fs — exiting %d so the "
-            "launcher can restart", gap, code)
-        _exit(code)
+        get_logger().error("step watchdog: no progress for %.1fs", gap)
+        data_path_stalled(gap, detail="step watchdog")
 
     def start(self) -> "StepWatchdog":
         self._last = time.monotonic()

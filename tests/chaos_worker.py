@@ -72,6 +72,15 @@ def main() -> int:
         grace=60.0,
         on_failure=(coordinator.on_failure if rank == 0 else
                     lambda stale: None)).start()
+    # Liveness bootstrap barrier: do not start (killable) training until
+    # the server has seen this rank beat.  The survivor's startup is
+    # seconds slower than the victim's (orbax CheckpointManager
+    # construction); without the barrier the victim can beat and die
+    # entirely BEFORE the server exists, landing in the never-seen
+    # startup-grace shadow where its death is invisible.
+    if not mon.wait_server(60.0):
+        print("NO-HEARTBEAT-SERVER", flush=True)
+        return 7
     print("START", rank, flush=True)
 
     # Each step's push_pull adds exactly 1.0 to every element (single

@@ -18,18 +18,30 @@ Scenarios, driven by env:
 
 - **victim**: ``BYTEPS_FAULT_SPEC=kill:rank=R:step=K`` makes the
   injector kill this process at its K-th push — mid-train, no cleanup.
+  ``kill:site=coordinator:step=K`` kills whichever process hosts the
+  membership control plane at its K-th push (ISSUE 8 coordinator lanes).
 - **survivor**: heartbeat detects, ``ElasticMembership.on_failure``
   shrinks in place; the worker keeps stepping to the final step and
-  prints ``FINAL <epoch> <world> <w[0]>``.
+  prints ``FINAL <epoch> <world> <w[0]>``.  Heartbeats are
+  membership-managed (``host_heartbeat``): the UDP server follows the
+  coordinator through world changes, so killing rank 0 leaves a world
+  that still detects the next failure.
 - **die-on-detect** (``BYTEPS_ELASTIC_DIE_ON_DETECT=1``): exits the
   moment its detector fires — manufactures a double failure *during*
-  the survivors' shrink window.
+  the survivors' shrink window (or, when the victim is the coordinator,
+  the standby dying mid-failover).
 - **rejoiner** (``BYTEPS_ELASTIC_REJOIN=1``): comes up fresh, parks on
   the bus, and resumes from the survivor-broadcast epoch/keys/params.
 - **stale probes** (``BYTEPS_ELASTIC_STALE_PROBE=1``): after training,
   deterministically manufactures a stale-epoch chunk (pause dispatch →
   enqueue → advance epoch → resume) and a stale-epoch server push, and
   asserts both are dropped, not delivered/summed.
+- **wedge** (``BYTEPS_ELASTIC_WEDGE_STEP=K`` [+ ``_WEDGE_S``]): at step
+  K this rank's engine sync blocks for WEDGE_S seconds — the simulated
+  wedged collective.  With ``BYTEPS_SYNC_DEADLINE_S`` armed the engine
+  deadline fires, the installed failure action runs a membership
+  *reconcile* (never ``os._exit``), and training continues; the worker
+  prints ``DEADLINE-TRIPS``/``RECONCILES`` counters before FINAL.
 """
 
 from __future__ import annotations
@@ -113,6 +125,8 @@ def main() -> int:
     sleep_s = float(os.environ.get("BYTEPS_ELASTIC_STEP_SLEEP", "0.05"))
     rejoining = os.environ.get("BYTEPS_ELASTIC_REJOIN", "") == "1"
     die_on_detect = os.environ.get("BYTEPS_ELASTIC_DIE_ON_DETECT", "") == "1"
+    wedge_step = int(os.environ.get("BYTEPS_ELASTIC_WEDGE_STEP", "0"))
+    wedge_s = float(os.environ.get("BYTEPS_ELASTIC_WEDGE_S", "4"))
 
     import jax
 
@@ -122,17 +136,15 @@ def main() -> int:
     from byteps_tpu.fault import membership as mm
     from byteps_tpu.fault.membership import (ElasticMembership,
                                              MembershipTimeout, WorldChanged)
-    from byteps_tpu.utils.failure_detector import HeartbeatMonitor
+    from byteps_tpu.utils.failure_detector import install_failure_action
 
-    mon = None
     if rejoining:
         # fresh process: park on the bus, adopt epoch/keys/params from a
-        # survivor, resume mid-run (no heartbeat: the old monitors are
-        # inert after their one firing and a new one sized for the grown
-        # world would false-positive on itself)
+        # survivor, resume mid-run
         m, step0, state = ElasticMembership.rejoin(rank, bus)
         w = np.asarray(state["w"], np.float32)
         start_step = int(step0) + 1
+        on_failure = m.on_failure
         print("REJOINED", mm.current_epoch(),
               ",".join(map(str, m.view().world)), step0, flush=True)
     else:
@@ -145,11 +157,16 @@ def main() -> int:
                 os._exit(1)
         else:
             on_failure = m.on_failure
-        if hb_port:
-            mon = HeartbeatMonitor(
-                rank, len(world), "127.0.0.1:" + hb_port,
-                interval=0.08, timeout=0.7, grace=60.0,
-                on_failure=on_failure).start()
+    # route every default failure path (heartbeat, step watchdog, the
+    # engine's sync deadline) through the elastic layer
+    install_failure_action(on_failure)
+    if hb_port:
+        # membership-managed heartbeats: the UDP server follows the
+        # coordinator through every world change (ISSUE 8) — the fixed
+        # 127.0.0.1 endpoint pins only host:port, not WHO serves it
+        m.host_heartbeat(interval=0.08, timeout=0.7, grace=60.0,
+                         addr="127.0.0.1:" + hb_port,
+                         on_failure=on_failure)
     # observability plane (test_observability.py): announce the obs
     # endpoint's resolved port when BYTEPS_OBS_PORT armed one — the
     # server outlives suspend/resume, so the port stays valid across
@@ -161,12 +178,27 @@ def main() -> int:
 
     step = start_step
     retries = 0
+    wedged = False
     while step <= n_steps:
         if retries > 200:   # a real wedge must fail loudly, not spin
             print("RETRY-BUDGET-EXHAUSTED at", step, flush=True)
             return 6
         try:
             eng = api._require()
+            if wedge_step and step == wedge_step and not wedged:
+                # simulated wedged collective: the NEXT unit the syncer
+                # retires blocks wedge_s seconds inside the engine's
+                # block hook (one-shot; restores itself).  The sync
+                # deadline must fire and route through reconcile.
+                wedged = True
+                orig = eng._block
+
+                def _wedge_once(x, _orig=orig, _eng=eng):
+                    _eng._block = _orig
+                    print("WEDGING", rank, flush=True)
+                    time.sleep(wedge_s)
+                    return _orig(x)
+                eng._block = _wedge_once
             red = np.asarray(eng.push_pull_local(_grad(rank), "grad",
                                                  op="sum"))
         except RuntimeError:
@@ -198,12 +230,16 @@ def main() -> int:
     rc = 0
     if os.environ.get("BYTEPS_ELASTIC_STALE_PROBE", "") == "1":
         rc = _stale_probes(api, mm)
+    if wedge_step:
+        from byteps_tpu.common.telemetry import counters as _counters
+        print("DEADLINE-TRIPS", _counters.get("engine.sync_deadline_trips"),
+              "RECONCILES", _counters.get("membership.reconcile_started"),
+              flush=True)
     view = m.view()
     print("FINAL", view.epoch, ",".join(map(str, view.world)),
           repr(float(w[0])), flush=True)
-    if mon is not None:
-        mon.stop()
-    m.stop()
+    install_failure_action(None)
+    m.stop()   # stops the managed heartbeat too
     api.shutdown()
     return rc
 

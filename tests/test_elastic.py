@@ -193,9 +193,13 @@ def test_rejoin_in_place_at_step_boundary():
 @pytest.mark.chaos
 def test_double_failure_during_shrink():
     """Rank 1 is killed mid-train; rank 2 dies the moment its detector
-    fires (inside the shrink window, before its rendezvous hello).  The
-    rendezvous times rank 2 out and rank 0 completes training alone at
-    epoch 1, world {0}."""
+    fires (inside the shrink window).  Rank 0 completes training alone
+    at world {0} with the exact expected state.  Epoch count is a race,
+    not a contract: usually the rendezvous times rank 2 out and one
+    bump suffices, but rank 2's parked sync can be released into the
+    rendezvous (reconcile join) just before it dies — it then makes the
+    epoch-1 agreement and costs rank 0 one more (equally correct)
+    shrink round to drop it."""
     n, kill_at = 9, 4
     bus, hb = str(_free_port()), str(_free_port())
     procs = {
@@ -211,7 +215,7 @@ def test_double_failure_during_shrink():
     assert "DIED-ON-DETECT" in outs[2], outs[2][-3000:]
     assert procs[0].returncode == 0, outs[0][-3000:]
     epoch, world, w0 = _final(outs[0])
-    assert epoch == 1 and world == "0", (epoch, world)
+    assert epoch >= 1 and world == "0", (epoch, world)
     expected = _simulate(_simulate(0.0, (0, 1, 2), kill_at - 1),
                          (0,), n - kill_at + 1)
     assert w0 == pytest.approx(expected, abs=1e-5), (w0, expected)

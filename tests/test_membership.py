@@ -140,14 +140,24 @@ def test_bus_hello_agreement_and_stale_sync_release():
         for h in hs:
             h.join(timeout=20)
         for r in (0, 2):
-            assert out[r] == {"ok": True, "epoch": 1, "world": [0, 2]}, out
-        # the parked sync was released as stale with the NEW view
+            assert out[r]["ok"], out
+            assert out[r]["epoch"] == 1 and out[r]["world"] == [0, 2], out
+        # rank 2 is the standby of the agreed world {0, 2}: its reply
+        # carries the piggybacked replica snapshot (ISSUE 8)
+        assert "replica" not in out[0]
+        assert out[2]["replica"]["epoch"] == 1
+        assert out[2]["replica"]["world"] == [0, 2]
+        # the parked sync was released for the new world: as stale (the
+        # agreement already landed) or told to JOIN the rendezvous
+        # (reconcile=True while the hellos were still pending) — either
+        # way the member retries at the agreed view
         t.join(timeout=20)
-        assert parked["r"]["stale"] and parked["r"]["epoch"] == 1
+        assert parked["r"].get("stale") or parked["r"].get("reconcile"), \
+            parked
         assert bus.view() == MembershipView(1, (0, 2))
         # a straggler's hello for the already-agreed epoch just gets the
         # current view (idempotent)
-        late = _req(port, {"op": "hello", "rank": 2, "epoch": 1,
+        late = _req(port, {"op": "hello", "rank": 0, "epoch": 1,
                            "world": [0, 2]})
         assert late == {"ok": True, "epoch": 1, "world": [0, 2]}
         assert counters.get("membership.shrink_agreed") >= 1

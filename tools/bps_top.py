@@ -73,11 +73,19 @@ def render(cluster: dict) -> str:
     for rank in sorted(cluster.get("ranks", {})):
         rows.append(_rank_row(rank, cluster["ranks"][rank]))
     widths = [max(len(r[i]) for r in rows) for i in range(len(_COLUMNS))]
+    head = "byteps_tpu cluster — epoch %s, world %s" % (
+        cluster.get("epoch"), cluster.get("world"))
+    if cluster.get("coordinator") is not None:
+        # who hosts the control plane, and who takes over if it dies
+        head += " — coordinator=%s standby=%s" % (
+            cluster.get("coordinator"), cluster.get("standby"))
+    if cluster.get("failover_in_progress"):
+        head += (" (COORDINATOR FAILOVER IN PROGRESS — bus not "
+                 "answering, local-only view)")
+    elif cluster.get("local_only"):
+        head += " (local-only view: no membership bus)"
     lines = [
-        "byteps_tpu cluster — epoch %s, world %s%s" % (
-            cluster.get("epoch"), cluster.get("world"),
-            " (local-only view: no membership bus)"
-            if cluster.get("local_only") else ""),
+        head,
         "  ".join(c.rjust(w) for c, w in zip(rows[0], widths)),
     ]
     for row in rows[1:]:

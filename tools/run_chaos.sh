@@ -12,13 +12,21 @@
 #      anything the in-process alarm cannot interrupt.
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
-#         lane: chaos (default) | integrity | obs | all
+#         lane: chaos (default) | integrity | obs | coordinator | all
 #         obs: the observability-under-chaos slice — every rank of a
 #              3-process chaos run serves /metrics//healthz, the
 #              membership bus answers cluster_metrics, and a
 #              chaos-killed worker leaves a flight-recorder dump whose
 #              tail holds the events leading into the kill
 #              (tests/test_observability.py)
+#         coordinator: kill-the-coordinator lanes — bus failover with
+#              replicated state (mid-step kill + rejoin through the
+#              successor bus), double failure during the failover
+#              (standby dies mid-rendezvous), heartbeat re-hosting, and
+#              the BYTEPS_SYNC_DEADLINE_S wedge→reconcile path
+#              (tests/test_coordinator_failover.py,
+#              tests/test_sync_deadline.py); all chaos-marked, so the
+#              `all` lane includes them too
 # Env:    CHAOS_TEST_TIMEOUT  per-test seconds   (default 120)
 #         CHAOS_LANE_TIMEOUT  whole-lane seconds (default 600)
 set -o pipefail
@@ -34,6 +42,9 @@ case "${1:-}" in
     chaos)     MARK="chaos"; shift ;;
     integrity) MARK="integrity"; shift ;;
     obs)       MARK="chaos"; KEXPR="flight_recorder or obs_cluster"; shift ;;
+    coordinator) MARK="chaos"
+                 KEXPR="coordinator or sync_deadline or reconcile"
+                 shift ;;
     all)       MARK="chaos or integrity"; shift ;;
 esac
 
