@@ -40,4 +40,11 @@ from byteps_tpu.core.api import (  # noqa: F401
     membership_epoch,
     metrics_snapshot,
     cluster_metrics,
+    start_serving,
+)
+from byteps_tpu.server import (  # noqa: F401
+    KVStore,
+    PullClient,
+    ServingPlane,
+    SnapshotStore,
 )

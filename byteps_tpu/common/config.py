@@ -200,6 +200,33 @@ class Config:
     #                                  barrier quorum window; a member
     #                                  missing past it is failure evidence
 
+    # --- parameter serving (server/serving.py, server/serve_client.py) ---
+    serve_replicas: int = 1          # BYTEPS_SERVE_REPLICAS: total shards
+    #                                  a hot key is readable from (primary
+    #                                  + N-1 replica mirrors); 1 = no
+    #                                  replication, every pull is
+    #                                  primary-served
+    serve_retention: int = 8         # BYTEPS_SERVE_RETENTION: snapshots
+    #                                  kept per SnapshotStore ring; a
+    #                                  client whose last snapshot_id aged
+    #                                  past retention falls back to a
+    #                                  full-snapshot pull
+    serve_hot_keys: int = 8          # BYTEPS_SERVE_HOT_KEYS: top-N keys
+    #                                  (by pull-count histogram) eligible
+    #                                  for replica mirroring; 0 disables
+    #                                  hotness tracking's replica rebuild
+    serve_max_staleness_s: float = 0.5
+    #                                  BYTEPS_SERVE_MAX_STALENESS: default
+    #                                  PullClient staleness bound —
+    #                                  cache younger than this serves
+    #                                  locally, older triggers a refresh
+    serve_cut_interval_s: float = 0.05
+    #                                  BYTEPS_SERVE_CUT_INTERVAL: minimum
+    #                                  seconds between write-triggered
+    #                                  snapshot cuts when a SnapshotStore
+    #                                  subscribes to its KVStore (0 = cut
+    #                                  on every consistent write point)
+
     # --- data integrity (common/integrity.py) ---
     integrity_on: bool = True        # BYTEPS_INTEGRITY: CRC32C-checksummed
     #                                  envelopes + non-finite quarantine on
@@ -342,6 +369,18 @@ class Config:
             raise ValueError("integrity_max_retransmits must be >= 0")
         if self.bus_max_frame <= 0:
             raise ValueError("bus_max_frame must be positive")
+        if self.serve_replicas < 1:
+            raise ValueError("serve_replicas must be >= 1 (1 = primary "
+                             "only, no replication)")
+        if self.serve_retention < 1:
+            raise ValueError("serve_retention must be >= 1 (at least the "
+                             "latest snapshot must stay pullable)")
+        if self.serve_hot_keys < 0:
+            raise ValueError("serve_hot_keys must be >= 0")
+        if self.serve_max_staleness_s < 0:
+            raise ValueError("serve_max_staleness_s must be >= 0")
+        if self.serve_cut_interval_s < 0:
+            raise ValueError("serve_cut_interval_s must be >= 0")
         if self.obs_port is not None and not 0 <= self.obs_port < 65536:
             raise ValueError("obs_port must be in 0..65535 (0 = ephemeral)")
         if self.flight_capacity <= 0:
@@ -401,6 +440,13 @@ class Config:
             failure_exit_code=_env_int("BYTEPS_FAILURE_EXIT_CODE", 17),
             sync_deadline_s=_env_float("BYTEPS_SYNC_DEADLINE_S", 0.0),
             membership_hosts=_env_str("BYTEPS_MEMBERSHIP_HOSTS", ""),
+            serve_replicas=_env_int("BYTEPS_SERVE_REPLICAS", 1),
+            serve_retention=_env_int("BYTEPS_SERVE_RETENTION", 8),
+            serve_hot_keys=_env_int("BYTEPS_SERVE_HOT_KEYS", 8),
+            serve_max_staleness_s=_env_float("BYTEPS_SERVE_MAX_STALENESS",
+                                             0.5),
+            serve_cut_interval_s=_env_float("BYTEPS_SERVE_CUT_INTERVAL",
+                                            0.05),
             integrity_on=_env_bool("BYTEPS_INTEGRITY", True),
             integrity_loopback=_env_bool("BYTEPS_INTEGRITY_LOOPBACK", True),
             integrity_max_retransmits=_env_int(

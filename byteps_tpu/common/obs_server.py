@@ -118,6 +118,8 @@ def debug_state() -> dict:
                            for c in _metrics.components("server_engine")],
         "kv_stores": [c.debug_state()
                       for c in _metrics.components("kv_store")],
+        "serving_planes": [c.debug_state()
+                           for c in _metrics.components("serving_plane")],
         "flight_recorder": {
             "enabled": _flight.recorder.enabled,
             "events": len(_flight.recorder),

@@ -304,6 +304,26 @@ def metrics_snapshot(light: bool = False) -> Dict[str, Any]:
     return snap
 
 
+def start_serving(store, **kwargs):
+    """Stand up the parameter-serving plane over ``store`` (a
+    :class:`~byteps_tpu.server.kv_store.KVStore`): versioned snapshots,
+    delta pulls, hot-key replicas (``server/serving.py``).  Keyword
+    arguments forward to :class:`~byteps_tpu.server.serving.ServingPlane`
+    (``replicas``, ``retention``, ``hot_keys``, ``cut_interval_s``);
+    defaults come from the ``BYTEPS_SERVE_*`` knobs — including
+    ``cut_interval_s`` from ``BYTEPS_SERVE_CUT_INTERVAL``, so a plane
+    started through this entry point is write-driven out of the box
+    (pass ``cut_interval_s=None`` explicitly for manual-``cut()``
+    publication, the :class:`ServingPlane` constructor's default).
+    Returns the plane; build consumers with
+    :class:`~byteps_tpu.server.serve_client.PullClient`.  Works with or
+    without a running engine — serving is a read plane, not a training
+    mode."""
+    from ..server.serving import ServingPlane
+    kwargs.setdefault("cut_interval_s", get_config().serve_cut_interval_s)
+    return ServingPlane(store, **kwargs)
+
+
 def cluster_metrics(bus: Optional[str] = None,
                     timeout: float = 10.0) -> Dict[str, Any]:
     """Every live rank's metrics snapshot in ONE round-trip to the

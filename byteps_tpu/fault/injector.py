@@ -47,6 +47,9 @@ Sites (where the hooks are woven):
 - ``kv_push`` — KVStore delta pushes (server/kv_store.py); ``bitflip``
   corrupts the wire frame, ``drop`` loses the *acknowledgement* after
   the delta applied (the duplicate-retry scenario the seq dedup absorbs)
+- ``serve_pull`` — the serving plane's pull-reply hop
+  (server/serving.py); ``bitflip`` corrupts a reply frame (NACKed and
+  retransmitted by the same envelope machine as pushes)
 - ``heartbeat`` — the heartbeat client's UDP send
   (utils/failure_detector.py); ``drop`` suppresses the datagram
 
@@ -96,10 +99,10 @@ _exit = os._exit
 
 VALID_KINDS = ("bitflip", "delay", "drop", "kill", "straggler")
 VALID_SITES = ("coordinator", "dcn", "dispatch", "heartbeat", "kv_push",
-               "server_pull", "server_push", "sync")
+               "serve_pull", "server_pull", "server_push", "sync")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
-CORRUPT_SITES = ("kv_push", "server_push")
+CORRUPT_SITES = ("kv_push", "serve_pull", "server_push")
 _FIELDS = ("rank", "step", "site", "p", "ms", "code")
 # fields each kind actually reads — anything else is rejected, not
 # silently ignored (kill:p=0.1 must fail loudly, not kill

@@ -1470,6 +1470,11 @@ class ElasticMembership:
                 self.server_engine.set_membership_epoch(view.epoch)
             if self.kv_store is not None:
                 self.kv_store.set_membership_epoch(view.epoch)
+            # serving plane: re-clamp replica endpoints + rebuild
+            # replica sets for the new world (a dead replica's hot keys
+            # degrade to primary reads; never an erroring read path)
+            from ..server import serving as _serving
+            _serving.notify_world_change(view)
             self._ensure_bus(view, prev_coordinator=old.coordinator)
             # heartbeat re-hosting: the UDP server follows the NEW
             # coordinator and every survivor re-points its beats; fresh

@@ -36,9 +36,10 @@ DCN collectives) is the natural extension and rides the same interface.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -49,6 +50,22 @@ from ..fault import injector as _fault
 from ..fault import membership as _membership
 from ..native import inplace_add, load as _native_load
 
+# /debug/state clamp: dedup_floors lists at most this many (key, worker)
+# entries — the WORST (lowest-floor) ones, the laggards a postmortem
+# cares about — plus a total count, so a many-key/many-worker run cannot
+# turn one debug scrape into a megabyte JSON document.
+DEBUG_FLOORS_MAX = 16
+
+
+def _copy_outside_lock(arr: np.ndarray) -> np.ndarray:
+    """The pull path's value copy, deliberately a module-level hook so
+    tests can prove the copy runs OUTSIDE the store lock (a slow pull of
+    a large key must not serialize concurrent pushes).  The reference
+    held by the caller is copy-on-write-protected: a concurrent push to
+    the same key replaces the stored array instead of mutating this one
+    in place, so the copy is torn-free even without the lock."""
+    return arr.copy()
+
 
 class KVStore:
     def __init__(self):
@@ -56,6 +73,17 @@ class KVStore:
         self._store: Dict[str, np.ndarray] = {}
         self._versions: Dict[str, int] = {}
         self._codecs: Dict[str, object] = {}
+        # copy-on-write marks: a key in this set has its stored array
+        # referenced outside the lock (a pull mid-copy or a serving
+        # snapshot); the NEXT push to it replaces the array with a fresh
+        # copy before summing, so the outstanding reference stays frozen
+        self._cow: set = set()
+        # write-subscription hook (server/serving.py SnapshotStore):
+        # callbacks fired OUTSIDE the lock after a version advances, at
+        # consistent points only (deferred to batch exit inside
+        # :meth:`write_batch`)
+        self._subs: List[Callable[[str, int], None]] = []
+        self._tls = threading.local()
         self.wire_bytes = 0         # compressed bytes that LANDED (summed)
         self.wire_bytes_wasted = 0  # retransmitted + duplicate-dropped bytes
         # per-(key, worker) highest sequence token seen — the dedup floor
@@ -64,6 +92,12 @@ class KVStore:
         # membership-epoch gate (fault/membership.py): deltas stamped
         # with another epoch are dropped, not summed
         self._membership_epoch = _membership.current_epoch()
+        # store generation: bumped by clear().  Snapshots carry it so a
+        # serving delta pull whose base predates a clear is answered
+        # FULL — per-key versions restart at 0 after a clear, and a
+        # version-vector comparison across the reset would skip every
+        # re-initialized key and serve pre-clear values as fresh
+        self._generation = 0
         # force the one-time native build/load here, NOT under self._lock in
         # push_delta (the first load may g++-compile core.cc for seconds)
         _native_load()
@@ -85,15 +119,85 @@ class KVStore:
 
     def debug_state(self) -> dict:
         """Postmortem internals for ``/debug/state``: dedup floors, wire
-        accounting, key count."""
+        accounting, key count.  ``dedup_floors`` is CLAMPED to the
+        :data:`DEBUG_FLOORS_MAX` lowest floors (the laggards) —
+        ``dedup_floor_count`` carries the true total, so a
+        many-key/many-worker run cannot balloon a debug scrape."""
         with self._lock:
+            worst = sorted(self._seen.items(), key=lambda kv: kv[1])
             return {"kind": "kv_store",
                     "membership_epoch": self._membership_epoch,
                     "keys": len(self._store),
                     "wire_bytes": self.wire_bytes,
                     "wire_bytes_wasted": self.wire_bytes_wasted,
-                    "dedup_floors": {f"{k}:{w}": s
-                                     for (k, w), s in self._seen.items()}}
+                    "dedup_floor_count": len(self._seen),
+                    "dedup_floors": {f"{k}:{w}": s for (k, w), s
+                                     in worst[:DEBUG_FLOORS_MAX]}}
+
+    # -- write subscription (serving-plane snapshot cutting) ----------------
+
+    def subscribe(self, fn: Callable[[str, int], None]) -> None:
+        """Register a write hook: ``fn(key, new_version)`` runs after a
+        delta lands, OUTSIDE the store lock (the subscriber may pull,
+        snapshot, or copy large arrays without stalling pushers).  Inside
+        a :meth:`write_batch`, notifications are deferred to batch exit
+        so a subscriber cutting snapshots never observes a half-applied
+        multi-key update from this writer."""
+        with self._lock:
+            self._subs.append(fn)
+
+    def unsubscribe(self, fn: Callable[[str, int], None]) -> None:
+        """Detach a write hook.  Subscribers are STRONGLY referenced (a
+        bound method pins its owner), so a dropped serving plane must
+        detach or the store keeps it — and its snapshot cutting — alive
+        forever.  Unknown hooks are ignored (idempotent)."""
+        with self._lock:
+            try:
+                self._subs.remove(fn)
+            except ValueError:
+                pass
+
+    @contextlib.contextmanager
+    def write_batch(self):
+        """Group several pushes into one consistent point: subscriber
+        notifications for everything pushed inside the block fire only
+        at exit.  Per-writer-thread (reentrant); concurrent writers'
+        batches are independent — multi-key atomicity is a single
+        writer's contract (async-PS sums commute per key across
+        workers)."""
+        depth = getattr(self._tls, "depth", 0)
+        if depth == 0:
+            self._tls.pending = []
+        self._tls.depth = depth + 1
+        try:
+            yield
+        finally:
+            self._tls.depth = depth
+            if depth == 0:
+                pending, self._tls.pending = self._tls.pending, []
+                for key, version in pending:
+                    self._fire(key, version)
+
+    def _notify(self, key: str, version: int) -> None:
+        """Caller does NOT hold the lock."""
+        if not self._subs:
+            return
+        if getattr(self._tls, "depth", 0) > 0:
+            self._tls.pending.append((key, version))
+            return
+        self._fire(key, version)
+
+    def _fire(self, key: str, version: int) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for fn in subs:
+            try:
+                fn(key, version)
+            except Exception:  # noqa: BLE001 — a subscriber must never
+                # fail a push that already landed
+                get_logger().error(
+                    "kv store: write subscriber raised for %r", key,
+                    exc_info=True)
 
     def set_membership_epoch(self, epoch: int) -> None:
         """Adopt a new membership epoch (monotonic); see ServerEngine.
@@ -152,15 +256,26 @@ class KVStore:
     def init_key(self, key: str, value) -> None:
         """Idempotent first-push initialization (reference init-push
         barrier, server.cc:261-289)."""
+        created = False
         with self._lock:
             if key not in self._store:
                 self._store[key] = np.array(value, copy=True)
                 self._versions[key] = 0
+                created = True
+        if created:
+            self._notify(key, 0)
 
     def _push_delta_locked(self, key: str, delta: np.ndarray) -> int:
         if key not in self._store:
             raise KeyError(f"key {key!r} not initialized")
         target = self._store[key]
+        if key in self._cow:
+            # copy-on-write: an outstanding reference (a pull copying
+            # outside the lock, or a serving snapshot) holds the current
+            # array — replace it instead of mutating it in place, so the
+            # reference stays a frozen consistent value
+            target = self._store[key] = target.copy()
+            self._cow.discard(key)
         screened = _integrity.enabled()
         prev = None
         if screened and _integrity.nonfinite_policy() in ("skip", "raise"):
@@ -233,42 +348,54 @@ class KVStore:
         — the current version is returned unchanged.  With integrity
         armed the delta crosses the envelope hop (chaos-visible, CRC
         verified); a ``(worker_id, seq)`` token makes the push
-        idempotent (see :meth:`_dup`)."""
-        with self._lock:
-            if self._stale(key, mepoch):
-                return self._versions.get(key, -1)
-            if self._dup(key, worker_id, seq):
-                version = self._versions.get(key, -1)
+        idempotent (see :meth:`_dup`).  A landed delta notifies write
+        subscribers outside the lock — even when the ack is then
+        chaos-dropped (the sum DID apply)."""
+        landed: Optional[int] = None
+        try:
+            with self._lock:
+                if self._stale(key, mepoch):
+                    return self._versions.get(key, -1)
+                if self._dup(key, worker_id, seq):
+                    version = self._versions.get(key, -1)
+                    self._maybe_drop_ack(key, version, seq)
+                    return version
+                arr = np.asarray(delta)
+                if _integrity.enabled():
+                    seq_env = (seq if seq is not None
+                               else next(self._wire_seq))
+                    frame = _integrity.seal_array(arr, key=key, seq=seq_env,
+                                                  worker=worker_id)
+                    # wasted_nbytes=0: the wire counters are denominated
+                    # in wire-ENCODED (compressed) bytes only — charging
+                    # raw float32 nbytes here would let uncompressed
+                    # deltas dwarf the compressed traffic and wreck the
+                    # waste ratio; raw rejects stay visible in
+                    # integrity.crc_reject/retransmit
+                    arr = self._wire_recv(key, frame, worker_id, seq_env,
+                                          _integrity.open_array, 0)
+                    arr = _integrity.screen_nonfinite(
+                        arr, what="delta", key=key, worker=worker_id)
+                    if arr is None:  # skip policy: drop this contribution
+                        self._mark_seen(key, worker_id, seq)  # fate final
+                        return self._versions.get(key, -1)
+                elif _fault.ENABLED:
+                    # integrity off: the bitflip lands silently in this
+                    # delta — the unprotected baseline the envelope fixes
+                    # (mirrors ServerEngine.push; a corrupt-site spec must
+                    # never silently no-op)
+                    arr = np.asarray(_fault.corrupt("kv_push", arr))
+                    _fault.fire("kv_push")
+                before = self._versions.get(key, -1)
+                version = self._push_delta_locked(key, arr)
+                self._mark_seen(key, worker_id, seq)
+                if version != before:
+                    landed = version
                 self._maybe_drop_ack(key, version, seq)
                 return version
-            arr = np.asarray(delta)
-            if _integrity.enabled():
-                seq_env = seq if seq is not None else next(self._wire_seq)
-                frame = _integrity.seal_array(arr, key=key, seq=seq_env,
-                                              worker=worker_id)
-                # wasted_nbytes=0: the wire counters are denominated in
-                # wire-ENCODED (compressed) bytes only — charging raw
-                # float32 nbytes here would let uncompressed deltas dwarf
-                # the compressed traffic and wreck the waste ratio; raw
-                # rejects stay visible in integrity.crc_reject/retransmit
-                arr = self._wire_recv(key, frame, worker_id, seq_env,
-                                      _integrity.open_array, 0)
-                arr = _integrity.screen_nonfinite(
-                    arr, what="delta", key=key, worker=worker_id)
-                if arr is None:  # skip policy: drop this contribution
-                    self._mark_seen(key, worker_id, seq)  # fate is final
-                    return self._versions.get(key, -1)
-            elif _fault.ENABLED:
-                # integrity off: the bitflip lands silently in this
-                # delta — the unprotected baseline the envelope fixes
-                # (mirrors ServerEngine.push; a corrupt-site spec must
-                # never silently no-op)
-                arr = np.asarray(_fault.corrupt("kv_push", arr))
-                _fault.fire("kv_push")
-            version = self._push_delta_locked(key, arr)
-            self._mark_seen(key, worker_id, seq)
-            self._maybe_drop_ack(key, version, seq)
-            return version
+        finally:
+            if landed is not None:
+                self._notify(key, landed)
 
     def register_compression(self, key: str, kwargs: dict, numel: int,
                              dtype=np.float32) -> None:
@@ -286,7 +413,24 @@ class KVStore:
                         f"compression kwargs {existing[0]}")
                 return
             comp = reg.create(dict(kwargs), numel, dtype, for_server=True)
-            self._codecs[key] = (dict(kwargs), comp)
+            self._codecs[key] = (dict(kwargs), comp, numel, dtype)
+
+    def codec_info(self, key: str):
+        """(kwargs, comp, numel, dtype) of the key's registered wire
+        codec, or ``None`` — the serving plane reuses the TRAINING
+        plane's codec on the read path (delta pulls ship the same wire
+        encoding the pushes arrive in), and a pull client rebuilds its
+        decoder from the kwargs/numel/dtype triple."""
+        with self._lock:
+            return self._codecs.get(key)
+
+    def codec_infos(self) -> Dict[str, tuple]:
+        """Every registered codec in ONE lock acquisition — captured
+        into each serving snapshot at cut time so the per-key read path
+        never touches the store lock (the contention the COW design
+        exists to keep off the pull path)."""
+        with self._lock:
+            return dict(self._codecs)
 
     def push_delta_wire(self, key: str, data: bytes,
                         mepoch: Optional[int] = None,
@@ -302,56 +446,103 @@ class KVStore:
         ``mepoch`` is dropped before the decode runs; a corrupt frame is
         NACKed and retransmitted before the decode runs — the codec
         never sees unverified bytes."""
-        with self._lock:
-            if self._stale(key, mepoch):
-                return self._versions.get(key, -1)
-            codec = self._codecs.get(key)
-            if codec is None:
-                raise KeyError(f"key {key!r} has no registered compression")
-            if self._dup(key, worker_id, seq):
-                self._account_wire(len(data), wasted=True)
-                version = self._versions.get(key, -1)
+        landed: Optional[int] = None
+        try:
+            with self._lock:
+                if self._stale(key, mepoch):
+                    return self._versions.get(key, -1)
+                codec = self._codecs.get(key)
+                if codec is None:
+                    raise KeyError(
+                        f"key {key!r} has no registered compression")
+                if self._dup(key, worker_id, seq):
+                    self._account_wire(len(data), wasted=True)
+                    version = self._versions.get(key, -1)
+                    self._maybe_drop_ack(key, version, seq)
+                    return version
+                if _integrity.enabled():
+                    env_seq = (seq if seq is not None
+                               else next(self._wire_seq))
+                    frame = _integrity.seal_bytes(data, key=key, seq=env_seq,
+                                                  worker=worker_id)
+                    verified = bytes(self._wire_recv(
+                        key, frame, worker_id, env_seq,
+                        _integrity.open_bytes, len(data)))
+                else:
+                    verified = data
+                    if _fault.ENABLED:
+                        # integrity off: corruption reaches the codec and
+                        # decodes into a many-element error — the baseline
+                        # the envelope exists to fix
+                        verified = _fault.corrupt_bytes("kv_push", verified)
+                        _fault.fire("kv_push")
+                delta = np.asarray(codec[1].decompress(
+                    codec[1].wire_decode(verified)))
+                if _integrity.enabled():
+                    delta = _integrity.screen_nonfinite(
+                        delta, what="delta", key=key, worker=worker_id)
+                    if delta is None:  # skip policy: dropped, bytes wasted
+                        self._account_wire(len(data), wasted=True)
+                        self._mark_seen(key, worker_id, seq)  # fate final
+                        return self._versions.get(key, -1)
+                before = self._versions.get(key, -1)
+                version = self._push_delta_locked(key, delta)
+                self._mark_seen(key, worker_id, seq)
+                if version != before:
+                    self._account_wire(len(data))
+                    landed = version
+                else:  # merged-screen skip: the delta did not land
+                    self._account_wire(len(data), wasted=True)
                 self._maybe_drop_ack(key, version, seq)
                 return version
-            if _integrity.enabled():
-                env_seq = seq if seq is not None else next(self._wire_seq)
-                frame = _integrity.seal_bytes(data, key=key, seq=env_seq,
-                                              worker=worker_id)
-                verified = bytes(self._wire_recv(
-                    key, frame, worker_id, env_seq,
-                    _integrity.open_bytes, len(data)))
-            else:
-                verified = data
-                if _fault.ENABLED:
-                    # integrity off: corruption reaches the codec and
-                    # decodes into a many-element error — the baseline
-                    # the envelope exists to fix
-                    verified = _fault.corrupt_bytes("kv_push", verified)
-                    _fault.fire("kv_push")
-            delta = np.asarray(codec[1].decompress(
-                codec[1].wire_decode(verified)))
-            if _integrity.enabled():
-                delta = _integrity.screen_nonfinite(
-                    delta, what="delta", key=key, worker=worker_id)
-                if delta is None:  # skip policy: dropped, bytes wasted
-                    self._account_wire(len(data), wasted=True)
-                    self._mark_seen(key, worker_id, seq)  # fate is final
-                    return self._versions.get(key, -1)
-            before = self._versions.get(key, -1)
-            version = self._push_delta_locked(key, delta)
-            self._mark_seen(key, worker_id, seq)
-            if version != before:
-                self._account_wire(len(data))
-            else:  # merged-screen skip: the delta did not land
-                self._account_wire(len(data), wasted=True)
-            self._maybe_drop_ack(key, version, seq)
-            return version
+        finally:
+            if landed is not None:
+                self._notify(key, landed)
 
     def pull(self, key: str) -> np.ndarray:
         """Return the current value (no barrier — async pull,
-        server.cc:371-404)."""
+        server.cc:371-404).
+
+        The lock is held only to take the reference and mark the key
+        copy-on-write; the (possibly large) copy runs OUTSIDE it, so a
+        slow pull never serializes concurrent pushes.  The COW mark
+        makes the unlocked copy torn-free: a concurrent push replaces
+        the stored array instead of mutating this reference."""
         with self._lock:
-            return self._store[key].copy()
+            ref = self._store[key]
+            self._cow.add(key)
+        return _copy_outside_lock(ref)
+
+    def pull_versioned(self, key: str) -> Tuple[np.ndarray, int]:
+        """``(value, version)`` with the same outside-the-lock copy as
+        :meth:`pull` — the serving plane's cheap read primitive (a
+        client compares the version against its cached one)."""
+        with self._lock:
+            ref = self._store[key]
+            version = self._versions[key]
+            self._cow.add(key)
+        return _copy_outside_lock(ref), version
+
+    def snapshot_refs(self) -> Tuple[Dict[str, Tuple[np.ndarray, int]],
+                                     int]:
+        """Consistent copy-on-write snapshot of every key:
+        ``({key: (read-only view, version)}, generation)`` taken under
+        ONE lock acquisition with no copying at all — every key is
+        marked COW, so later pushes replace arrays rather than mutate
+        them and the returned views stay a frozen, mutually-consistent
+        cut of the store.  The generation rides the same lock hold so a
+        racing :meth:`clear` cannot stamp pre-clear refs with a
+        post-clear generation.  This is what ``server/serving.py`` cuts
+        snapshots from; the cost is one lazy copy per (snapshot,
+        subsequently-pushed key), paid on the push path."""
+        with self._lock:
+            self._cow.update(self._store.keys())
+            out = {}
+            for k, a in self._store.items():
+                v = a.view()
+                v.flags.writeable = False
+                out[k] = (v, self._versions[k])
+            return out, self._generation
 
     def version(self, key: str) -> int:
         with self._lock:
@@ -362,10 +553,23 @@ class KVStore:
             return list(self._store)
 
     def clear(self) -> None:
+        """Reset the store to empty.  The membership epoch RE-SYNCS to
+        the process-wide current epoch rather than surviving the clear:
+        a cleared-and-reused store is a new logical store in whatever
+        world exists NOW — keeping the old epoch would silently drop
+        every delta from the new world as stale (the dedup floors and
+        versions it guarded are gone anyway).  The store GENERATION is
+        bumped so serving snapshots cut before the clear can never act
+        as a delta base afterwards: per-key versions restart at 0, and
+        a cross-clear version comparison would silently serve pre-clear
+        values as fresh."""
         with self._lock:
             self._store.clear()
             self._versions.clear()
             self._codecs.clear()
             self._seen.clear()
+            self._cow.clear()
             self.wire_bytes = 0
             self.wire_bytes_wasted = 0
+            self._membership_epoch = _membership.current_epoch()
+            self._generation += 1

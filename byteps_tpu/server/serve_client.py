@@ -1,0 +1,193 @@
+"""Pull client: staleness-bounded, delta-pulling parameter consumer.
+
+The consumer half of the serving plane (:mod:`~byteps_tpu.server.serving`):
+a :class:`PullClient` holds a local cache of parameter values plus the
+``snapshot_id`` it was hydrated at, and on every :meth:`pull` chooses its
+own consistency point:
+
+- cache younger than ``max_staleness_s`` → served locally
+  (``serve.cache_hits``), zero wire traffic;
+- stale, ``block=True`` (default) → a DELTA pull against the plane
+  (only keys whose version advanced since the cached snapshot travel,
+  codec-encoded where the training plane registered one), then serve;
+- stale, ``block=False`` or ``prefetch=True`` → the stale cache is
+  served immediately (``serve.stale_served``) while a single-flight
+  background refresh brings it forward (``serve.async_refresh``) — the
+  online-learning consumer's mode: bounded staleness, never a stall.
+
+Byte accounting: :attr:`bytes_received` sums the wire-encoded payload
+bytes of every refresh — the figure the delta-pull acceptance test and
+``tools/serve_bench.py`` assert O(churn), not O(model), traffic with.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..common.telemetry import counters
+
+__all__ = ["PullClient"]
+
+
+class PullClient:
+    """One read-side consumer of a :class:`~.serving.ServingPlane`.
+
+    ``keys=None`` tracks the whole model; a list restricts the working
+    set (and the delta traffic) to those keys.  Thread-safe: one
+    refresh at a time (single-flight), concurrent ``pull`` calls share
+    its result."""
+
+    def __init__(self, plane, keys: Optional[List[str]] = None,
+                 max_staleness_s: Optional[float] = None,
+                 prefetch: bool = False):
+        from ..common.config import get_config
+        self._plane = plane
+        self._keys = list(keys) if keys is not None else None
+        self.max_staleness_s = (get_config().serve_max_staleness_s
+                                if max_staleness_s is None
+                                else max_staleness_s)
+        self.prefetch = prefetch
+        self._cache: Dict[str, np.ndarray] = {}
+        self._versions: Dict[str, int] = {}
+        self._codecs: Dict[str, object] = {}
+        self._snapshot_id: Optional[int] = None
+        self._fetched_at: float = 0.0
+        self._refresh_lock = threading.Lock()
+        # single-flight guard for background refreshes: acquired
+        # non-blocking by the thread that wins the race, released when
+        # its refresh finishes (an Event's check-then-set would let two
+        # concurrent stale pulls both spawn refresh threads)
+        self._inflight = threading.Lock()
+        self.bytes_received = 0
+        self.refreshes = 0
+
+    # -- freshness -----------------------------------------------------------
+
+    @property
+    def snapshot_id(self) -> Optional[int]:
+        return self._snapshot_id
+
+    def staleness_s(self) -> float:
+        """Seconds since the cache was last brought forward (``inf``
+        before the first refresh)."""
+        if self._snapshot_id is None:
+            return float("inf")
+        return time.monotonic() - self._fetched_at
+
+    def version(self, key: str) -> int:
+        return self._versions.get(key, -1)
+
+    # -- the pull ------------------------------------------------------------
+
+    def pull(self, keys: Optional[List[str]] = None,
+             max_staleness_s: Optional[float] = None,
+             block: bool = True) -> Dict[str, np.ndarray]:
+        """Return ``{key: value}`` no staler than the bound.
+
+        ``block=False`` (or a client built with ``prefetch=True``)
+        serves the current cache immediately when stale and refreshes in
+        the background; the very first pull always blocks — there is
+        nothing to serve yet."""
+        bound = (self.max_staleness_s if max_staleness_s is None
+                 else max_staleness_s)
+        wanted = keys if keys is not None else self._keys
+        # the _snapshot_id check keeps the first-pull-always-blocks
+        # contract even for an unbounded staleness (inf <= inf would
+        # otherwise "hit" an empty cache forever)
+        if self._snapshot_id is not None and self.staleness_s() <= bound:
+            counters.inc("serve.cache_hits")
+            return self._slice(wanted)
+        if self._snapshot_id is not None and (self.prefetch or not block):
+            counters.inc("serve.stale_served")
+            self._refresh_async()
+            return self._slice(wanted)
+        self.refresh()
+        return self._slice(wanted)
+
+    def _slice(self, keys: Optional[List[str]]) -> Dict[str, np.ndarray]:
+        cache = self._cache     # bind ONCE: a concurrent refresh swaps
+        #                         the reference; re-reading it per key
+        #                         could mix two snapshots
+        if keys is None:
+            return dict(cache)
+        return {k: cache[k] for k in keys if k in cache}
+
+    # -- refresh machinery ---------------------------------------------------
+
+    def refresh(self) -> None:
+        """Bring the cache forward to the plane's latest snapshot with
+        one delta pull (full on first contact or after the cached id
+        aged out of retention server-side)."""
+        with self._refresh_lock:
+            reply = self._plane.pull(since_id=self._snapshot_id,
+                                     keys=self._keys)
+            # build the updated view ASIDE and publish it with one
+            # reference swap: a concurrent non-blocking pull slicing
+            # the cache mid-refresh must see snapshot N or N+1 whole,
+            # never a torn mix of the two
+            cache = dict(self._cache)
+            versions = dict(self._versions)
+            for k, item in reply.items.items():
+                cache[k] = self._decode(k, item)
+                versions[k] = item.version
+            if reply.full and self._keys is None:
+                # a whole-model client's keys absent from a FULL reply
+                # no longer exist server-side (store cleared/re-keyed);
+                # a restricted client keeps its slice regardless
+                for k in list(cache):
+                    if k not in reply.items:
+                        del cache[k]
+                        versions.pop(k, None)
+            self._cache = cache
+            self._versions = versions
+            self._snapshot_id = reply.snapshot_id
+            self._fetched_at = time.monotonic()
+            self.bytes_received += reply.wire_bytes
+            self.refreshes += 1
+            counters.inc("serve.cache_misses")
+
+    def _refresh_async(self) -> None:
+        """Single-flight background refresh: while one is in flight,
+        further stale pulls keep serving the cache instead of piling up
+        refresh threads (atomic test-and-set — losers return
+        immediately)."""
+        if not self._inflight.acquire(blocking=False):
+            return
+        counters.inc("serve.async_refresh")
+
+        def run():
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 — the cache stays stale;
+                # the next blocking pull surfaces the error
+                get_logger().error("serve: async refresh failed",
+                                   exc_info=True)
+            finally:
+                self._inflight.release()
+
+        threading.Thread(target=run, daemon=True,
+                         name="bps-serve-prefetch").start()
+
+    def _decode(self, key: str, item) -> np.ndarray:
+        """Materialize one reply item into cache memory the client owns
+        (reply payloads may be COW views of server memory on the
+        loopback fast path)."""
+        if item.codec is None:
+            return np.array(item.payload, copy=True)
+        kwargs, numel, dtype_s = item.codec
+        comp = self._codecs.get(key)
+        if comp is None or comp[0] != (kwargs, numel, dtype_s):
+            from ..compression import registry as reg
+            comp = ((kwargs, numel, dtype_s),
+                    reg.create(dict(kwargs), numel, np.dtype(dtype_s),
+                               for_server=True))
+            self._codecs[key] = comp
+        decoder = comp[1]
+        return np.array(
+            decoder.decompress(decoder.wire_decode(item.payload)),
+            copy=True)

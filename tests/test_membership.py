@@ -205,6 +205,19 @@ def test_bus_rejoin_admitted_at_step_boundary_with_state():
 
         tj = threading.Thread(target=rejoiner)
         tj.start()
+        # wait until the bus has PARKED the joiner before any member
+        # syncs: if the step-7 quorum completes first, the members'
+        # round legitimately finishes without a world change (the
+        # joiner would be admitted at the NEXT boundary — which this
+        # test never produces) and the ok-without-stale replies here
+        # were a thread-scheduling flake, not a bus bug
+        import time as _time
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            with bus._cv:
+                if 1 in bus._join_wait:
+                    break
+            _time.sleep(0.005)
         ts = [threading.Thread(target=member, args=(r,)) for r in (0, 2)]
         for t in ts:
             t.start()

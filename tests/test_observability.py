@@ -346,7 +346,11 @@ def test_obs_endpoints_serve_metrics_healthz_debug_state(tmp_path):
         assert "planner" in doc["engine"]
         assert doc["engine"]["step"]["bytes_pushed"] == 8192
         kv_states = [c for c in doc["kv_stores"]]
-        assert any(c["dedup_floors"] == {"w:1": 4} for c in kv_states)
+        # dedup_floors is CLAMPED (ISSUE 9 satellite): worst-N entries
+        # plus the true count, so the shape carries both fields
+        assert any(c["dedup_floors"] == {"w:1": 4}
+                   and c["dedup_floor_count"] == 1 for c in kv_states)
+        assert "serving_planes" in doc
         assert any(c["kind"] == "server_engine"
                    for c in doc["server_engines"])
 

@@ -403,6 +403,43 @@ def test_assigner_mixed_mode_ranges():
                        num_workers=2)   # no non-colocated servers
 
 
+def test_assigner_mixed_reshard_rollback_keeps_previous_shape_routable():
+    """ISSUE 9 satellite: a shape-violating mixed-mode reshard must
+    raise AND leave the assigner fully routable under the shape it had
+    before — service survives the failed transition."""
+    a = ServerAssigner(num_servers=5, fn="djb2", mixed_mode=True,
+                       num_workers=3)
+    before = {k << 16: a.assign(k << 16) for k in range(50)}
+    with pytest.raises(ValueError):
+        a.reshard(2, num_workers=2)     # 0 non-colocated: invalid split
+    assert a.num_servers == 5           # shape rolled back...
+    sids = {k: a.assign(k) for k in before}
+    assert sids == before               # ...and routing is unchanged
+    assert all(0 <= s < 5 for s in sids.values())
+    a.assign(99 << 16, nbytes=64)       # fresh keys still route
+    with pytest.raises(ValueError):
+        a.reshard(3)                    # mixed mode needs num_workers
+    assert a.assign(99 << 16) == a.assign(99 << 16)
+
+
+def test_assigner_load_summary_percentages():
+    """ISSUE 9 satellite: load_summary() percentages are derived from
+    the accumulated byte loads and sum to ~100%."""
+    a = ServerAssigner(num_servers=2, fn="djb2")
+    # route two keys to known servers, then charge known byte loads
+    k0, k1 = 0, 1
+    while a.assign(k1) == a.assign(k0):
+        k1 += 1
+    a.assign(k0, nbytes=300)
+    a.assign(k1, nbytes=100)
+    text = a.load_summary()
+    assert "75.0%" in text and "25.0%" in text
+    assert "300" in text and "100" in text
+    # empty accounting renders 0% everywhere instead of dividing by zero
+    fresh = ServerAssigner(num_servers=2, fn="djb2")
+    assert fresh.load_summary() == "s0: 0 (0.0%), s1: 0 (0.0%)"
+
+
 def test_debug_sample_tensor_logs():
     """BYTEPS_DEBUG_SAMPLE_TENSOR emits stage samples for matching names.
     (The byteps logger has its own handler and does not propagate, so a

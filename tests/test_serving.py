@@ -1,0 +1,691 @@
+"""Parameter-serving plane tests (server/serving.py + serve_client.py).
+
+What is pinned here:
+
+- snapshot cutting: monotonic ids, bounded retention, per-snapshot
+  version vectors, ATOMIC publication (a reader sees a complete cut or
+  the previous complete cut — never a torn multi-key view), and the
+  copy-on-write contract (cutting copies nothing; pushes after a cut
+  leave the snapshot frozen);
+- delta pulls: only keys whose version advanced travel, wire-byte
+  accounting is exact (O(churn), not O(model)), codec-encoded where the
+  training plane registered a codec, full-snapshot fallback when the
+  client's snapshot id aged out of retention;
+- the ``serve_pull`` reply hop: chaos bitflips are NACKed and
+  retransmitted to exact values (the PR-4 envelope machine);
+- hot-key replication: pull-count histogram → replica sets, reads fan
+  across replicas, writes stay primary-routed, a killed replica
+  degrades to primary-served pulls with ZERO failed reads, and
+  ``reshard()`` rebuilds the sets for a changed world;
+- staleness-bounded client pulls: fresh cache serves locally, stale
+  blocks or async-prefetches by the caller's choice;
+- ISSUE 9 satellites: a slow pull copies OUTSIDE the store lock (pushes
+  are not serialized behind it), ``clear()`` re-syncs the membership
+  epoch, ``debug_state()`` clamps the dedup-floor listing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config, reset_config
+from byteps_tpu.common.telemetry import counters
+from byteps_tpu.fault import injector as inj
+from byteps_tpu.fault import membership as mm
+from byteps_tpu.server import kv_store as kv_mod
+from byteps_tpu.server.kv_store import DEBUG_FLOORS_MAX, KVStore
+from byteps_tpu.server.serve_client import PullClient
+from byteps_tpu.server.serving import ServingPlane, SnapshotStore
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    yield
+    inj.disarm()
+
+
+def _store(keys, numel=8, dtype=np.float32):
+    s = KVStore()
+    for k in keys:
+        s.init_key(k, np.zeros(numel, dtype))
+    return s
+
+
+# -- snapshots --------------------------------------------------------------
+
+def test_snapshot_ids_monotonic_and_retention_bounded():
+    s = _store(["a"])
+    ss = SnapshotStore(s, retention=3)
+    ids = []
+    for _ in range(6):
+        s.push_delta("a", np.ones(8, np.float32))
+        ids.append(ss.cut().id)
+    assert ids == sorted(ids) == list(range(1, 7))
+    assert len(ss.ring) == 3
+    assert ss.ring.get(ids[0]) is None          # aged out
+    assert ss.ring.get(ids[-1]).versions == {"a": 6}
+
+
+def test_snapshot_version_vector_and_cow_freeze():
+    s = _store(["a", "b"])
+    ss = SnapshotStore(s, retention=4)
+    s.push_delta("a", np.ones(8, np.float32))
+    snap = ss.cut()
+    assert snap.versions == {"a": 1, "b": 0}
+    # pushes AFTER the cut must not leak into the frozen snapshot
+    s.push_delta("a", np.ones(8, np.float32))
+    s.push_delta("b", np.ones(8, np.float32))
+    assert snap.refs["a"][0] == 1.0 and snap.refs["b"][0] == 0.0
+    assert s.pull("a")[0] == 2.0 and s.pull("b")[0] == 1.0
+    with pytest.raises(ValueError):
+        snap.refs["a"][0] = 9.0                 # read-only view
+
+
+def test_snapshot_publish_is_atomic_under_concurrent_cuts():
+    """A reader polling latest() while cuts race must only ever observe
+    complete, internally-consistent version vectors."""
+    s = _store(["x", "y"])
+    ss = SnapshotStore(s, retention=4)
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            snap = ss.ring.latest()
+            if snap is None:
+                continue
+            # the invariant the writer maintains: x and y move together
+            if snap.versions["x"] != snap.versions["y"]:
+                bad.append(dict(snap.versions))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for _ in range(60):
+        with s.write_batch():
+            s.push_delta("x", np.ones(8, np.float32))
+            s.push_delta("y", np.ones(8, np.float32))
+        ss.cut()
+    stop.set()
+    t.join(timeout=10)
+    assert bad == []
+
+
+def test_write_subscription_cuts_only_at_consistent_points():
+    """The auto-cut hook fires at write_batch exit, never mid-batch —
+    no snapshot can split a multi-key update from one writer."""
+    s = _store(["x", "y"])
+    ss = SnapshotStore(s, retention=8, cut_interval_s=0.0)
+    for _ in range(5):
+        with s.write_batch():
+            s.push_delta("x", np.ones(8, np.float32))
+            s.push_delta("y", np.ones(8, np.float32))
+    seen = [ss.ring.get(i) for i in range(1, 100)]
+    for snap in filter(None, seen):
+        assert snap.versions["x"] == snap.versions["y"], snap.versions
+    assert ss.ring.latest().versions == {"x": 5, "y": 5}
+
+
+# -- delta pulls ------------------------------------------------------------
+
+def test_delta_pull_ships_only_changed_keys_exact_bytes():
+    """The acceptance pin: wire-byte accounting proves a delta pull
+    transfers ONLY the changed keys' encoded bytes."""
+    numel = 256
+    s = _store(["a", "b", "c"], numel=numel)
+    plane = ServingPlane(s, replicas=1, retention=8)
+    for k in ("a", "b", "c"):
+        s.push_delta(k, np.ones(numel, np.float32))
+    plane.cut()
+    client = PullClient(plane, max_staleness_s=0.0)
+    client.pull()
+    key_bytes = numel * 4
+    assert client.bytes_received == 3 * key_bytes       # full hydration
+    s.push_delta("b", np.ones(numel, np.float32))
+    plane.cut()
+    vals = client.pull()
+    assert client.bytes_received == 4 * key_bytes       # +ONE key only
+    assert vals["b"][0] == 2.0 and vals["a"][0] == 1.0
+    assert counters.get("serve.delta_pulls") >= 1
+    # nothing changed -> zero-byte delta
+    plane.cut()
+    client.pull()
+    assert client.bytes_received == 4 * key_bytes
+
+
+def test_full_snapshot_fallback_when_since_id_aged_out():
+    s = _store(["a"], numel=16)
+    plane = ServingPlane(s, replicas=1, retention=2)
+    s.push_delta("a", np.ones(16, np.float32))
+    plane.cut()
+    client = PullClient(plane, max_staleness_s=0.0)
+    client.pull()
+    old_sid = client.snapshot_id
+    for _ in range(4):                  # push retention past old_sid
+        s.push_delta("a", np.ones(16, np.float32))
+        plane.cut()
+    assert plane.snapstore.ring.get(old_sid) is None
+    client.pull()
+    assert counters.get("serve.retention_miss") == 1
+    assert counters.get("serve.full_pulls") >= 2        # hydrate + fallback
+    assert client.pull()["a"][0] == 5.0
+
+
+def test_codec_encoded_delta_pull_reuses_training_codec():
+    import jax.numpy as jnp
+
+    from byteps_tpu.compression import registry as creg
+    numel = 8192
+    s = _store(["g"], numel=numel)
+    s.register_compression("g", {"compressor": "onebit"}, numel)
+    comp = creg.create({"compressor": "onebit"}, numel, np.float32)
+    payload, _ = comp.compress(jnp.ones(numel), comp.init_state())
+    s.push_delta_wire("g", comp.wire_encode(payload), worker_id=0, seq=1)
+    plane = ServingPlane(s, replicas=1)
+    plane.cut()
+    client = PullClient(plane, max_staleness_s=0.0)
+    vals = client.pull()
+    # the client decodes the same wire bytes the server encoded: exact
+    # agreement with a server-side round-trip of the stored value
+    expect = np.asarray(comp.decompress(
+        comp.compress(s.pull("g"), comp.init_state())[0]))
+    np.testing.assert_allclose(vals["g"], expect)
+    # onebit wire encoding beats raw float32 at this size
+    assert 0 < client.bytes_received < numel * 4
+
+
+def test_torn_snapshot_never_observed_by_concurrent_pullers():
+    """Acceptance pin: a writer advances two keys in lockstep (one
+    write_batch per step, auto-cut subscription); concurrent delta-pull
+    clients must NEVER see the keys diverge."""
+    numel = 64
+    s = _store(["w.a", "w.b"], numel=numel)
+    plane = ServingPlane(s, replicas=2, retention=8,
+                         cut_interval_s=0.0)
+    with s.write_batch():
+        s.push_delta("w.a", np.ones(numel, np.float32))
+        s.push_delta("w.b", np.ones(numel, np.float32))
+    stop = threading.Event()
+    torn = []
+
+    def puller():
+        client = PullClient(plane, max_staleness_s=0.0)
+        while not stop.is_set():
+            vals = client.pull()
+            if vals and vals["w.a"][0] != vals["w.b"][0]:
+                torn.append((float(vals["w.a"][0]),
+                             float(vals["w.b"][0])))
+
+    threads = [threading.Thread(target=puller, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(50):
+        with s.write_batch():
+            s.push_delta("w.a", np.ones(numel, np.float32))
+            s.push_delta("w.b", np.ones(numel, np.float32))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert torn == []
+    assert s.pull("w.a")[0] == 51.0
+
+
+# -- the serve_pull chaos hop (integrity lane) ------------------------------
+
+@pytest.mark.integrity
+def test_serve_pull_bitflip_nacked_and_retransmitted(monkeypatch):
+    """A corrupted pull reply is NACKed and retransmitted from the
+    sealed source — the client converges to exact values."""
+    monkeypatch.setenv("BYTEPS_INTEGRITY_MAX_RETRANSMITS", "8")
+    reset_config()
+    inj.arm("bitflip:site=serve_pull:p=0.3", seed=7, rank=0)
+    numel = 128
+    s = _store(["k"], numel=numel)
+    plane = ServingPlane(s, replicas=1)
+    plane.cut()
+    client = PullClient(plane, max_staleness_s=0.0)
+    for i in range(12):
+        s.push_delta("k", np.ones(numel, np.float32))
+        plane.cut()
+        vals = client.pull()
+    assert vals["k"][0] == 12.0 and (vals["k"] == vals["k"][0]).all()
+    assert counters.get("integrity.crc_reject") > 0
+    assert counters.get("integrity.retransmit") > 0
+    assert counters.get("serve.pull_bytes_wasted") > 0
+
+
+@pytest.mark.integrity
+def test_serve_pull_corruption_reaches_client_with_integrity_off(
+        monkeypatch):
+    """The unprotected baseline the envelope exists to fix: integrity
+    off + a serve_pull bitflip lands silently in the reply."""
+    monkeypatch.setenv("BYTEPS_INTEGRITY", "0")
+    reset_config()
+    inj.arm("bitflip:site=serve_pull:p=1", seed=0, rank=0)
+    s = _store(["k"], numel=64)
+    s.push_delta("k", np.ones(64, np.float32))
+    plane = ServingPlane(s, replicas=1)
+    plane.cut()
+    vals = PullClient(plane, max_staleness_s=0.0).pull()
+    assert not np.array_equal(vals["k"], s.pull("k"))
+    assert counters.get("integrity.crc_reject") == 0
+
+
+# -- hot-key replication (chaos lane) ---------------------------------------
+
+def _warm_plane(keys, numel=64, replicas=3):
+    s = _store(keys, numel=numel)
+    plane = ServingPlane(s, replicas=replicas, retention=8, hot_keys=8)
+    for k in keys:
+        s.push_delta(k, np.ones(numel, np.float32))
+    plane.cut()
+    warm = PullClient(plane, max_staleness_s=0.0)
+    warm.pull()                 # populate the pull-count histogram
+    plane.cut()                 # mirror the now-hot keys
+    return s, plane
+
+
+def test_hot_key_histogram_drives_replica_sets():
+    from byteps_tpu.server.sharding import ServerAssigner
+    a = ServerAssigner(num_servers=4, fn="djb2", replicas=2, hot_keys=2)
+    for _ in range(5):
+        a.record_pull("hot.a")
+    for _ in range(3):
+        a.record_pull("hot.b")
+    a.record_pull("cold.c")
+    assert a.hot_keys() == ["hot.a", "hot.b"]
+    sets = a.rebuild_replicas()
+    assert set(sets) == {"hot.a", "hot.b"}
+    for key, shard_set in sets.items():
+        assert len(shard_set) == 2 == len(set(shard_set))
+        # writes stay primary-routed: the set's head IS the primary
+        assert shard_set[0] == a.write_target(key)
+    assert a.replica_set("cold.c") == [a.write_target("cold.c")]
+
+
+def test_reads_fan_across_replicas_writes_stay_primary():
+    s, plane = _warm_plane(["r.a", "r.b"])
+    client = PullClient(plane, max_staleness_s=0.0)
+    for _ in range(6):
+        client.pull()
+    assert counters.get("serve.replica_reads") > 0
+    assert plane.debug_state()["hot_keys_mirrored"] == 2
+    # a write lands in the ONE store; the next cut propagates it to
+    # every replica mirror (no forked value history)
+    s.push_delta("r.a", np.ones(64, np.float32))
+    plane.cut()
+    assert client.pull()["r.a"][0] == 2.0
+
+
+@pytest.mark.chaos
+def test_serve_killed_replica_degrades_to_primary_zero_failed_reads():
+    """Acceptance pin: kill replicas under concurrent training pushes —
+    every pull keeps answering (primary degradation), zero failed
+    reads."""
+    numel = 256
+    s, plane = _warm_plane(["h.a", "h.b"], numel=numel)
+    stop = threading.Event()
+    pushing = threading.Event()
+    pushing.set()
+    paused = threading.Event()
+    pushes = [0]
+
+    def pusher():
+        while not stop.is_set():
+            if not pushing.is_set():
+                paused.set()        # handshake: no further cuts until
+                time.sleep(0.001)   # pushing is re-set
+                continue
+            paused.clear()
+            with s.write_batch():
+                s.push_delta("h.a", np.ones(numel, np.float32))
+                s.push_delta("h.b", np.ones(numel, np.float32))
+            pushes[0] += 1
+            plane.cut()
+
+    failed = []
+    results = [0]
+
+    def puller():
+        client = PullClient(plane, max_staleness_s=0.0)
+        while not stop.is_set():
+            try:
+                vals = client.pull()
+            except Exception as e:  # noqa: BLE001 — exactly what must
+                failed.append(repr(e))          # never happen
+                return
+            assert vals["h.a"][0] == vals["h.b"][0]
+            results[0] += 1
+
+    pt = threading.Thread(target=pusher, daemon=True)
+    ts = [threading.Thread(target=puller, daemon=True) for _ in range(2)]
+    pt.start()
+    for t in ts:
+        t.start()
+    time.sleep(0.2)
+    # kill EVERY replica mid-traffic.  Cutting is paused so the mirror
+    # sets still point at the corpses: the next pulls MUST pay the
+    # discovery hop (ServeUnavailable -> serve.replica_fallback) and
+    # still answer from the primary
+    pushing.clear()
+    assert paused.wait(timeout=30)  # the in-flight cut (if any) is done
+    for rep in plane.replicas:
+        rep.kill()
+    probe = PullClient(plane, max_staleness_s=0.0)
+    for _ in range(4):
+        assert probe.pull()["h.a"][0] >= 1.0
+    assert counters.get("serve.replica_fallback") > 0   # dead hop paid
+    assert counters.get("serve.primary_reads") > 0      # ...and degraded
+    pushing.set()           # cuts resume: corpses leave the mirror sets
+    time.sleep(0.3)
+    stop.set()
+    pt.join(timeout=10)
+    for t in ts:
+        t.join(timeout=10)
+    assert failed == []
+    assert results[0] > 0 and pushes[0] > 0
+    assert plane.debug_state()["dead_replicas"] == [1, 2]
+
+
+def test_reshard_rebuilds_replica_sets_and_revives():
+    s, plane = _warm_plane(["e.a", "e.b"], replicas=3)
+    client = PullClient(plane, max_staleness_s=0.0)
+    plane.reshard(1)                    # world shrank to the primary
+    assert all(not r.alive for r in plane.replicas)
+    assert client.pull()["e.a"][0] == 1.0
+    assert plane.debug_state()["hot_keys_mirrored"] == 0
+    plane.reshard(3)                    # rejoin re-opens the endpoints
+    assert all(r.alive for r in plane.replicas)
+    client.pull()
+    plane.cut()
+    assert plane.debug_state()["hot_keys_mirrored"] == 2
+    assert counters.get("serve.reshards") == 2
+
+
+def test_membership_world_change_reshards_active_planes():
+    from byteps_tpu.server import serving as serving_mod
+    s, plane = _warm_plane(["m.a"], replicas=3)
+    view = mm.MembershipView(epoch=1, world=(0,))
+    serving_mod.notify_world_change(view)
+    assert plane.debug_state()["alive_clamp"] == 1
+    assert PullClient(plane, max_staleness_s=0.0).pull()["m.a"][0] == 1.0
+
+
+# -- staleness-bounded client pulls -----------------------------------------
+
+def test_fresh_cache_serves_locally_without_wire_traffic():
+    s, plane = _warm_plane(["s.a"])
+    client = PullClient(plane, max_staleness_s=60.0)
+    client.pull()
+    served = counters.get("serve.pulls")
+    got = client.bytes_received
+    for _ in range(5):
+        assert client.pull()["s.a"][0] == 1.0
+    assert counters.get("serve.pulls") == served        # no plane trips
+    assert client.bytes_received == got
+    assert counters.get("serve.cache_hits") == 5
+
+
+def test_stale_cache_blocking_refresh_picks_up_new_values():
+    s, plane = _warm_plane(["s.b"], numel=64)
+    client = PullClient(plane, max_staleness_s=0.0)
+    assert client.pull()["s.b"][0] == 1.0
+    s.push_delta("s.b", np.ones(64, np.float32))
+    plane.cut()
+    assert client.pull()["s.b"][0] == 2.0               # bound 0: refetch
+
+
+def test_async_prefetch_serves_stale_then_converges():
+    s, plane = _warm_plane(["s.c"], numel=64)
+    client = PullClient(plane, max_staleness_s=0.0, prefetch=True)
+    client.pull()                                       # first: blocking
+    s.push_delta("s.c", np.ones(64, np.float32))
+    plane.cut()
+    first = client.pull()                               # stale, instant
+    assert first["s.c"][0] in (1.0, 2.0)
+    assert counters.get("serve.stale_served") >= 1
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if client.pull()["s.c"][0] == 2.0:
+            break
+        time.sleep(0.01)
+    assert client.pull()["s.c"][0] == 2.0
+    assert counters.get("serve.async_refresh") >= 1
+
+
+def test_per_pull_staleness_override_and_config_default(monkeypatch):
+    monkeypatch.setenv("BYTEPS_SERVE_MAX_STALENESS", "123.0")
+    reset_config()
+    s, plane = _warm_plane(["s.d"], numel=64)
+    client = PullClient(plane)
+    assert client.max_staleness_s == 123.0
+    client.pull()
+    s.push_delta("s.d", np.ones(64, np.float32))
+    plane.cut()
+    assert client.pull()["s.d"][0] == 1.0               # fresh per config
+    assert client.pull(max_staleness_s=0.0)["s.d"][0] == 2.0
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        Config(serve_replicas=0)
+    with pytest.raises(ValueError):
+        Config(serve_retention=0)
+    with pytest.raises(ValueError):
+        Config(serve_max_staleness_s=-1.0)
+    with pytest.raises(ValueError):
+        Config(serve_cut_interval_s=-0.1)
+
+
+# -- ISSUE 9 satellites ------------------------------------------------------
+
+def test_slow_pull_does_not_serialize_pushes(monkeypatch):
+    """Satellite: the pull-path copy runs OUTSIDE the store lock — a
+    slow pull of a large key must not stall concurrent pushes."""
+    s = _store(["big", "other"], numel=64)
+    s.push_delta("big", np.ones(64, np.float32))
+    started = threading.Event()
+    release = threading.Event()
+    orig = kv_mod._copy_outside_lock
+
+    def slow_copy(arr):
+        started.set()
+        assert release.wait(timeout=30)
+        return orig(arr)
+
+    monkeypatch.setattr(kv_mod, "_copy_outside_lock", slow_copy)
+    out = {}
+
+    def slow_puller():
+        out["v"] = s.pull("big")
+
+    t = threading.Thread(target=slow_puller, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    # the pull is parked inside its copy; pushes must sail through
+    monkeypatch.setattr(kv_mod, "_copy_outside_lock", orig)
+    for _ in range(10):
+        s.push_delta("other", np.ones(64, np.float32))
+        s.push_delta("big", np.ones(64, np.float32))
+    assert s.version("other") == 10 and s.version("big") == 11
+    release.set()
+    t.join(timeout=30)
+    # ...and the parked pull still copied a CONSISTENT value: the COW
+    # mark made the concurrent pushes replace the array, not mutate it
+    assert out["v"][0] == 1.0
+
+
+def test_clear_resets_membership_epoch():
+    """Satellite: a cleared-and-reused store must accept the CURRENT
+    world's deltas instead of dropping them as stale forever."""
+    s = _store(["k"], numel=4)
+    s.set_membership_epoch(7)
+    # stale-dropped: the version stays at 0, the delta never lands
+    assert s.push_delta("k", np.ones(4, np.float32),
+                        mepoch=mm.current_epoch()) == 0
+    assert s.pull("k")[0] == 0.0
+    s.clear()
+    s.init_key("k", np.zeros(4, np.float32))
+    assert s.push_delta("k", np.ones(4, np.float32),
+                        mepoch=mm.current_epoch()) == 1   # accepted
+    assert counters.get("membership.stale_pushes_dropped") == 1
+
+
+def test_debug_state_clamps_dedup_floors():
+    """Satellite: /debug/state lists at most DEBUG_FLOORS_MAX floors —
+    the lowest (laggard) ones — plus the true total count."""
+    s = _store(["k"], numel=4)
+    n = DEBUG_FLOORS_MAX + 9
+    for w in range(n):
+        # worker w's floor ends at w+1: worker 0 is the laggard
+        s.push_delta("k", np.ones(4, np.float32), worker_id=w, seq=w + 1)
+    d = s.debug_state()
+    assert d["dedup_floor_count"] == n
+    assert len(d["dedup_floors"]) == DEBUG_FLOORS_MAX
+    assert set(d["dedup_floors"].values()) == set(
+        range(1, DEBUG_FLOORS_MAX + 1))
+
+
+def test_clear_bumps_generation_so_stale_delta_bases_go_full():
+    """A store clear restarts versions at 0; a client whose snapshot
+    predates the clear must get a FULL reply, never a 'delta' that
+    skips re-initialized keys and serves pre-clear values as fresh."""
+    s = _store(["g.a"], numel=16)
+    plane = ServingPlane(s, replicas=1, retention=8)
+    for _ in range(5):
+        s.push_delta("g.a", np.ones(16, np.float32))
+    plane.cut()
+    client = PullClient(plane, max_staleness_s=0.0)
+    assert client.pull()["g.a"][0] == 5.0
+    s.clear()                               # re-keyed store, version 0
+    s.init_key("g.a", np.full(16, 42.0, np.float32))
+    plane.cut()
+    vals = client.pull()                    # base snapshot: old gen
+    assert vals["g.a"][0] == 42.0           # NOT the stale 5.0
+    assert client.version("g.a") == 0
+
+
+def test_start_serving_defaults_write_driven_cutting(monkeypatch):
+    """bps.start_serving honors BYTEPS_SERVE_CUT_INTERVAL — a plane
+    started through the product entry point publishes on writes without
+    anyone calling cut()."""
+    import byteps_tpu as bps
+    monkeypatch.setenv("BYTEPS_SERVE_CUT_INTERVAL", "0.0")
+    reset_config()
+    s = _store(["w"], numel=8)
+    plane = bps.start_serving(s, replicas=1)
+    try:
+        s.push_delta("w", np.ones(8, np.float32))
+        snap = plane.snapstore.ring.latest()
+        assert snap is not None and snap.versions == {"w": 1}
+        # explicit opt-out still means manual cuts only
+        s2 = _store(["w"], numel=8)
+        plane2 = bps.start_serving(s2, replicas=1, cut_interval_s=None)
+        s2.push_delta("w", np.ones(8, np.float32))
+        assert plane2.snapstore.ring.latest() is None
+    finally:
+        plane.close()
+
+
+def test_plane_close_detaches_write_driven_cutting():
+    """A dropped plane must detach: the store's subscriber list holds
+    strong references, so without close() it would keep cutting (and
+    stay alive) for the store's lifetime."""
+    s = _store(["d.a"], numel=8)
+    plane = ServingPlane(s, replicas=1, cut_interval_s=0.0)
+    s.push_delta("d.a", np.ones(8, np.float32))
+    sid = plane.snapstore.ring.latest().id
+    plane.close()
+    s.push_delta("d.a", np.ones(8, np.float32))
+    assert plane.snapstore.ring.latest().id == sid    # no further cuts
+    plane.close()                                     # idempotent
+
+
+def test_snapshot_encode_memoized_across_clients():
+    """N clients refreshing against one cut must not pay N identical
+    compressions: the wire encoding is cached per (snapshot, key)."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.compression import registry as creg
+    numel = 4096
+    s = _store(["g"], numel=numel)
+    s.register_compression("g", {"compressor": "onebit"}, numel)
+    comp = creg.create({"compressor": "onebit"}, numel, np.float32)
+    payload, _ = comp.compress(jnp.ones(numel), comp.init_state())
+    s.push_delta_wire("g", comp.wire_encode(payload), worker_id=0, seq=1)
+    plane = ServingPlane(s, replicas=1)
+    snap = plane.cut()
+    first = PullClient(plane, max_staleness_s=0.0)
+    first.pull()
+    assert "g" in snap.enc_cache                      # encoded once...
+    sentinel = comp.wire_encode(
+        comp.compress(jnp.zeros(numel), comp.init_state())[0])
+    snap.enc_cache["g"] = sentinel
+    second = PullClient(plane, max_staleness_s=0.0)
+    vals = second.pull()
+    assert np.allclose(vals["g"], 0.0)                # ...served cached
+    assert second.bytes_received == len(sentinel)
+
+
+def test_empty_key_list_pull_answers_without_crashing():
+    """plane.pull(keys=[]) with hot keys mirrored must not trip the
+    replica-eligibility intersection (an empty loop once left it None
+    and the alive filter crashed on `in None`)."""
+    s, plane = _warm_plane(["z.a"])
+    reply = plane.pull(keys=[])
+    assert reply.items == {} and reply.wire_bytes == 0
+    assert PullClient(plane, keys=[], max_staleness_s=0.0).pull() == {}
+
+
+def test_unbounded_staleness_first_pull_still_hydrates():
+    """max_staleness_s=inf must not defeat the first-pull-always-blocks
+    contract (inf <= inf 'hit' an empty cache forever)."""
+    s, plane = _warm_plane(["u.a"], numel=8)
+    client = PullClient(plane, max_staleness_s=float("inf"))
+    vals = client.pull()                    # first: blocking hydration
+    assert vals["u.a"][0] == 1.0 and client.snapshot_id is not None
+    s.push_delta("u.a", np.ones(8, np.float32))
+    plane.cut()
+    assert client.pull()["u.a"][0] == 1.0   # then: cache forever
+
+
+def test_partial_replica_refuses_uncovered_keys_router_degrades():
+    """A replica asked for a key outside its mirror snapshot must
+    REFUSE (router falls to the primary) — silently skipping it would
+    stamp the reply with a snapshot id whose version vector already
+    covers the key, and the update would never be re-shipped."""
+    from byteps_tpu.server.serving import (ServeUnavailable,
+                                           SnapshotServer)
+    s, plane = _warm_plane(["p.a", "p.b"])
+    rep = plane.replicas[0]
+    assert rep.partial
+    with pytest.raises(ServeUnavailable):
+        rep.pull(keys=["p.a", "not.mirrored"])
+    # plane level: stale mirror map claiming coverage degrades cleanly
+    with plane._lock:
+        plane._mirrored["ghost"] = [rep.server_id]
+        plane._mirrored["p.a"] = [rep.server_id]
+    reply = plane.pull(keys=["p.a", "ghost"])
+    assert reply.server_id == 0             # primary answered
+    assert "p.a" in reply.items             # ...completely
+    assert counters.get("serve.replica_fallback") >= 1
+
+
+# -- the bench tool ----------------------------------------------------------
+
+def test_serve_bench_reports_throughput_latency_and_delta_accounting():
+    from tools import serve_bench
+    out = serve_bench.measure(seconds=0.3, clients=2, keys=3,
+                              numel=1024, replicas=2)
+    assert out["pulls"] > 0 and out["pulls_per_s"] > 0
+    assert out["p99_ms"] >= out["p50_ms"] >= 0
+    assert out["pushes"] > 0                # concurrent training pushes
+    assert out["failed_reads"] == 0
+    check = serve_bench.delta_check(numel=512, keys=3)
+    assert check["ok"]
+    assert check["full_pull_bytes"] == 3 * 512 * 4
+    assert check["delta_pull_bytes"] == 512 * 4

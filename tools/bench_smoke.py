@@ -101,10 +101,25 @@ def _measure(nbytes=8 * MB, reps=9):
             "metrics": diag}
 
 
+def _measure_serve():
+    """Serving lane (ISSUE 9): pulls/sec + p99 pull latency under
+    concurrent training pushes, recorded beside the push figures so the
+    read dimension lands in the benched trajectory.  Not gated — the
+    delta-accounting ``ok`` flag is the correctness proxy, and absolute
+    pulls/sec on a shared host measures the host."""
+    from tools import serve_bench
+    out = serve_bench.measure(seconds=1.0, clients=2, keys=4,
+                              numel=32768, replicas=3, staleness=0.0)
+    out["delta"] = serve_bench.delta_check()
+    return {k: out[k] for k in ("pulls_per_s", "p50_ms", "p99_ms",
+                                "pushes_per_s", "failed_reads", "delta")}
+
+
 def main() -> int:
     setup_cpu8_mesh()
     tol = float(os.environ.get("BENCH_SMOKE_TOLERANCE", "0.30"))
     out = _measure()
+    out["serve"] = _measure_serve()
     if "--update-floor" in sys.argv:
         floor = {"engine_vs_fused_ratio": out["engine_vs_fused_ratio"],
                  "engine_8MB_gbps": out["engine_8MB_gbps"],

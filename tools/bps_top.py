@@ -31,7 +31,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "RETX",
-            "EPOCH", "STEP", "AGE")
+            "PULLS", "EPOCH", "STEP", "AGE")
 
 
 def _rank_row(rank: int, entry: dict) -> tuple:
@@ -61,6 +61,9 @@ def _rank_row(rank: int, entry: dict) -> tuple:
         fmt(m.get("bytes_in_flight")),
         fmt(stall, "{:.0f}"),
         fmt(counters.get("integrity.retransmit", 0)),
+        # serving plane (server/serving.py): cumulative pulls served by
+        # this rank — 0 everywhere means the rank runs no read plane
+        fmt(counters.get("serve.pulls", 0)),
         fmt(m.get("epoch")),
         fmt(step.get("step")),
         fmt(entry.get("age_s"), "{:.1f}s"),

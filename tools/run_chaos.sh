@@ -12,7 +12,13 @@
 #      anything the in-process alarm cannot interrupt.
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
-#         lane: chaos (default) | integrity | obs | coordinator | all
+#         lane: chaos (default) | integrity | obs | coordinator | serve
+#               | all
+#         serve: the serving-plane chaos slice — replica kill under
+#              concurrent training pushes (zero failed reads, primary
+#              degradation) and serve_pull reply corruption
+#              (NACK/retransmit to exact values)
+#              (tests/test_serving.py)
 #         obs: the observability-under-chaos slice — every rank of a
 #              3-process chaos run serves /metrics//healthz, the
 #              membership bus answers cluster_metrics, and a
@@ -45,6 +51,7 @@ case "${1:-}" in
     coordinator) MARK="chaos"
                  KEXPR="coordinator or sync_deadline or reconcile"
                  shift ;;
+    serve)     MARK="chaos or integrity"; KEXPR="serve"; shift ;;
     all)       MARK="chaos or integrity"; shift ;;
 esac
 

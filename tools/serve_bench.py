@@ -1,0 +1,184 @@
+"""Serve bench: pulls/sec + p99 pull latency under concurrent pushes.
+
+The read-dimension headline the bench trajectory ignored until ISSUE 9:
+every prior figure measures push GB/s.  This tool stands up the
+parameter-serving plane (server/serving.py) over a live KV store, keeps
+a TRAINING pusher thread summing deltas and cutting snapshots the whole
+time, and drives N concurrent pull clients — reporting:
+
+- ``pulls_per_s``     — aggregate client pull throughput
+- ``p50_ms`` / ``p99_ms`` — per-pull latency quantiles (client-observed,
+  cache hits included when ``--staleness`` > 0: that IS the product's
+  latency story)
+- ``pushes_per_s``    — the write load sustained while serving
+- ``delta``           — a controlled wire-byte accounting check proving
+  a delta pull ships ONLY changed keys' encoded bytes (O(churn), not
+  O(model))
+
+Usage:  python tools/serve_bench.py [--seconds S] [--clients N]
+            [--keys K] [--numel E] [--replicas R] [--staleness SEC]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def delta_check(numel: int = 4096, keys: int = 4) -> dict:
+    """Deterministic byte accounting: a full hydration costs the whole
+    model; a delta pull after ONE changed key costs exactly that key's
+    encoded bytes.  Returns the measured figures plus ``ok``."""
+    import numpy as np
+
+    from byteps_tpu.server.kv_store import KVStore
+    from byteps_tpu.server.serve_client import PullClient
+    from byteps_tpu.server.serving import ServingPlane
+
+    store = KVStore()
+    names = [f"serve.delta.{i}" for i in range(keys)]
+    for n in names:
+        store.init_key(n, np.zeros(numel, np.float32))
+        store.push_delta(n, np.ones(numel, np.float32))
+    plane = ServingPlane(store, replicas=1, retention=8)
+    plane.cut()
+    client = PullClient(plane, max_staleness_s=0.0)
+    client.pull()
+    full_bytes = client.bytes_received
+    store.push_delta(names[0], np.ones(numel, np.float32))
+    plane.cut()
+    client.pull()
+    delta_bytes = client.bytes_received - full_bytes
+    key_bytes = numel * 4
+    return {"model_bytes": keys * key_bytes,
+            "full_pull_bytes": full_bytes,
+            "delta_pull_bytes": delta_bytes,
+            "changed_key_bytes": key_bytes,
+            "ok": (full_bytes == keys * key_bytes
+                   and delta_bytes == key_bytes)}
+
+
+def measure(*, seconds: float = 2.0, clients: int = 4, keys: int = 8,
+            numel: int = 65536, replicas: int = 3,
+            staleness: float = 0.0) -> dict:
+    """The concurrent-read/write measurement.  One pusher thread keeps
+    training pushes landing (one cut per full key sweep, the per-step
+    publication pattern); ``clients`` threads pull as fast as they can
+    under the given staleness bound."""
+    import numpy as np
+
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.server.kv_store import KVStore
+    from byteps_tpu.server.serve_client import PullClient
+    from byteps_tpu.server.serving import ServingPlane
+
+    store = KVStore()
+    names = [f"serve.bench.{i}" for i in range(keys)]
+    rng = np.random.RandomState(0)
+    for n in names:
+        store.init_key(n, rng.randn(numel).astype(np.float32))
+    plane = ServingPlane(store, replicas=replicas, retention=16)
+    plane.cut()
+    # warm the hot-key histogram so replicas participate from the start
+    warm = PullClient(plane, max_staleness_s=0.0)
+    warm.pull()
+    plane.cut()
+
+    stop = threading.Event()
+    pushes = [0]
+
+    def pusher():
+        delta = np.ones(numel, np.float32) * 1e-3
+        i = 0
+        while not stop.is_set():
+            store.push_delta(names[i % keys], delta)
+            pushes[0] += 1
+            i += 1
+            if i % keys == 0:
+                plane.cut()
+
+    lat_lock = threading.Lock()
+    latencies: list = []
+    pull_counts = [0] * clients
+    errors = [0]
+
+    def puller(idx: int):
+        client = PullClient(plane, max_staleness_s=staleness)
+        mine = []
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                client.pull()
+            except Exception:  # noqa: BLE001 — an erroring read is the
+                # one thing the plane promises not to produce
+                errors[0] += 1
+                continue
+            mine.append((time.perf_counter() - t0) * 1e3)
+            pull_counts[idx] += 1
+        with lat_lock:
+            latencies.extend(mine)
+
+    push_thread = threading.Thread(target=pusher, daemon=True)
+    threads = [threading.Thread(target=puller, args=(i,), daemon=True)
+               for i in range(clients)]
+    c0 = counters.get("serve.cache_hits")
+    t0 = time.perf_counter()
+    push_thread.start()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    push_thread.join(timeout=10)
+    wall = time.perf_counter() - t0
+
+    total_pulls = sum(pull_counts)
+    lat = np.asarray(latencies) if latencies else np.asarray([0.0])
+    return {
+        "seconds": round(wall, 3),
+        "clients": clients,
+        "keys": keys,
+        "numel": numel,
+        "replicas": replicas,
+        "staleness_s": staleness,
+        "pulls": total_pulls,
+        "pulls_per_s": round(total_pulls / wall, 1),
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "pushes": pushes[0],
+        "pushes_per_s": round(pushes[0] / wall, 1),
+        "failed_reads": errors[0],
+        "cache_hits": counters.get("serve.cache_hits") - c0,
+        "replica_reads": counters.get("serve.replica_reads"),
+        "primary_reads": counters.get("serve.primary_reads"),
+        "snapshot_cuts": counters.get("serve.snapshot_cuts"),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seconds", type=float, default=3.0)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--keys", type=int, default=8)
+    p.add_argument("--numel", type=int, default=65536)
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--staleness", type=float, default=0.0)
+    args = p.parse_args(argv)
+    out = measure(seconds=args.seconds, clients=args.clients,
+                  keys=args.keys, numel=args.numel,
+                  replicas=args.replicas, staleness=args.staleness)
+    out["delta"] = delta_check()
+    print(json.dumps(out))
+    return 0 if (out["failed_reads"] == 0 and out["delta"]["ok"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
