@@ -171,6 +171,42 @@ class Config:
     #                                  last resort when nothing is
     #                                  installed
 
+    # --- gray-failure tolerance (utils/slowness.py, docs/gray_failures.md) ---
+    straggler_policy: str = "wait"   # BYTEPS_STRAGGLER_POLICY: what the
+    #                                  stack does about a slow-but-alive
+    #                                  rank — wait (observe only: scores
+    #                                  exported, nothing acts) | hedge
+    #                                  (serving pulls fire a backup to a
+    #                                  replica after the adaptive hedge
+    #                                  delay) | demote (the membership
+    #                                  bus moves a sustained straggler
+    #                                  onto the probation list via
+    #                                  shrink-to-survivors; it rejoins
+    #                                  at a step boundary once healthy)
+    slowness_phi: float = 8.0        # BYTEPS_SLOWNESS_PHI: phi-accrual
+    #                                  suspicion threshold above which a
+    #                                  peer counts as slow (8 = one in
+    #                                  10^8 under healthy behavior)
+    slowness_window: int = 64        # BYTEPS_SLOWNESS_WINDOW: latency
+    #                                  samples retained per (site, peer)
+    straggler_demote_after: int = 3  # BYTEPS_STRAGGLER_DEMOTE_AFTER:
+    #                                  consecutive slow step barriers
+    #                                  before the bus demotes (hysteresis
+    #                                  against one-off stalls)
+    straggler_min_lag_s: float = 0.25
+    #                                  BYTEPS_STRAGGLER_MIN_LAG: absolute
+    #                                  floor a rank's step-barrier lag
+    #                                  must exceed to count as slow — the
+    #                                  phi score self-calibrates, so
+    #                                  without a floor microsecond jitter
+    #                                  in an otherwise-idle world could
+    #                                  score "astronomical"
+    serve_hedge_ms: float = 0.0      # BYTEPS_SERVE_HEDGE_MS: fixed hedge
+    #                                  delay for serving pulls; 0 =
+    #                                  adaptive (p99 of recent winning
+    #                                  pull latencies, the tail-tolerant
+    #                                  default)
+
     # --- elastic membership (fault/membership.py) ---
     elastic: bool = False            # BYTEPS_ELASTIC: elastic-membership
     #                                  mode — survivors shrink in place and
@@ -369,6 +405,20 @@ class Config:
             raise ValueError("integrity_max_retransmits must be >= 0")
         if self.bus_max_frame <= 0:
             raise ValueError("bus_max_frame must be positive")
+        if self.straggler_policy not in ("wait", "hedge", "demote"):
+            raise ValueError(
+                f"BYTEPS_STRAGGLER_POLICY must be wait, hedge, or demote "
+                f"— got {self.straggler_policy!r}")
+        if self.slowness_phi <= 0:
+            raise ValueError("slowness_phi must be positive")
+        if self.slowness_window < 8:
+            raise ValueError("slowness_window must be >= 8")
+        if self.straggler_demote_after < 1:
+            raise ValueError("straggler_demote_after must be >= 1")
+        if self.straggler_min_lag_s < 0:
+            raise ValueError("straggler_min_lag_s must be >= 0")
+        if self.serve_hedge_ms < 0:
+            raise ValueError("serve_hedge_ms must be >= 0 (0 = adaptive)")
         if self.serve_replicas < 1:
             raise ValueError("serve_replicas must be >= 1 (1 = primary "
                              "only, no replication)")
@@ -440,6 +490,15 @@ class Config:
             failure_exit_code=_env_int("BYTEPS_FAILURE_EXIT_CODE", 17),
             sync_deadline_s=_env_float("BYTEPS_SYNC_DEADLINE_S", 0.0),
             membership_hosts=_env_str("BYTEPS_MEMBERSHIP_HOSTS", ""),
+            straggler_policy=_env_str("BYTEPS_STRAGGLER_POLICY",
+                                      "wait").strip().lower(),
+            slowness_phi=_env_float("BYTEPS_SLOWNESS_PHI", 8.0),
+            slowness_window=_env_int("BYTEPS_SLOWNESS_WINDOW", 64),
+            straggler_demote_after=_env_int(
+                "BYTEPS_STRAGGLER_DEMOTE_AFTER", 3),
+            straggler_min_lag_s=_env_float("BYTEPS_STRAGGLER_MIN_LAG",
+                                           0.25),
+            serve_hedge_ms=_env_float("BYTEPS_SERVE_HEDGE_MS", 0.0),
             serve_replicas=_env_int("BYTEPS_SERVE_REPLICAS", 1),
             serve_retention=_env_int("BYTEPS_SERVE_RETENTION", 8),
             serve_hot_keys=_env_int("BYTEPS_SERVE_HOT_KEYS", 8),

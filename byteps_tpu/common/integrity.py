@@ -377,6 +377,14 @@ def wire_transmit(frame: bytes, *, key: str, worker: int, seq: int,
     if attempts["n"] > 1:
         record_span("retransmit", t0, key=key, worker=worker, seq=seq,
                     attempts=attempts["n"])
+    # Slowness feed (utils/slowness.py): the hop's wall time — including
+    # any retransmit rounds — attributed to the hop's peer id, so a peer
+    # whose frames are chronically slow/corrupt scores as SLOW before it
+    # ever scores as dead.  Peer ids are per-site namespaces (pusher
+    # worker on push sites, serving endpoint on serve_pull).  Lazy
+    # import: utils pulls in checkpoint → core.api at package init.
+    from ..utils import slowness as _slowness
+    _slowness.tracker().observe(worker, time.monotonic() - t0, site=site)
     return out
 
 

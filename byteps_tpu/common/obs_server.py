@@ -126,6 +126,11 @@ def debug_state() -> dict:
             "capacity": _flight.recorder._ring.maxlen,
         },
     }
+    # gray-failure view (utils/slowness.py): per-(site, peer) latency
+    # medians + phi scores, with the labeled gauges re-stamped so a
+    # /metrics scrape that follows this sees the same figures
+    from ..utils import slowness as _slowness
+    doc["slowness"] = _slowness.tracker().publish_gauges()
     m = _membership.active_membership()
     if m is not None:
         v = m.view()

@@ -292,6 +292,8 @@ def metrics_snapshot(light: bool = False) -> Dict[str, Any]:
     }
     if not light:
         snap["histograms"] = reg["histograms"]
+        from ..utils import slowness as _slowness
+        snap["slowness"] = _slowness.tracker().snapshot()
     eng = _engine
     if eng is not None:
         snap["speed_mbps"] = round(eng.speed.speed()[1], 3)
@@ -379,4 +381,9 @@ def cluster_metrics(bus: Optional[str] = None,
     for k in ("coordinator", "standby", "bus_rank"):
         if reply.get(k) is not None:
             out[k] = reply[k]
+    # gray-failure columns (ISSUE 10): per-rank step-barrier slowness
+    # scores and the probation list — bps_top renders SLOW/STATE from
+    # these, and empty is meaningful ("nobody is slow")
+    out["slow"] = {int(r): v for r, v in (reply.get("slow") or {}).items()}
+    out["probation"] = [int(r) for r in (reply.get("probation") or ())]
     return out

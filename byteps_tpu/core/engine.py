@@ -1037,8 +1037,18 @@ class PushPullEngine:
                             # completion — the step's sync-stall share
                             # (the un-overlapped remainder of
                             # communication)
-                            self.step_stats.add_stall(
-                                (time.perf_counter() - t_blk) * 1e3)
+                            dt_blk = time.perf_counter() - t_blk
+                            self.step_stats.add_stall(dt_blk * 1e3)
+                            # slowness feed: this process's own
+                            # data-path latency — the self-reported
+                            # half of gray-failure detection (the bus's
+                            # step-barrier lags are the cross-rank
+                            # half).  Imported lazily: utils pulls in
+                            # checkpoint → core.api, a cycle at engine
+                            # import time.
+                            from ..utils import slowness as _slowness
+                            _slowness.tracker().observe(
+                                self.cfg.host_id, dt_blk, site="sync")
                 finally:
                     if self._deadline_on:
                         with self._sync_block_lock:

@@ -14,6 +14,7 @@ with epoch/keys/parameters handed over by a survivor.
 """
 
 from .injector import FaultInjector, arm, disarm  # noqa: F401
-from .membership import (ElasticMembership, Evicted,  # noqa: F401
-                         MembershipTimeout, MembershipView, WorldChanged)
+from .membership import (Demoted, ElasticMembership,  # noqa: F401
+                         Evicted, MembershipTimeout, MembershipView,
+                         WorldChanged)
 from .recovery import RecoveryCoordinator, RecoveryResult  # noqa: F401

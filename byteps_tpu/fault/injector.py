@@ -29,12 +29,27 @@ Spec grammar (``BYTEPS_FAULT_SPEC``, ``;``- or ``,``-separated faults)::
                                    value with prob 0.001
     straggler:rank=2:ms=50         rank 2 sleeps 50ms at every dispatch
     drop:site=heartbeat:p=0.2      drop 20% of heartbeat sends
+    slow:rank=1:site=sync:ms=300:n=20   GRAY failure: rank 1 sleeps
+                                   300ms at EVERY visit of the sync
+                                   site for its first 20 visits, then
+                                   the fault clears (``n`` absent =
+                                   slow forever).  Unlike ``delay``
+                                   (probabilistic one-shots) this is a
+                                   sustained per-rank throttle — the
+                                   slow-but-alive condition the
+                                   straggler chaos lane injects — and
+                                   unlike ``straggler`` it has a
+                                   bounded window, so recovery and
+                                   probation readmission are testable
 
 Fields: ``rank`` (int, default: every rank), ``step`` (int, kill only),
 ``site`` (one of :data:`VALID_SITES`), ``p`` (probability in (0, 1],
-default 1), ``ms`` (sleep milliseconds), ``code`` (kill exit code,
-default 1 — a *crash*, distinct from the detector's restartable
-``BYTEPS_FAILURE_EXIT_CODE``).
+default 1), ``ms`` (sleep milliseconds), ``n`` (visit budget, slow
+only), ``code`` (kill exit code, default 1 — a *crash*, distinct from
+the detector's restartable ``BYTEPS_FAILURE_EXIT_CODE``).  The set of
+fields each kind accepts is exactly :data:`_KIND_FIELDS` — the master
+table :data:`_FIELDS` is *derived* from it, so the two cannot drift
+(pinned kind-by-field by tests/test_fault_injector.py).
 
 Sites (where the hooks are woven):
 
@@ -89,21 +104,29 @@ _active: Optional["FaultInjector"] = None
 # from zero and cascade-kill the new coordinator.
 _lifetime_step = 0
 
+# Process-lifetime visit accounting for `slow` rules (keyed by the
+# rule's identity): a gray fault is a property of the HOST, not of one
+# engine incarnation — an elastic suspend/resume (a demoted rank's
+# rejoin!) re-arms the injector from config, and without this a slow
+# fault whose n= window had already CLEARED would come back fresh and
+# immediately re-demote the readmitted rank.
+_slow_consumed: Dict[str, int] = {}
+
 
 def _reset_lifetime_for_tests() -> None:
     global _lifetime_step
     _lifetime_step = 0
+    _slow_consumed.clear()
 
 # monkeypatch point for tests (a real os._exit would take pytest with it)
 _exit = os._exit
 
-VALID_KINDS = ("bitflip", "delay", "drop", "kill", "straggler")
+VALID_KINDS = ("bitflip", "delay", "drop", "kill", "slow", "straggler")
 VALID_SITES = ("coordinator", "dcn", "dispatch", "heartbeat", "kv_push",
                "serve_pull", "server_pull", "server_push", "sync")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
 CORRUPT_SITES = ("kv_push", "serve_pull", "server_push")
-_FIELDS = ("rank", "step", "site", "p", "ms", "code")
 # fields each kind actually reads — anything else is rejected, not
 # silently ignored (kill:p=0.1 must fail loudly, not kill
 # deterministically while the operator believes it is probabilistic)
@@ -111,18 +134,30 @@ _KIND_FIELDS = {
     "kill": ("rank", "step", "site", "code"),
     "delay": ("rank", "site", "p", "ms"),
     "straggler": ("rank", "site", "ms"),
+    "slow": ("rank", "site", "ms", "n"),
     "drop": ("rank", "site", "p"),
     "bitflip": ("rank", "site", "p"),
 }
+# the master field set is DERIVED from the per-kind tables: a field a
+# kind reads but the master list forgot (or vice versa) is structurally
+# impossible, instead of a drift the parser rejects at runtime
+_FIELDS = tuple(sorted({f for fs in _KIND_FIELDS.values() for f in fs}))
+assert set(_KIND_FIELDS) == set(VALID_KINDS)
 
 
 class FaultRule:
-    """One parsed fault clause plus its private deterministic RNG."""
+    """One parsed fault clause plus its private deterministic RNG.
 
-    __slots__ = ("kind", "site", "rank", "step", "p", "ms", "code", "rng")
+    ``left`` is the mutable visit budget of a ``slow`` rule (counts down
+    from ``n``; ``None`` = unbounded) — the one piece of rule state that
+    changes over a run, guarded by the injector's lock."""
+
+    __slots__ = ("kind", "site", "rank", "step", "p", "ms", "code", "n",
+                 "left", "skey", "rng")
 
     def __init__(self, kind: str, site: Optional[str], rank: Optional[int],
-                 step: Optional[int], p: float, ms: float, code: int):
+                 step: Optional[int], p: float, ms: float, code: int,
+                 n: Optional[int] = None):
         self.kind = kind
         self.site = site
         self.rank = rank
@@ -130,11 +165,14 @@ class FaultRule:
         self.p = p
         self.ms = ms
         self.code = code
+        self.n = n
+        self.left = n
+        self.skey: Optional[str] = None  # lifetime-budget key (slow only)
         self.rng: Optional[random.Random] = None  # bound by FaultInjector
 
     def __repr__(self) -> str:  # actionable in logs and error messages
         parts = [self.kind]
-        for f in ("site", "rank", "step", "p", "ms"):
+        for f in ("site", "rank", "step", "p", "ms", "n"):
             v = getattr(self, f)
             if v is not None:
                 parts.append(f"{f}={v}")
@@ -203,8 +241,9 @@ def parse_spec(spec: str) -> List[FaultRule]:
             p = float(fields.get("p", "1"))
             ms = float(fields.get("ms", "0"))
             code = int(fields.get("code", "1"))
+            n = int(fields["n"]) if "n" in fields else None
         except ValueError:
-            raise _fail(spec, clause, "rank/step/code must be integers, "
+            raise _fail(spec, clause, "rank/step/code/n must be integers, "
                                       "p/ms numbers") from None
         if not 0.0 < p <= 1.0:
             raise _fail(spec, clause, f"p={p} must be in (0, 1]")
@@ -235,7 +274,15 @@ def parse_spec(spec: str) -> List[FaultRule]:
             if ms <= 0:
                 raise _fail(spec, clause, "straggler needs ms=N > 0")
             site = site or "dispatch"
-        rules.append(FaultRule(kind, site, rank, step, p, ms, code))
+        if kind == "slow":
+            if ms <= 0:
+                raise _fail(spec, clause, "slow needs ms=N > 0 (the "
+                                          "sustained per-visit delay)")
+            if n is not None and n <= 0:
+                raise _fail(spec, clause,
+                            "slow n=N (visit budget) must be > 0")
+            site = site or "dispatch"
+        rules.append(FaultRule(kind, site, rank, step, p, ms, code, n))
     if not rules:
         raise ValueError(
             f"BYTEPS_FAULT_SPEC={spec!r} contains no fault clauses")
@@ -259,6 +306,12 @@ class FaultInjector:
         for i, r in enumerate(self.rules):
             # string seeding: stable across processes (no hash salt)
             r.rng = random.Random(f"{seed}/{i}/{r.kind}/{r.site}")
+            if r.kind == "slow" and r.n is not None:
+                # resume the lifetime visit budget: a re-armed schedule
+                # (elastic suspend/resume) continues the SAME fault
+                # window instead of restarting it
+                r.skey = f"{seed}/{i}/{r.site}/{r.rank}/{r.ms}/{r.n}"
+                r.left = max(0, r.n - _slow_consumed.get(r.skey, 0))
         self._by_site: Dict[str, List[FaultRule]] = {}
         for r in self.rules:
             if r.site is not None:
@@ -307,7 +360,8 @@ class FaultInjector:
             _exit(r.code)
 
     def fire(self, site: str) -> None:
-        """Visit a site: apply delay/straggler sleeps scheduled there."""
+        """Visit a site: apply delay/straggler/slow sleeps scheduled
+        there."""
         for r in self._by_site.get(site, ()):
             if r.kind == "delay":
                 if r.rank is not None and r.rank != self.rank:
@@ -319,6 +373,35 @@ class FaultInjector:
                 if r.rank is None or r.rank == self.rank:
                     counters.inc("fault.straggler")
                     time.sleep(r.ms / 1000.0)
+            elif r.kind == "slow":
+                if r.rank is not None and r.rank != self.rank:
+                    continue
+                # sustained per-rank throttle with a bounded visit
+                # budget: decremented under the lock (sites fire from
+                # several threads), and its exhaustion — the gray fault
+                # CLEARING — is announced once so the straggler lane
+                # can pin "readmitted after the fault window ends"
+                with self._lock:
+                    if r.left is not None:
+                        if r.left <= 0:
+                            continue
+                        r.left -= 1
+                        if r.skey is not None:
+                            _slow_consumed[r.skey] = \
+                                _slow_consumed.get(r.skey, 0) + 1
+                        cleared = r.left == 0
+                    else:
+                        cleared = False
+                counters.inc("fault.slow")
+                if cleared:
+                    counters.inc("fault.slow_cleared")
+                    from ..common import flight_recorder as _flight
+                    _flight.record("fault.slow_cleared", site=site,
+                                   rank=self.rank, n=r.n)
+                    get_logger().warning(
+                        "fault injector: slow fault at %s cleared after "
+                        "%d visits (rank %d)", site, r.n, self.rank)
+                time.sleep(r.ms / 1000.0)
 
     def should_drop(self, site: str) -> bool:
         """True when a drop rule says to suppress this message."""

@@ -75,6 +75,27 @@ world at the next epoch.  Members parked in a step sync are released
 with ``reconcile=True`` and join it; whoever is wedged-dead never
 hellos and is dropped by the rendezvous timeout.  The bus turns
 "something is stuck" into "exactly who is gone".
+
+Gray failures — probation-based demotion (ISSUE 10): a rank that is
+slow-but-ALIVE (throttled chip, degraded NIC) completes every quorum,
+just late, so nothing above ever fires while every barrier waits on it.
+The bus is the one place that SEES this: :meth:`_BusServer._do_sync`
+stamps each rank's arrival time per barrier, scores arrival lags with a
+phi-accrual tracker (``utils/slowness.py``, ``site="step_sync"``), and
+folds in self-reported sync-deadline trips from the metrics piggyback.
+Under ``BYTEPS_STRAGGLER_POLICY=demote`` a rank slow for
+``straggler_demote_after`` consecutive barriers is **demoted**: the
+round answers every member with a ``demote`` signal — survivors reuse
+shrink-to-survivors (:meth:`ElasticMembership.demote`), while the
+straggler itself raises :class:`Demoted` (NOT :class:`Evicted`): it
+stays alive on the bus's **probation list**, recovers at its own pace
+(``utils.slowness.wait_recovered``), and returns through the ordinary
+:meth:`ElasticMembership.rejoin` step-boundary admission, which clears
+its probation entry.  The probation list replicates to the standby with
+the rest of the bus state, and the current coordinator is exempt from
+demotion (its slowness escalates through the crash-failover path
+instead — demoting the process that hosts the bus would race its own
+takeover).  See docs/gray_failures.md.
 """
 
 from __future__ import annotations
@@ -96,8 +117,8 @@ from ..common.telemetry import counters
 
 __all__ = [
     "MembershipView", "ElasticMembership", "WorldChanged", "Evicted",
-    "MembershipTimeout", "current_epoch", "advance_epoch", "set_epoch",
-    "resolve_bus_addr", "bus_request", "active_membership",
+    "Demoted", "MembershipTimeout", "current_epoch", "advance_epoch",
+    "set_epoch", "resolve_bus_addr", "bus_request", "active_membership",
 ]
 
 
@@ -185,6 +206,24 @@ class WorldChanged(RuntimeError):
 class Evicted(RuntimeError):
     """This rank is not in the agreed world (the survivors shrank past
     it).  Exit restartable and come back through rejoin()."""
+
+
+class Demoted(RuntimeError):
+    """The bus demoted THIS rank onto the probation list — a sustained
+    straggler under ``BYTEPS_STRAGGLER_POLICY=demote``.  Deliberately
+    not an :class:`Evicted`: the rank is slow, not dead — stay alive,
+    wait out the local condition (``utils.slowness.wait_recovered``
+    against a small data-path probe), then come back through
+    :meth:`ElasticMembership.rejoin` at a step boundary; admission
+    clears the probation entry."""
+
+    def __init__(self, view: MembershipView, probation):
+        super().__init__(
+            f"demoted to probation: the world moved on to epoch "
+            f"{view.epoch + 1} without this rank (probation list: "
+            f"{sorted(probation)}); recover, then rejoin()")
+        self.view = view
+        self.probation = sorted(probation)
 
 
 class MembershipTimeout(TimeoutError):
@@ -398,6 +437,28 @@ class _BusServer:
         # every sync (and may metrics_put explicitly); the metrics verb
         # answers from here in one round-trip (core/api.cluster_metrics)
         self._metrics: Dict[int, Tuple[float, Any]] = {}
+        # -- gray-failure state (ISSUE 10, docs/gray_failures.md) ----------
+        # The bus scores each rank's STEP-BARRIER ARRIVAL LAG: a
+        # slow-but-alive rank completes every quorum, just last — the
+        # one cross-rank signal that attributes "everyone waits on R".
+        from ..common.config import get_config
+        from ..utils.slowness import SlownessTracker
+        cfg = get_config()
+        self._straggler_policy = cfg.straggler_policy
+        self._phi = cfg.slowness_phi
+        self._demote_after = cfg.straggler_demote_after
+        self._min_lag = cfg.straggler_min_lag_s
+        self._slow = SlownessTracker(window=cfg.slowness_window)
+        # (epoch, step) -> {rank: monotonic arrival}; rounds already
+        # scored (scoring runs once per completed barrier)
+        self._arrive: Dict[Tuple[int, int], Dict[int, float]] = {}
+        self._scored: set = set()
+        self._slow_rounds: Dict[int, int] = {}   # consecutive slow barriers
+        self._deadline_seen: Dict[int, int] = {}  # last seen trip counters
+        # rank -> {"since": wall ts, "score": phi at demotion}: demoted
+        # ranks awaiting recovery; cleared by rejoin admission
+        self._probation: Dict[int, dict] = {}
+        self._demote_pending: Optional[Tuple[int, int]] = None  # (epoch, rank)
         if seed and seed.get("epoch", -1) >= view.epoch:
             self.epoch = int(seed["epoch"])
             self.world = set(int(r) for r in (seed.get("world")
@@ -415,6 +476,11 @@ class _BusServer:
                                for r in (seed.get("join_wait") or ())}
             self._metrics = {int(r): tuple(v)
                              for r, v in (seed.get("metrics") or {}).items()}
+            # probation survives a coordinator failover: a demoted rank
+            # must still be readmittable (and visible as demoted, not
+            # forgotten) through the successor bus
+            self._probation = {int(r): dict(v) for r, v in
+                               (seed.get("probation") or {}).items()}
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -464,6 +530,7 @@ class _BusServer:
             "join_wait": sorted(r for r, v in self._join_wait.items()
                                 if v is None),
             "metrics": dict(self._metrics),
+            "probation": {r: dict(v) for r, v in self._probation.items()},
         }
 
     # -- serving -----------------------------------------------------------
@@ -535,8 +602,12 @@ class _BusServer:
                 pass
 
     def _stale_reply(self) -> dict:
+        # probation rides every stale reply so a demoted rank that syncs
+        # late (it raced the demote signal) learns it is demoted — not
+        # evicted — from the same reply that tells it the world moved
         return {"ok": False, "stale": True, "epoch": self.epoch,
-                "world": sorted(self.world)}
+                "world": sorted(self.world),
+                "probation": sorted(self._probation)}
 
     def _pending_rendezvous(self) -> Optional[int]:
         """The highest proposed epoch of an in-flight hello rendezvous
@@ -560,6 +631,11 @@ class _BusServer:
                 self._metrics[rank] = (time.time(), msg["metrics"])
             if epoch != self.epoch:
                 return self._stale_reply()
+            if (self._demote_pending is not None
+                    and self._demote_pending[0] == self.epoch):
+                # a demotion was decided this epoch: every member of the
+                # doomed round (and any late sync) gets the signal
+                return self._demote_reply()
             pe = self._pending_rendezvous()
             if pe is not None:
                 # a shrink/reconcile rendezvous is in flight: this round
@@ -567,6 +643,9 @@ class _BusServer:
                 return {"ok": False, "reconcile": True, "pending_epoch": pe,
                         "epoch": self.epoch, "world": sorted(self.world)}
             key = (epoch, step)
+            # arrival stamp: the straggler signal is WHEN each rank
+            # reached this barrier relative to the round's first arrival
+            self._arrive.setdefault(key, {})[rank] = time.monotonic()
             self._sync.setdefault(key, {})[rank] = msg.get("payload")
             if msg.get("state") is not None:
                 # the state a member carries at step s is its state
@@ -580,6 +659,8 @@ class _BusServer:
             for k in [k for k in self._sync if k[1] < step - 4]:
                 self._sync.pop(k, None)
                 self._snapshots.pop(k, None)
+                self._arrive.pop(k, None)
+                self._scored.discard(k)
             self._cv.notify_all()
             while not self._stop.is_set():
                 if self.epoch != epoch:
@@ -587,6 +668,9 @@ class _BusServer:
                     # round was parked: the payloads are void, retry the
                     # step at the new epoch
                     return self._stale_reply()
+                if (self._demote_pending is not None
+                        and self._demote_pending[0] == self.epoch):
+                    return self._demote_reply()
                 pe = self._pending_rendezvous()
                 if pe is not None:
                     return {"ok": False, "reconcile": True,
@@ -596,6 +680,13 @@ class _BusServer:
                 joins_parked = any(v is None
                                    for v in self._join_wait.values())
                 if set(got) >= self.world:
+                    # gray-failure scoring on the COMPLETED barrier (one
+                    # pass per round): may decide a demotion, in which
+                    # case this round's reply IS the demote signal
+                    self._score_round(key)
+                    if (self._demote_pending is not None
+                            and self._demote_pending[0] == self.epoch):
+                        return self._demote_reply()
                     if joins_parked and key in self._snapshots:
                         self._admit(key)
                         continue  # epoch changed: loop → stale reply
@@ -618,6 +709,86 @@ class _BusServer:
                 self._cv.wait(min(remaining, 0.25))
         return self._stale_reply()
 
+    def _demote_reply(self) -> dict:
+        """The demote signal every member of a doomed round receives
+        (caller holds the condition): survivors turn it into
+        ``ElasticMembership.demote`` (a shrink), the target into
+        :class:`Demoted` (park on probation, recover, rejoin)."""
+        return {"ok": False, "demote": self._demote_pending[1],
+                "probation": sorted(self._probation),
+                "epoch": self.epoch, "world": sorted(self.world)}
+
+    def _score_round(self, key: Tuple[int, int]) -> None:
+        """Score one COMPLETED step barrier (caller holds the condition;
+        runs once per round).
+
+        Per-rank arrival lag against the round's first arrival feeds the
+        bus-side phi tracker (``site="step_sync"``); the metrics
+        piggyback contributes self-reported ``engine.sync_deadline_trips``
+        deltas (a rank whose own units blow the data-path deadline is
+        slow even if it somehow makes the barrier on time).  A rank is
+        *slow this round* when its lag clears BOTH the absolute floor
+        (``straggler_min_lag_s`` — phi self-calibrates, so an idle
+        world's microsecond jitter must not score) and the phi threshold
+        (``slowness_phi``), or when it reported fresh deadline trips.
+        ``straggler_demote_after`` consecutive slow rounds under
+        ``BYTEPS_STRAGGLER_POLICY=demote`` demote it — except the
+        current coordinator (it hosts this bus; its slowness escalates
+        through the crash-failover path instead)."""
+        if key in self._scored:
+            return
+        self._scored.add(key)
+        arrivals = self._arrive.get(key) or {}
+        if len(arrivals) < 2:
+            return
+        first = min(arrivals.values())
+        slow_now = set()
+        for r, t in arrivals.items():
+            lag = t - first
+            self._slow.observe(r, lag, site="step_sync")
+            ent = self._metrics.get(r)
+            trips = 0
+            if ent is not None and isinstance(ent[1], dict):
+                trips = int((ent[1].get("counters") or {}).get(
+                    "engine.sync_deadline_trips", 0) or 0)
+            tripped = trips > self._deadline_seen.get(r, trips)
+            if trips > self._deadline_seen.get(r, 0):
+                self._deadline_seen[r] = trips
+            if tripped or (lag >= self._min_lag
+                           and self._slow.score(r, site="step_sync")
+                           >= self._phi):
+                slow_now.add(r)
+        for r in arrivals:
+            self._slow_rounds[r] = (self._slow_rounds.get(r, 0) + 1
+                                    if r in slow_now else 0)
+        if (self._straggler_policy != "demote"
+                or self._demote_pending is not None
+                or len(self.world) < 2):
+            return
+        coordinator = min(self.world)
+        candidates = [r for r in sorted(slow_now)
+                      if r in self.world and r != coordinator
+                      and self._slow_rounds.get(r, 0) >= self._demote_after]
+        if not candidates:
+            return
+        # one demotion at a time, worst straggler first: the world
+        # change resets every counter, so a second straggler re-earns
+        # its consecutive rounds under the new world
+        target = max(candidates, key=lambda r: self._slow_rounds[r])
+        score = round(self._slow.score(target, site="step_sync"), 2)
+        self._probation[target] = {"since": time.time(), "score": score}
+        self._demote_pending = (self.epoch, target)
+        self._slow_rounds[target] = 0
+        counters.inc("membership.straggler_demote_decided")
+        _flight.record("membership.straggler_demote", rank=target,
+                       epoch=self.epoch, score=score,
+                       consecutive=self._demote_after)
+        get_logger().error(
+            "membership bus: rank %d is a sustained straggler (phi %.1f, "
+            "%d consecutive slow barriers) — demoting to probation, world "
+            "shrinks without it", target, score, self._demote_after)
+        self._cv.notify_all()
+
     def _admit(self, key: Tuple[int, int]) -> None:
         """Admit every parked joiner at this completed step boundary
         (caller holds the condition)."""
@@ -629,7 +800,28 @@ class _BusServer:
                 "declared": declared, "step": state_step, "state": state}
         for r in joiners:
             self._join_wait[r] = dict(info)
+            # the joiner is a FRESH incarnation: its sync_deadline_trips
+            # counter restarts, so the high-water mark from the dead
+            # incarnation must go too — otherwise new trips stay masked
+            # until they exceed the old lifetime total
+            self._deadline_seen.pop(r, None)
+            if self._probation.pop(r, None) is not None:
+                # a demoted straggler came back healthy: readmission IS
+                # the end of probation
+                counters.inc("membership.probation_readmitted")
+                _flight.record("membership.probation_readmitted",
+                               rank=r, epoch=self.epoch)
+                get_logger().warning(
+                    "membership bus: rank %d readmitted from probation "
+                    "(epoch %d)", r, self.epoch)
         counters.inc("membership.rejoin_admitted", len(joiners))
+        # the admission moved the world: a stale pending demotion is
+        # void and every consecutive-slow counter restarts (a rejoiner
+        # must re-earn any accusation under the new world)
+        if (self._demote_pending is not None
+                and self.epoch > self._demote_pending[0]):
+            self._demote_pending = None
+        self._slow_rounds.clear()
         get_logger().warning(
             "membership bus: admitted rank(s) %s at step boundary %d — "
             "epoch %d, world %s", joiners, key[1], self.epoch,
@@ -693,6 +885,16 @@ class _BusServer:
         self._hellos = {e: v for e, v in self._hellos.items() if e > epoch}
         # release every sync round parked under the dead epoch
         self._sync = {k: v for k, v in self._sync.items() if k[0] >= epoch}
+        self._arrive = {k: v for k, v in self._arrive.items()
+                        if k[0] >= epoch}
+        self._scored = {k for k in self._scored if k[0] >= epoch}
+        # a pending demotion is consumed by the agreement that applied
+        # it; consecutive-slow counters restart under the new world
+        # (readmitted or resized worlds re-earn any accusation)
+        if (self._demote_pending is not None
+                and epoch > self._demote_pending[0]):
+            self._demote_pending = None
+        self._slow_rounds.clear()
         counters.inc("membership.shrink_agreed")
         get_logger().warning("membership bus: agreed epoch %d, world %s",
                              epoch, world)
@@ -750,6 +952,12 @@ class _BusServer:
                     "coordinator": min(self.world) if self.world else None,
                     "standby": self._standby_rank(),
                     "bus_rank": self.host_rank,
+                    # gray-failure view: per-rank step-barrier phi
+                    # scores + who is demoted right now — bps_top's
+                    # SLOW/STATE columns read these
+                    "slow": {r: round(s, 2) for r, s in
+                             self._slow.scores(site="step_sync").items()},
+                    "probation": sorted(self._probation),
                     "ranks": {r: {"age_s": round(now - t, 3), "metrics": m}
                               for r, (t, m) in self._metrics.items()}}
 
@@ -773,7 +981,8 @@ class _BusServer:
                     "world": sorted(self.world),
                     "coordinator": min(self.world) if self.world else None,
                     "standby": self._standby_rank(),
-                    "bus_rank": self.host_rank}
+                    "bus_rank": self.host_rank,
+                    "probation": sorted(self._probation)}
 
 
 # -- the per-process membership object --------------------------------------
@@ -1233,11 +1442,35 @@ class ElasticMembership:
         if reply.get("stale"):
             new = MembershipView(reply["epoch"], tuple(reply["world"]))
             if self.rank not in new.world:
+                if self.rank in set(reply.get("probation") or ()):
+                    # demoted, not dead: a probation rank that syncs
+                    # again (it raced the demote signal, or retried)
+                    # must not exit restartable — it recovers and
+                    # rejoins instead
+                    raise Demoted(new, reply.get("probation") or ())
                 raise Evicted(
                     f"rank {self.rank} is outside the agreed world "
                     f"{list(new.world)} (epoch {new.epoch})")
             self._maybe_apply(new)
             raise WorldChanged(new)
+        if reply.get("demote") is not None:
+            # the bus demoted a sustained straggler out of this round:
+            # nobody consumes the round's payloads — the target parks on
+            # probation, every survivor applies the demotion (a shrink)
+            # and retries the step at the new epoch
+            target = int(reply["demote"])
+            cur = MembershipView(reply["epoch"], tuple(reply["world"]))
+            if target == self.rank:
+                counters.inc("membership.demoted")
+                _flight.record("membership.demoted", rank=self.rank,
+                               epoch=cur.epoch,
+                               probation=list(reply.get("probation") or ()))
+                get_logger().error(
+                    "membership: this rank (%d) was demoted to probation "
+                    "as a sustained straggler — recover locally, then "
+                    "rejoin()", self.rank)
+                raise Demoted(cur, reply.get("probation") or ())
+            raise WorldChanged(self.demote(target))
         if reply.get("reconcile"):
             # a shrink/reconcile rendezvous is already in flight on the
             # bus: join it instead of waiting out a doomed quorum — this
@@ -1284,6 +1517,25 @@ class ElasticMembership:
                 "elastic transition failed — exiting %d so the launcher "
                 "can restart", code, exc_info=True)
             _exit(code)
+
+    def demote(self, rank: int) -> MembershipView:
+        """Apply a bus-decided straggler demotion: move ``rank`` out of
+        the data-path world onto probation, reusing shrink-to-survivors
+        wholesale — the epoch guard, drain/suspend, rendezvous, and
+        resume are exactly a shrink's.  The difference is entirely in
+        bookkeeping and intent: the bus keeps the rank on its probation
+        list (it is slow, not dead), the rank itself got :class:`Demoted`
+        instead of :class:`Evicted`, and it returns through the ordinary
+        :meth:`rejoin` admission once ``utils.slowness.wait_recovered``
+        says its local data path is healthy again."""
+        rank = int(rank)
+        counters.inc("membership.straggler_demote")
+        _flight.record("membership.straggler_demote_applied",
+                       rank=rank, by=self.rank, epoch=self._view.epoch)
+        get_logger().warning(
+            "membership: demoting straggler rank %d to probation "
+            "(shrink-to-survivors; it rejoins when healthy)", rank)
+        return self.shrink({rank})
 
     def shrink(self, stale: Set[int]) -> MembershipView:
         """Drop ``stale`` ranks: epoch guard up → drain/suspend →

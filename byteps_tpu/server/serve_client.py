@@ -44,7 +44,8 @@ class PullClient:
 
     def __init__(self, plane, keys: Optional[List[str]] = None,
                  max_staleness_s: Optional[float] = None,
-                 prefetch: bool = False):
+                 prefetch: bool = False,
+                 hedge: Optional[bool] = None):
         from ..common.config import get_config
         self._plane = plane
         self._keys = list(keys) if keys is not None else None
@@ -52,6 +53,10 @@ class PullClient:
                                 if max_staleness_s is None
                                 else max_staleness_s)
         self.prefetch = prefetch
+        # per-client hedging override (None = the plane's policy): a
+        # tail-sensitive consumer opts in even when the plane default
+        # is sequential, and vice versa (docs/gray_failures.md)
+        self.hedge = hedge
         self._cache: Dict[str, np.ndarray] = {}
         self._versions: Dict[str, int] = {}
         self._codecs: Dict[str, object] = {}
@@ -125,7 +130,7 @@ class PullClient:
         aged out of retention server-side)."""
         with self._refresh_lock:
             reply = self._plane.pull(since_id=self._snapshot_id,
-                                     keys=self._keys)
+                                     keys=self._keys, hedge=self.hedge)
             # build the updated view ASIDE and publish it with one
             # reference swap: a concurrent non-blocking pull slicing
             # the cache mid-refresh must see snapshot N or N+1 whole,

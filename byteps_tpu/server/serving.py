@@ -59,6 +59,8 @@ from ..common import integrity as _integrity
 from ..common.logging import get_logger
 from ..common.telemetry import counters, gauges, histograms
 from ..fault import injector as _fault
+from ..utils.slowness import LatencyQuantile
+from ..utils import slowness as _slowness
 from .kv_store import KVStore
 from .sharding import ServerAssigner
 
@@ -259,6 +261,11 @@ class SnapshotServer:
         # never be re-shipped until the key next changes
         self.partial = partial
         self.alive = True
+        # gray-failure chaos hook (docs/gray_failures.md): a per-ENDPOINT
+        # sustained delay — the slow-but-alive serving replica the
+        # hedged-pull path exists for (the injector's `slow` kind is
+        # per-process; this hook throttles ONE endpoint of a plane)
+        self.delay_s = 0.0
 
     def kill(self) -> None:
         """Chaos hook: the endpoint stops answering (a dead replica)."""
@@ -308,6 +315,14 @@ class SnapshotServer:
             counters.inc("serve.unavailable")
             raise ServeUnavailable(
                 f"serving endpoint {self.server_id} is down")
+        if self.delay_s:
+            # the slow-but-alive endpoint: answers correctly, late (the
+            # per-ENDPOINT gray-failure hook; the injector's per-process
+            # `slow`/`delay` kinds keep firing per shipped key at the
+            # existing serve_pull hop — a second entry-point fire here
+            # would double-inject and burn `n=` budgets off-count)
+            counters.inc("serve.slow_endpoint_delays")
+            time.sleep(self.delay_s)
         snap = self.ring.latest()
         if snap is None:
             counters.inc("serve.unavailable")
@@ -411,7 +426,8 @@ class ServingPlane:
                  retention: Optional[int] = None,
                  hot_keys: Optional[int] = None,
                  cut_interval_s: Optional[float] = None,
-                 assigner: Optional[ServerAssigner] = None):
+                 assigner: Optional[ServerAssigner] = None,
+                 hedge: Optional[bool] = None):
         from ..common.config import get_config
         cfg = get_config()
         n = cfg.serve_replicas if replicas is None else replicas
@@ -419,6 +435,23 @@ class ServingPlane:
             raise ValueError("replicas must be >= 1 (the primary)")
         self.store = store
         self.num_endpoints = n
+        # Hedged pulls (ISSUE 10, docs/gray_failures.md): fire a backup
+        # pull to the next replica when the first endpoint has not
+        # answered within the hedge delay — first response wins, losers
+        # are discarded (reads are idempotent; the seq-token machinery
+        # that makes PUSHES idempotent is what lets a duplicated wire
+        # frame downstream be dropped harmlessly).  Off by default: the
+        # per-pull thread costs real throughput, so it is the explicit
+        # `hedge=True` / BYTEPS_STRAGGLER_POLICY=hedge trade — bounded
+        # tail latency under one slow serving endpoint for overhead on
+        # every hedged pull.  Delay: BYTEPS_SERVE_HEDGE_MS fixed, or
+        # (default 0) adaptive — the p99 of recent WINNING pull
+        # latencies, so the observed-latency ring never learns the slow
+        # endpoint's figure as "normal".
+        self._hedge = (cfg.straggler_policy == "hedge" if hedge is None
+                       else bool(hedge))
+        self._hedge_ms = cfg.serve_hedge_ms
+        self._hedge_lat = LatencyQuantile()
         self.assigner = assigner if assigner is not None else ServerAssigner(
             num_servers=n, fn="djb2", mixed_mode=False, bound=101,
             replicas=n, hot_keys=(cfg.serve_hot_keys if hot_keys is None
@@ -548,10 +581,15 @@ class ServingPlane:
 
     def pull(self, since_id: Optional[int] = None,
              keys: Optional[List[str]] = None,
-             record: bool = True) -> ServeReply:
+             record: bool = True,
+             hedge: Optional[bool] = None) -> ServeReply:
         """One routed pull: fan across the replica set for hot keys,
         degrade to the primary on any replica failure — a pull fails
-        only when the PRIMARY cannot answer."""
+        only when the PRIMARY cannot answer.  With hedging on (plane
+        default or per-call ``hedge=``) and at least one eligible
+        replica, the attempts race instead of running sequentially:
+        the backup fires after the hedge delay and the first response
+        wins, so no single slow endpoint owns the tail."""
         t0 = time.perf_counter()
         # resolve keys=None to the latest snapshot's key list, NOT
         # store.keys(): the hot read path must not contend on the live
@@ -563,21 +601,138 @@ class ServingPlane:
             wanted = list(snap.versions) if snap is not None else []
         if record:
             self.assigner.record_pulls(wanted)
-        reply = None
-        for rep in self._read_candidates(wanted, since_id):
-            try:
-                reply = rep.pull(since_id=since_id, keys=wanted)
-                counters.inc("serve.replica_reads")
-                break
-            except ServeUnavailable:
-                counters.inc("serve.replica_fallback")
-                continue
-        if reply is None:
-            reply = self.primary.pull(since_id=since_id, keys=keys)
-            counters.inc("serve.primary_reads")
+        cands = self._read_candidates(wanted, since_id)
+        use_hedge = self._hedge if hedge is None else bool(hedge)
+        if use_hedge and cands:
+            reply = self._pull_hedged(cands, since_id, keys, wanted)
+        else:
+            reply = None
+            for rep in cands:
+                try:
+                    reply = rep.pull(since_id=since_id, keys=wanted)
+                    counters.inc("serve.replica_reads")
+                    break
+                except ServeUnavailable:
+                    counters.inc("serve.replica_fallback")
+                    continue
+            if reply is None:
+                reply = self.primary.pull(since_id=since_id, keys=keys)
+                counters.inc("serve.primary_reads")
         counters.inc("serve.pulls")
         histograms.observe("serve.pull_ms",
                            (time.perf_counter() - t0) * 1e3)
+        return reply
+
+    # -- hedging -------------------------------------------------------------
+
+    def _hedge_delay_s(self) -> float:
+        """How long the first attempt gets before the backup fires:
+        the fixed BYTEPS_SERVE_HEDGE_MS when set, else the p99 of
+        recent winning pull latencies (floored so scheduler jitter
+        cannot hedge every pull, capped so a cold ring cannot park the
+        tail)."""
+        if self._hedge_ms > 0:
+            return self._hedge_ms / 1e3
+        q = self._hedge_lat.quantile(0.99)
+        if q is None:
+            return 0.002          # cold start: no history yet
+        return min(max(q, 0.0005), 0.25)
+
+    def _pull_hedged(self, cands: List[SnapshotServer],
+                     since_id: Optional[int], keys: Optional[List[str]],
+                     wanted: List[str]) -> ServeReply:
+        """Race the read candidates: fire the first, then one more per
+        elapsed hedge delay until something answers.  First successful
+        response wins; late duplicates are counted and dropped
+        (``serve.hedge_discarded``) — a pull is idempotent, so
+        discarding is the whole duplicate story.  A candidate that
+        FAILS fast (``ServeUnavailable``) does not consume the budget
+        forever: once every attempt has failed and none succeeded, the
+        primary's error propagates exactly as on the sequential path.
+        Every attempt's latency feeds the slowness tracker
+        (``site="serve_pull"``), so a chronically slow endpoint is
+        visible in ``/debug/state`` and ``bps_top`` even while hedging
+        hides it from clients."""
+        endpoints: List[Tuple[SnapshotServer, Optional[List[str]]]] = [
+            (rep, wanted) for rep in cands]
+        endpoints.append((self.primary, keys))
+        done = threading.Event()
+        wake = threading.Event()   # ANY attempt outcome (win or failure)
+        lock = threading.Lock()
+        state = {"reply": None, "winner": None, "failed": 0, "exc": None}
+        total = len(endpoints)
+
+        def attempt(ep: SnapshotServer, ep_keys, hedged: bool) -> None:
+            t0 = time.perf_counter()
+            try:
+                r = ep.pull(since_id=since_id, keys=ep_keys)
+            except Exception as e:  # noqa: BLE001 — ServeUnavailable is
+                # the routing signal; anything else still must COUNT
+                # (an uncounted dead attempt would park the final wait
+                # forever) and propagates if nothing answers
+                with lock:
+                    state["failed"] += 1
+                    state["exc"] = e
+                    if state["failed"] >= total and state["reply"] is None:
+                        done.set()
+                wake.set()
+                return
+            dt = time.perf_counter() - t0
+            _slowness.tracker().observe(ep.server_id, dt, site="serve_pull")
+            with lock:
+                if state["reply"] is None:
+                    state["reply"] = r
+                    state["winner"] = ep
+                    # winners only: the delay ring must keep describing
+                    # HEALTHY latency, not learn the straggler's
+                    self._hedge_lat.observe(dt)
+                    if hedged:
+                        counters.inc("serve.hedge_wins")
+                    done.set()
+                else:
+                    counters.inc("serve.hedge_discarded")
+            wake.set()
+
+        delay = self._hedge_delay_s()
+        launched = 0
+        answered = False
+        for i, (ep, ep_keys) in enumerate(endpoints):
+            threading.Thread(target=attempt, args=(ep, ep_keys, i > 0),
+                             daemon=True, name="bps-serve-hedge").start()
+            launched += 1
+            if i == 1:
+                counters.inc("serve.hedged_pulls")
+            if i == total - 1 or answered:
+                break
+            # wait out the hedge delay — but wake on every attempt
+            # outcome: an answer stops hedging, and fast failures
+            # covering EVERY launched attempt fire the next candidate
+            # immediately (a dead leading replica must not tax each
+            # pull the full delay when the sequential path would fall
+            # through instantly)
+            deadline = time.monotonic() + delay
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not wake.wait(remaining):
+                    break   # delay elapsed: hedge
+                wake.clear()
+                with lock:
+                    if state["reply"] is not None:
+                        answered = True
+                        break
+                    if state["failed"] >= launched:
+                        break   # everyone so far failed: next, NOW
+            if answered:
+                break
+        done.wait()
+        with lock:
+            reply, winner = state["reply"], state["winner"]
+            exc = state["exc"]
+        if reply is None:
+            raise exc if exc is not None else ServeUnavailable(
+                "no serving endpoint answered the hedged pull")
+        counters.inc("serve.primary_reads" if winner is self.primary
+                     else "serve.replica_reads")
         return reply
 
     # -- elastic -------------------------------------------------------------

@@ -1,5 +1,5 @@
-"""Utilities: checkpoint/resume, failure detection, timing, HLO wire
-accounting."""
+"""Utilities: checkpoint/resume, failure detection, slowness scoring,
+timing, HLO wire accounting."""
 
 from .checkpoint import (  # noqa: F401
     CheckpointManager,
@@ -8,5 +8,6 @@ from .checkpoint import (  # noqa: F401
     save_checkpoint,
 )
 from .failure_detector import HeartbeatMonitor, StepWatchdog  # noqa: F401
+from .slowness import LatencyQuantile, SlownessTracker  # noqa: F401
 from .prefetch import ShardedBatchLoader, prefetch_to_device  # noqa: F401
 from .timing import Timer, throughput  # noqa: F401

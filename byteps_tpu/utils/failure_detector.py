@@ -112,6 +112,17 @@ def _default_on_failure(stale: Set[int]) -> None:
     _exit(code)
 
 
+# Single-flight latch for data-path stall evidence: the sync-deadline
+# watchdog and the step watchdog are SEPARATE threads observing the same
+# wedge, and during an in-flight elastic transition both can fire inside
+# one detection window.  The second report while the first is still
+# being acted on must be a no-op — two concurrent escalations would
+# double-run the failure action (or, uninstalled, double-fire os._exit
+# mid-shrink).  Released when the action returns, so a LATER, distinct
+# stall still escalates.
+_stall_inflight = threading.Lock()
+
+
 def data_path_stalled(gap_s: float, detail: str = "") -> None:
     """Failure evidence from the DATA path: a sync unit
     (``BYTEPS_SYNC_DEADLINE_S``, core/engine.py) or a whole step
@@ -125,21 +136,39 @@ def data_path_stalled(gap_s: float, detail: str = "") -> None:
     rendezvous whose timeout identifies exactly who is gone
     (fault/membership.py).  Without an installed action the restartable
     ``os._exit`` remains the escalation of last resort: a wedged
-    collective cannot be cancelled in-process."""
+    collective cannot be cancelled in-process.
+
+    Single-flight: a report arriving while another is still being acted
+    on (a stall observed by two watchdog threads, or one landing during
+    an in-flight elastic shrink the first report started) is logged and
+    dropped — the in-flight handler owns the escalation."""
     from ..common import flight_recorder as _flight
-    _flight.record("failure_detector.data_path_stall",
-                   gap_s=round(gap_s, 3), detail=detail)
-    _flight.dump("data_path_stall")
-    action = _installed_action
-    if action is not None:
-        action(set())
+    if not _stall_inflight.acquire(blocking=False):
+        from ..common.telemetry import counters
+        counters.inc("failure_detector.stall_suppressed")
+        _flight.record("failure_detector.stall_suppressed",
+                       gap_s=round(gap_s, 3), detail=detail)
+        get_logger().warning(
+            "data path stall report (%.1fs, %s) suppressed: another "
+            "stall report is already being acted on", gap_s,
+            detail or "no detail")
         return
-    code = _failure_exit_code()
-    get_logger().error(
-        "data path stalled for %.1fs (%s) and no in-process failure "
-        "action is installed — exiting %d so the launcher can restart",
-        gap_s, detail or "no detail", code)
-    _exit(code)
+    try:
+        _flight.record("failure_detector.data_path_stall",
+                       gap_s=round(gap_s, 3), detail=detail)
+        _flight.dump("data_path_stall")
+        action = _installed_action
+        if action is not None:
+            action(set())
+            return
+        code = _failure_exit_code()
+        get_logger().error(
+            "data path stalled for %.1fs (%s) and no in-process failure "
+            "action is installed — exiting %d so the launcher can restart",
+            gap_s, detail or "no detail", code)
+        _exit(code)
+    finally:
+        _stall_inflight.release()
 
 
 class HeartbeatMonitor:

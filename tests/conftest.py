@@ -95,7 +95,9 @@ def _fresh_telemetry():
     from byteps_tpu.common import flight_recorder as _flight
     from byteps_tpu.common import metrics as _metrics
     from byteps_tpu.common import obs_server as _obs
+    from byteps_tpu.utils import slowness as _slowness
     _obs.stop_server()
     _metrics.registry.reset()
     _metrics._reset_components_for_tests()
     _flight._reset_for_tests()
+    _slowness._reset_for_tests()

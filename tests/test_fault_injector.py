@@ -14,7 +14,8 @@ import pytest
 from byteps_tpu.common.telemetry import counters
 from byteps_tpu.fault import injector as inj_mod
 from byteps_tpu.fault.injector import (CORRUPT_SITES, FaultInjector,
-                                       VALID_KINDS, VALID_SITES, parse_spec)
+                                       VALID_KINDS, VALID_SITES,
+                                       _FIELDS, _KIND_FIELDS, parse_spec)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -73,6 +74,151 @@ def test_error_lists_every_valid_kind_and_site():
         parse_spec("delay:site=bogus")
     for s in VALID_SITES:
         assert s in str(ei.value)
+
+
+# --- table-driven kind × field validation (ISSUE 10 satellite) --------------
+#
+# The master field list is DERIVED from the per-kind tables, and this
+# test sweeps EVERY kind × field combination: a field a kind reads must
+# parse, anything else must be rejected with the actionable "no effect"
+# message — per-kind drift (e.g. delay/drop silently losing rank=) is
+# structurally pinned.
+
+# a minimal valid clause per kind, to which one extra field is appended
+_BASE_CLAUSE = {
+    "kill": "kill:step=3",
+    "delay": "delay:site=dcn",
+    "straggler": "straggler:ms=5",
+    "slow": "slow:ms=5",
+    "drop": "drop:site=heartbeat",
+    "bitflip": "bitflip:site=server_push",
+}
+# a value valid for each field (site chosen per kind: kill only accepts
+# the coordinator predicate, bitflip only corrupt-woven sites)
+_SITE_FOR = {"kill": "coordinator", "bitflip": "server_push"}
+
+
+def _field_value(kind, field):
+    if field == "site":
+        return _SITE_FOR.get(kind, "dcn")
+    return {"rank": "1", "step": "3", "p": "0.5", "ms": "5",
+            "code": "9", "n": "4"}[field]
+
+
+def test_master_field_table_is_derived_from_kind_tables():
+    assert set(_KIND_FIELDS) == set(VALID_KINDS)
+    assert set(_FIELDS) == {f for fs in _KIND_FIELDS.values() for f in fs}
+
+
+@pytest.mark.parametrize("kind", VALID_KINDS)
+@pytest.mark.parametrize("field", _FIELDS)
+def test_every_kind_field_combination(kind, field):
+    clause = f"{_BASE_CLAUSE[kind]}:{field}={_field_value(kind, field)}"
+    if field in _KIND_FIELDS[kind]:
+        rules = parse_spec(clause)
+        assert rules[0].kind == kind
+        # an ACCEPTED field must land on the rule, not be dropped
+        if field == "rank":
+            assert rules[0].rank == 1
+        elif field == "n":
+            assert rules[0].n == 4
+    else:
+        with pytest.raises(ValueError, match="no effect on"):
+            parse_spec(clause)
+
+
+@pytest.mark.parametrize("kind,site", [
+    ("delay", "dcn"), ("drop", "heartbeat"), ("straggler", "dispatch"),
+    ("slow", "dispatch"),
+])
+def test_rank_filter_is_honored_by_every_sleep_and_drop_kind(kind, site,
+                                                             monkeypatch):
+    """rank= must FILTER, not merely parse: an injector whose process
+    rank differs never fires the rule."""
+    slept = []
+    monkeypatch.setattr(inj_mod.time, "sleep", slept.append)
+    clause = {"delay": "delay:rank=1:site=dcn:p=1:ms=5",
+              "drop": "drop:rank=1:site=heartbeat:p=1",
+              "straggler": "straggler:rank=1:ms=5",
+              "slow": "slow:rank=1:ms=5"}[kind]
+    other = FaultInjector(clause, rank=0)
+    mine = FaultInjector(clause, rank=1)
+    if kind == "drop":
+        assert not other.should_drop(site)
+        assert mine.should_drop(site)
+    else:
+        other.fire(site)
+        assert slept == []
+        mine.fire(site)
+        assert slept == [0.005]
+
+
+# --- the slow kind (gray failures) ------------------------------------------
+
+
+def test_slow_validation():
+    with pytest.raises(ValueError, match="ms=N > 0"):
+        parse_spec("slow:rank=1")
+    with pytest.raises(ValueError, match="visit budget"):
+        parse_spec("slow:ms=5:n=0")
+    with pytest.raises(ValueError, match="no effect on 'slow'"):
+        parse_spec("slow:ms=5:p=0.5")
+    r = parse_spec("slow:rank=2:ms=300:n=20")[0]
+    assert (r.rank, r.ms, r.n, r.site) == (2, 300.0, 20, "dispatch")
+    assert parse_spec("slow:site=sync:ms=10")[0].n is None
+
+
+def test_slow_is_sustained_and_budget_clears(monkeypatch):
+    inj_mod._reset_lifetime_for_tests()
+    counters.reset()
+    slept = []
+    monkeypatch.setattr(inj_mod.time, "sleep", slept.append)
+    inj = FaultInjector("slow:site=sync:ms=100:n=3", rank=0)
+    for _ in range(6):
+        inj.fire("sync")
+    # sustained for exactly the n-visit window, then the fault CLEARS
+    assert slept == [0.1, 0.1, 0.1]
+    assert counters.get("fault.slow") == 3
+    assert counters.get("fault.slow_cleared") == 1
+    # unbounded form never clears
+    slept.clear()
+    inj2 = FaultInjector("slow:site=sync:ms=50", rank=0)
+    for _ in range(5):
+        inj2.fire("sync")
+    assert slept == [0.05] * 5
+    assert counters.get("fault.slow_cleared") == 1
+
+
+def test_slow_budget_survives_rearm(monkeypatch):
+    """An elastic suspend/resume re-arms the injector from config; a
+    slow window that already cleared must STAY cleared — otherwise a
+    demoted rank's rejoin would resurrect the very fault it recovered
+    from and be re-demoted forever."""
+    inj_mod._reset_lifetime_for_tests()
+    slept = []
+    monkeypatch.setattr(inj_mod.time, "sleep", slept.append)
+    spec = "slow:site=sync:ms=100:n=2"
+    inj = inj_mod.arm(spec, seed=3, rank=0)
+    inj.fire("sync")
+    inj.fire("sync")
+    inj.fire("sync")
+    assert slept == [0.1, 0.1]
+    inj_mod.disarm()
+    # the re-armed incarnation resumes the CONSUMED budget
+    inj2 = inj_mod.arm(spec, seed=3, rank=0)
+    inj2.fire("sync")
+    assert slept == [0.1, 0.1]
+    inj_mod.disarm()
+    # partial consumption carries over too
+    inj_mod._reset_lifetime_for_tests()
+    inj3 = inj_mod.arm(spec, seed=3, rank=0)
+    inj3.fire("sync")
+    inj_mod.disarm()
+    inj4 = inj_mod.arm(spec, seed=3, rank=0)
+    inj4.fire("sync")
+    inj4.fire("sync")
+    assert slept == [0.1, 0.1, 0.1, 0.1]   # 1 + 1 more, then cleared
+    inj_mod._reset_lifetime_for_tests()
 
 
 # --- determinism ------------------------------------------------------------

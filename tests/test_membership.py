@@ -94,7 +94,8 @@ def test_bus_sync_wrong_epoch_is_stale():
                      rendezvous_timeout_s=1.0, sync_timeout_s=2.0)
     try:
         r = _req(port, {"op": "sync", "rank": 0, "epoch": 1, "step": 7})
-        assert r == {"ok": False, "stale": True, "epoch": 3, "world": [0]}
+        assert r == {"ok": False, "stale": True, "epoch": 3, "world": [0],
+                     "probation": []}
     finally:
         bus.close()
 

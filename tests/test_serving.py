@@ -675,6 +675,104 @@ def test_partial_replica_refuses_uncovered_keys_router_degrades():
     assert counters.get("serve.replica_fallback") >= 1
 
 
+# -- hedged pulls (ISSUE 10, chaos straggler lane) ---------------------------
+
+
+def test_hedge_off_by_default_and_policy_knobs():
+    from byteps_tpu.common.config import set_config
+    s = _store(["hk.a"])
+    assert not ServingPlane(s)._hedge            # wait = sequential
+    assert ServingPlane(s, hedge=True)._hedge    # explicit opt-in
+    set_config(Config(straggler_policy="hedge"))
+    try:
+        assert ServingPlane(s)._hedge            # policy default
+        assert not ServingPlane(s, hedge=False)._hedge   # override wins
+    finally:
+        reset_config()
+
+
+def test_hedge_delay_fixed_and_adaptive():
+    from byteps_tpu.common.config import set_config
+    s = _store(["hd.a"])
+    plane = ServingPlane(s, hedge=True)
+    assert plane._hedge_delay_s() == 0.002       # cold: no history yet
+    for _ in range(50):
+        plane._hedge_lat.observe(0.004)
+    plane._hedge_lat.observe(0.020)              # one slow winner
+    # adaptive = p99 of recent WINNING latencies, clamped
+    assert plane._hedge_delay_s() == pytest.approx(0.020)
+    set_config(Config(serve_hedge_ms=5.0))
+    try:
+        assert ServingPlane(s, hedge=True)._hedge_delay_s() == 0.005
+    finally:
+        reset_config()
+
+
+@pytest.mark.chaos
+def test_hedged_pull_bounds_tail_under_one_slow_replica():
+    """Acceptance direction: one serving endpoint slow-but-alive (the
+    gray failure) — hedged pulls answer from a backup after the hedge
+    delay, so the tail stops tracking the slow endpoint's 80ms, while
+    every reply stays correct and late duplicates are discarded."""
+    s, plane = _warm_plane(["hg.a", "hg.b"], replicas=3)
+    plane._hedge = True
+    slow = plane.replicas[0]
+    slow.delay_s = 0.08
+    client = PullClient(plane, max_staleness_s=0.0, hedge=True)
+    lats = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        vals = client.pull()
+        lats.append(time.perf_counter() - t0)
+        # correctness never hedged away
+        assert vals["hg.a"][0] == 1.0 and vals["hg.b"][0] == 1.0
+    lats.sort()
+    # the slow endpoint sits in the rotation, so WITHOUT hedging a
+    # large fraction of pulls would cost >= 80ms; hedged, the tail is
+    # bounded by hedge-delay + a healthy pull (generous CI margin)
+    assert lats[int(len(lats) * 0.9)] < 0.04, lats
+    assert counters.get("serve.hedged_pulls") > 0
+    assert counters.get("serve.hedge_wins") > 0
+    # the slow endpoint's late replies were discarded, not double-used
+    time.sleep(0.15)
+    assert counters.get("serve.hedge_discarded") > 0
+    assert counters.get("serve.unavailable") == 0
+    # the slowness tracker saw per-endpoint latency: the slow endpoint
+    # is VISIBLE even while hedging hides it from clients
+    from byteps_tpu.utils import slowness as _slowness
+    snap = _slowness.tracker().snapshot()
+    assert "serve_pull" in snap
+    assert snap["serve_pull"][slow.server_id]["median_ms"] >= 50.0
+
+
+@pytest.mark.chaos
+def test_hedged_pull_survives_dead_candidates_and_raises_when_all_dead():
+    s, plane = _warm_plane(["hx.a"], replicas=3)
+    plane._hedge = True
+    for rep in plane.replicas:
+        rep.kill()
+    # dead replicas: the hedge race still lands on the primary
+    reply = plane.pull()
+    assert reply.server_id == 0
+    # everything dead: the failure propagates like the sequential path
+    plane.primary.kill()
+    from byteps_tpu.server.serving import ServeUnavailable
+    with pytest.raises(ServeUnavailable):
+        plane.pull()
+
+
+def test_pull_client_hedge_override_reaches_the_plane():
+    s, plane = _warm_plane(["hc.a", "hc.b"], replicas=3)
+    assert not plane._hedge                      # plane default: off
+    before = counters.get("serve.hedged_pulls")
+    slow = plane.replicas[0]
+    slow.delay_s = 0.05
+    client = PullClient(plane, max_staleness_s=0.0, hedge=True)
+    for _ in range(6):
+        client.pull()
+    assert counters.get("serve.hedged_pulls") > before
+
+
 # -- the bench tool ----------------------------------------------------------
 
 def test_serve_bench_reports_throughput_latency_and_delta_accounting():

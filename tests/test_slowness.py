@@ -1,0 +1,199 @@
+"""Slowness scoring units (utils/slowness.py): phi-accrual behavior,
+the latency-quantile helper behind adaptive hedge delays, the probation
+recovery loop, and the engine/metrics feeds (ISSUE 10 tentpole part 1)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import byteps_tpu.core.api as api
+from byteps_tpu.common.config import Config
+from byteps_tpu.common.telemetry import gauges
+from byteps_tpu.utils.slowness import (PHI_MAX, LatencyQuantile,
+                                       SlownessTracker, wait_recovered)
+from byteps_tpu.utils import slowness as slowness_mod
+
+
+# -- SlownessTracker ---------------------------------------------------------
+
+
+def test_uniform_peers_score_low():
+    tr = SlownessTracker(window=32)
+    for _ in range(20):
+        for r in (0, 1, 2):
+            tr.observe(r, 0.010, site="sync")
+    for r in (0, 1, 2):
+        assert tr.score(r, site="sync") < 2.0, tr.scores(site="sync")
+
+
+def test_one_slow_peer_scores_high_others_stay_low():
+    tr = SlownessTracker(window=32)
+    for _ in range(20):
+        tr.observe(0, 0.010, site="sync")
+        tr.observe(1, 0.011, site="sync")
+        tr.observe(2, 0.350, site="sync")   # the straggler
+    scores = tr.scores(site="sync")
+    assert scores[2] >= 8.0, scores
+    assert scores[0] < 2.0 and scores[1] < 2.0, scores
+    # the straggler's median is visible too
+    assert tr.latency(2, site="sync") == pytest.approx(0.35, rel=0.01)
+
+
+def test_identical_baseline_clamps_at_phi_max():
+    """A zero-variance healthy population makes any outlier
+    astronomically improbable: the score must CLAMP, not overflow."""
+    tr = SlownessTracker(window=32)
+    for _ in range(16):
+        tr.observe(0, 0.010, site="s")
+        tr.observe(1, 0.010, site="s")
+        tr.observe(2, 10.0, site="s")
+    assert tr.score(2, site="s") == PHI_MAX
+
+
+def test_single_peer_scores_against_own_history():
+    """With no peers at a site, the baseline is the peer's own older
+    half — a sudden sustained slowdown still scores."""
+    tr = SlownessTracker(window=32)
+    for _ in range(12):
+        tr.observe(0, 0.010, site="solo")
+    for _ in range(12):
+        tr.observe(0, 0.400, site="solo")
+    assert tr.score(0, site="solo") >= 8.0
+    # and a peer with a steady history does not
+    tr2 = SlownessTracker(window=32)
+    for _ in range(24):
+        tr2.observe(0, 0.010, site="solo")
+    assert tr2.score(0, site="solo") < 2.0
+
+
+def test_window_bound_and_recovery():
+    """The bounded window forgets: after the slow phase ends, enough
+    healthy samples bring the score back down (the readmission
+    hysteresis depends on this)."""
+    tr = SlownessTracker(window=16)
+    for _ in range(16):
+        tr.observe(0, 0.010, site="s")
+        tr.observe(1, 0.400, site="s")
+    assert tr.score(1, site="s") >= 8.0
+    for _ in range(16):
+        tr.observe(0, 0.010, site="s")
+        tr.observe(1, 0.010, site="s")   # recovered
+    assert tr.score(1, site="s") < 2.0
+
+
+def test_score_without_site_takes_worst_site():
+    tr = SlownessTracker(window=32)
+    for _ in range(16):
+        tr.observe(1, 0.010, site="a")
+        tr.observe(2, 0.010, site="a")
+        tr.observe(1, 0.500, site="b")
+        tr.observe(2, 0.010, site="b")
+    assert tr.score(1) >= 8.0          # slow at site b only
+    assert tr.score(1, site="a") < 2.0
+
+
+def test_snapshot_shape_and_gauges():
+    tr = SlownessTracker(window=16)
+    for _ in range(10):
+        tr.observe(0, 0.010, site="sync")
+        tr.observe(1, 0.300, site="sync")
+    snap = tr.snapshot()
+    assert set(snap) == {"sync"}
+    assert set(snap["sync"]) == {0, 1}
+    row = snap["sync"][1]
+    assert set(row) == {"n", "median_ms", "score"}
+    assert row["median_ms"] == pytest.approx(300.0, rel=0.01)
+    tr.publish_gauges()
+    assert gauges.get("slowness.max_score") >= 8.0
+    assert gauges.get("slowness.score", site="sync", rank=1) >= 8.0
+    assert gauges.get("slowness.score", site="sync", rank=0) < 2.0
+
+
+def test_window_validation_and_reset():
+    with pytest.raises(ValueError, match="window"):
+        SlownessTracker(window=4)
+    tr = SlownessTracker()
+    tr.observe(0, 1.0)
+    tr.reset()
+    assert tr.scores() == {} and tr.latency(0) == 0.0
+
+
+def test_module_tracker_honors_config_window(monkeypatch):
+    slowness_mod._reset_for_tests()
+    monkeypatch.setenv("BYTEPS_SLOWNESS_WINDOW", "16")
+    from byteps_tpu.common.config import reset_config
+    reset_config()
+    assert slowness_mod.tracker().window == 16
+    assert slowness_mod.tracker() is slowness_mod.tracker()  # singleton
+
+
+# -- LatencyQuantile ---------------------------------------------------------
+
+
+def test_latency_quantile_none_until_min_samples():
+    q = LatencyQuantile(window=32, min_samples=8)
+    for i in range(7):
+        q.observe(0.001 * (i + 1))
+        assert q.quantile(0.99) is None
+    q.observe(0.008)
+    assert q.quantile(0.99) == pytest.approx(0.008)
+
+
+def test_latency_quantile_values():
+    q = LatencyQuantile(window=100, min_samples=8)
+    for i in range(1, 101):
+        q.observe(i / 1000.0)
+    assert q.quantile(0.5) == pytest.approx(0.050)
+    assert q.quantile(0.99) == pytest.approx(0.099)
+    assert len(q) == 100
+
+
+# -- wait_recovered ----------------------------------------------------------
+
+
+def test_wait_recovered_waits_out_the_fault():
+    state = {"n": 0}
+
+    def probe():
+        state["n"] += 1
+        if state["n"] <= 4:
+            time.sleep(0.05)    # "slow" phase
+
+    assert wait_recovered(probe, baseline_s=0.01, factor=2.0,
+                          consecutive=3, interval_s=0.0, timeout_s=10.0)
+    # 4 slow probes, then 3 consecutive healthy ones
+    assert state["n"] == 7
+
+
+def test_wait_recovered_times_out_on_a_sustained_fault():
+    assert not wait_recovered(lambda: time.sleep(0.03), baseline_s=0.01,
+                              factor=2.0, consecutive=2,
+                              interval_s=0.0, timeout_s=0.3)
+
+
+# -- the engine feed ---------------------------------------------------------
+
+
+def test_engine_sync_loop_feeds_tracker():
+    """Every retired sync unit lands one `sync`-site sample for this
+    process's own rank — the self-reported half of gray-failure
+    detection (the bus's step-barrier lags are the cross-rank half)."""
+    slowness_mod._reset_for_tests()
+    api.init(Config(telemetry_on=True))
+    try:
+        for i in range(4):
+            api._require().push_pull_local(
+                np.ones(8, np.float32), "slowfeed", op="sum")
+        snap = slowness_mod.tracker().snapshot()
+        assert "sync" in snap, snap
+        rank = Config().host_id
+        assert snap["sync"][rank]["n"] >= 4
+        # a healthy local engine must not accuse itself
+        assert snap["sync"][rank]["score"] < 8.0
+        # and the non-light metrics snapshot carries the same view
+        assert "slowness" in api.metrics_snapshot()
+    finally:
+        api.shutdown()

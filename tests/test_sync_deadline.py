@@ -137,6 +137,67 @@ def test_sync_deadline_routes_through_reconcile_not_exit(_clean_slate):
 
 
 @pytest.mark.chaos
+def test_concurrent_stall_reports_fire_the_action_once(_clean_slate):
+    """ISSUE 10 satellite: the sync-deadline watchdog and the step
+    watchdog are separate threads observing the same wedge — a second
+    ``data_path_stalled`` arriving while the first is still being acted
+    on must be suppressed, not double-run the failure action (or,
+    uninstalled, double-fire ``os._exit``)."""
+    import threading
+    exits = _clean_slate
+    calls = []
+    entered = threading.Event()
+
+    def slow_action(stale):
+        calls.append(set(stale))
+        entered.set()
+        time.sleep(0.5)         # the first report is still in flight...
+
+    fd.install_failure_action(slow_action)
+    t = threading.Thread(target=fd.data_path_stalled, args=(1.0, "first"))
+    t.start()
+    assert entered.wait(5.0)
+    fd.data_path_stalled(1.0, "second")     # ...when the second lands
+    t.join(timeout=5)
+    assert calls == [set()]                 # the action ran ONCE
+    assert counters.get("failure_detector.stall_suppressed") == 1
+    assert exits == []
+    # sequential reports (a later, distinct stall) still escalate
+    fd.data_path_stalled(2.0, "third")
+    assert len(calls) == 2
+
+
+@pytest.mark.chaos
+def test_stall_during_inflight_shrink_does_not_double_exit(_clean_slate):
+    """Regression guard: a watchdog stall landing DURING an in-flight
+    elastic transition (epoch already advanced by the shrink) resolves
+    through the membership's already-moving-world path — never a second
+    ``os._exit`` racing the transition."""
+    import threading
+    exits = _clean_slate
+    port = _free_port()
+    m = mm.ElasticMembership(0, [0], f"127.0.0.1:{port}",
+                             rendezvous_timeout_s=2.0,
+                             sync_timeout_s=5.0).start()
+    try:
+        fd.install_failure_action(m.on_failure)
+        # an in-flight transition: another thread is applying epoch 1
+        applier = threading.Thread(
+            target=lambda: m._maybe_apply(mm.MembershipView(1, (0,))))
+        mm.set_epoch(1)          # the shrink's guard is already up
+        applier.start()
+        # the stall report arrives mid-transition: reconcile sees the
+        # epoch already moving and FOLLOWS it (wait_ready), no exit
+        fd.data_path_stalled(3.0, "watchdog during shrink")
+        applier.join(timeout=30)
+        assert m.view().epoch == 1
+        assert exits == [], exits
+    finally:
+        fd.install_failure_action(None)
+        m.stop()
+
+
+@pytest.mark.chaos
 def test_step_watchdog_default_prefers_installed_action(_clean_slate):
     """StepWatchdog's default stall action is demoted: with an installed
     failure action the evidence goes there (empty stale set); os._exit

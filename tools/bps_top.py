@@ -31,12 +31,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "RETX",
-            "PULLS", "EPOCH", "STEP", "AGE")
+            "PULLS", "SLOW", "STATE", "EPOCH", "STEP", "AGE")
 
 
-def _rank_row(rank: int, entry: dict) -> tuple:
+def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
     """One table row from a rank's cached snapshot (missing fields render
-    as '-': a rank mid-transition posts partial snapshots)."""
+    as '-': a rank mid-transition posts partial snapshots).  ``slow`` is
+    the bus's per-rank step-barrier phi score, ``probation`` the demoted
+    set — together they make a demotion watchable live: the score climbs,
+    STATE flips to PROBATION, and the rank leaves the world until it
+    recovers and rejoins (docs/gray_failures.md)."""
     m = entry.get("metrics") or {}
     gauges = m.get("gauges") or {}
     counters = m.get("counters") or {}
@@ -64,6 +68,10 @@ def _rank_row(rank: int, entry: dict) -> tuple:
         # serving plane (server/serving.py): cumulative pulls served by
         # this rank — 0 everywhere means the rank runs no read plane
         fmt(counters.get("serve.pulls", 0)),
+        # gray-failure columns: the coordinator's phi suspicion of this
+        # rank's step-barrier lag, and whether it is demoted right now
+        fmt(slow, "{:.1f}"),
+        "PROBATION" if rank in probation else "ok",
         fmt(m.get("epoch")),
         fmt(step.get("step")),
         fmt(entry.get("age_s"), "{:.1f}s"),
@@ -72,9 +80,16 @@ def _rank_row(rank: int, entry: dict) -> tuple:
 
 def render(cluster: dict) -> str:
     """The table for one cluster_metrics() reply (pure; unit-tested)."""
+    slow = cluster.get("slow") or {}
+    probation = set(cluster.get("probation") or ())
     rows = [_COLUMNS]
-    for rank in sorted(cluster.get("ranks", {})):
-        rows.append(_rank_row(rank, cluster["ranks"][rank]))
+    ranks = cluster.get("ranks", {})
+    # demoted ranks leave the world (and the metrics cache) but stay
+    # VISIBLE: a probation row with '-' metrics is the operator's cue
+    # that the rank is parked, not vanished
+    for rank in sorted(set(ranks) | probation):
+        rows.append(_rank_row(rank, ranks.get(rank, {}),
+                              slow=slow.get(rank), probation=probation))
     widths = [max(len(r[i]) for r in rows) for i in range(len(_COLUMNS))]
     head = "byteps_tpu cluster — epoch %s, world %s" % (
         cluster.get("epoch"), cluster.get("world"))
@@ -82,6 +97,8 @@ def render(cluster: dict) -> str:
         # who hosts the control plane, and who takes over if it dies
         head += " — coordinator=%s standby=%s" % (
             cluster.get("coordinator"), cluster.get("standby"))
+    if probation:
+        head += " — probation=%s" % sorted(probation)
     if cluster.get("failover_in_progress"):
         head += (" (COORDINATOR FAILOVER IN PROGRESS — bus not "
                  "answering, local-only view)")

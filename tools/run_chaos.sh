@@ -13,12 +13,19 @@
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
-#               | all
+#               | straggler | all
 #         serve: the serving-plane chaos slice — replica kill under
 #              concurrent training pushes (zero failed reads, primary
 #              degradation) and serve_pull reply corruption
 #              (NACK/retransmit to exact values)
 #              (tests/test_serving.py)
+#         straggler: the gray-failure slice — one rank under a
+#              sustained `slow` fault is demoted to probation
+#              (throughput recovers to the checked bound), readmitted
+#              once the fault window clears, and hedged pulls bound the
+#              serving tail under one slow endpoint
+#              (tests/test_straggler.py, tests/test_serving.py hedge
+#              tests, tests/test_sync_deadline.py stall guards)
 #         obs: the observability-under-chaos slice — every rank of a
 #              3-process chaos run serves /metrics//healthz, the
 #              membership bus answers cluster_metrics, and a
@@ -52,8 +59,28 @@ case "${1:-}" in
                  KEXPR="coordinator or sync_deadline or reconcile"
                  shift ;;
     serve)     MARK="chaos or integrity"; KEXPR="serve"; shift ;;
+    straggler) MARK="chaos"
+               KEXPR="straggler or demote or hedge or stall"
+               shift ;;
     all)       MARK="chaos or integrity"; shift ;;
 esac
+
+# Fail fast on an invalid ambient BYTEPS_FAULT_SPEC: the workers that
+# honor it would raise at init, but many lane tests *clear* the env var
+# before spawning — an operator's typo'd spec would then inject nothing
+# anywhere and the lane would count as passed while the intended chaos
+# never ran.  Validate up front and refuse loudly instead.
+if [ -n "${BYTEPS_FAULT_SPEC:-}" ]; then
+    if ! err=$(env JAX_PLATFORMS=cpu python -c \
+        "import os; from byteps_tpu.fault.injector import parse_spec; \
+parse_spec(os.environ['BYTEPS_FAULT_SPEC'])" 2>&1); then
+        echo "run_chaos.sh: refusing to run — the BYTEPS_FAULT_SPEC" \
+             "exported in this environment failed validation, so the" \
+             "lane would pass vacuously without the intended chaos:" >&2
+        echo "$err" | tail -3 >&2
+        exit 2
+    fi
+fi
 
 exec timeout -k 15 "$LANE" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$MARK" \
