@@ -129,6 +129,31 @@ class Config:
 
     # --- compression ---
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES
+    compress_autotune: bool = False  # BYTEPS_COMPRESS_AUTOTUNE: the
+    #                                  planner's COMPRESSOR ladder — per
+    #                                  tensor-size bucket, explore
+    #                                  none/onebit/randomk/topk (with
+    #                                  error feedback) round-robin and
+    #                                  lock the fastest candidate whose
+    #                                  codec-golden gradient error stays
+    #                                  under compress_error_ceiling.
+    #                                  Off by default (changing a codec
+    #                                  changes gradient values, so the
+    #                                  operator opts in); tensors pushed
+    #                                  with explicit compression= kwargs
+    #                                  are pinned and never tuned, and
+    #                                  multi-process runs never tune
+    #                                  (SPMD lockstep) — the same pin
+    #                                  semantics as the chunk planner
+    compress_error_ceiling: float = 0.55
+    #                                  BYTEPS_COMPRESS_ERROR_CEILING:
+    #                                  max codec-golden gradient error
+    #                                  (compression.registry.golden_error
+    #                                  — EF-corrected residual mass over
+    #                                  8 repeated pushes) a ladder
+    #                                  candidate may carry and still be
+    #                                  explored; quality gate of the
+    #                                  wall-time race
 
     # --- native core ---
     use_native: bool = True          # BYTEPS_NATIVE: C++ scheduler/reducer
@@ -419,6 +444,12 @@ class Config:
             raise ValueError("straggler_min_lag_s must be >= 0")
         if self.serve_hedge_ms < 0:
             raise ValueError("serve_hedge_ms must be >= 0 (0 = adaptive)")
+        if self.min_compress_bytes < 0:
+            raise ValueError("min_compress_bytes must be >= 0")
+        if not 0 < self.compress_error_ceiling <= 1.0:
+            raise ValueError(
+                "compress_error_ceiling must be in (0, 1] — it is a "
+                "relative gradient-error bound")
         if self.serve_replicas < 1:
             raise ValueError("serve_replicas must be >= 1 (1 = primary "
                              "only, no replication)")
@@ -465,6 +496,9 @@ class Config:
             credit_pinned=("BYTEPS_SCHEDULING_CREDIT" in os.environ
                            or None),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            compress_autotune=_env_bool("BYTEPS_COMPRESS_AUTOTUNE", False),
+            compress_error_ceiling=_env_float(
+                "BYTEPS_COMPRESS_ERROR_CEILING", 0.55),
             use_native=_env_bool("BYTEPS_NATIVE", True),
             use_pallas=_env_bool("BYTEPS_PALLAS", True),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC", False),

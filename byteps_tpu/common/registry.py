@@ -123,6 +123,45 @@ class TensorRegistry:
             len(bounds), partition_bytes)
         return True
 
+    @staticmethod
+    def retune_compression_locked(ctx: TensorContext,
+                                  compression_kwargs: Optional[Dict[str,
+                                                                   str]],
+                                  partition_bytes: int) -> bool:
+        """Swap a PLANNER-OWNED tensor's codec between pushes (the
+        compressor-ladder exploration, ISSUE 11).  Caller holds
+        ``ctx.lock``, has checked ``ctx.inflight == 0``, and owns the
+        tensor through ``ctx.compression_tuned`` — explicitly-configured
+        tensors never reach here (``repartition_locked``'s refusal
+        stands for them).  Rebuilds chunk bounds for the new codec's
+        partition bound and drops the compressor slots; the engine's
+        ``_ensure_compression`` re-instantiates them (fresh functional
+        state — exploration restarts EF accumulation, which is exactly
+        what switching codecs requires).  Returns True when anything
+        changed."""
+        if not ctx.initialized:
+            return False
+        new_kwargs = dict(compression_kwargs or {})
+        if (new_kwargs == ctx.compression_kwargs
+                and partition_bytes == ctx.partition_bytes):
+            return False
+        ctx.compression_kwargs = new_kwargs
+        ctx.compressor = None
+        bounds = chunk_bounds(ctx.num_elems,
+                              np.dtype(ctx.dtype_name).itemsize,
+                              partition_bytes)
+        ctx.partition_bytes = partition_bytes
+        if bounds != ctx.chunk_bounds:
+            ctx.chunk_bounds = bounds
+            ctx.key_list = [make_key(ctx.declared_key, i)
+                            for i in range(len(bounds))]
+        ctx.scatter_layout = None   # recomputed lazily for the new mode
+        get_logger().debug(
+            "retuned tensor %s codec -> %s (%d chunk(s) at %d B)",
+            ctx.name, new_kwargs.get("compressor", "none"), len(bounds),
+            partition_bytes)
+        return True
+
     def get(self, name: str) -> Optional[TensorContext]:
         with self._lock:
             return self._by_name.get(name)

@@ -152,6 +152,22 @@ _PLAN_SAMPLES = 2
 # the tiling the partitioner rounds to).
 _PLAN_ALIGN = ALIGN_BYTES
 
+# The compressor candidate ladder (ISSUE 11): per size bucket the planner
+# races these codecs on measured push wall time, gated by the codec-golden
+# gradient-error ceiling.  Every quantized candidate carries error
+# feedback — it is what makes a lossy codec's LONG-RUN delivered gradient
+# track the true one, and the golden-error figure is EF-aware to match.
+# k=0.25 for the sparsifiers: the densest rung whose EF-corrected golden
+# error clears the default ceiling (k=0.01 never delivers enough mass in
+# a bounded window — "Compressed Communication for Distributed Training"
+# (PAPERS.md) reaches the same per-bucket-adaptive conclusion).
+COMPRESS_LADDER = (
+    ("none", None),
+    ("onebit", {"compressor": "onebit", "ef": "vanilla"}),
+    ("randomk", {"compressor": "randomk", "k": "0.25", "ef": "vanilla"}),
+    ("topk", {"compressor": "topk", "k": "0.25", "ef": "vanilla"}),
+)
+
 
 class ChunkPlanner:
     """Online (chunk-size, credit-window) tuner for the push_pull hot path.
@@ -191,6 +207,16 @@ class ChunkPlanner:
                                 and num_procs == 1)
         self._tune_credit = (cfg.autotune and not cfg.credit_pinned
                              and num_procs == 1)
+        # Compressor-ladder dimension (ISSUE 11): opt-in (a tuned codec
+        # changes gradient values, unlike a tuned chunk size), and never
+        # multi-process — SPMD processes must dispatch identical
+        # programs, and a per-host codec choice would diverge them.
+        # Per-tensor pins (explicit compression= kwargs) live in the
+        # engine: a pinned tensor never calls plan_compression at all.
+        self._tune_compress = cfg.compress_autotune and num_procs == 1
+        self._error_ceiling = cfg.compress_error_ceiling
+        self._min_compress = cfg.min_compress_bytes
+        self._cbuckets = {}         # bucket -> compressor-ladder state
         self._buckets = {}          # bucket -> state dict
         self._lock = threading.Lock()
         self._credit = 0            # 0 = leave the scheduler unlimited
@@ -292,6 +318,119 @@ class ChunkPlanner:
             st = self._buckets.get(nbytes.bit_length())
             return st is not None and st["locked"] is not None
 
+    # -- compressor ladder (ISSUE 11) --------------------------------------
+
+    @property
+    def compress_active(self) -> bool:
+        return self._tune_compress
+
+    def _compress_candidates(self) -> List[tuple]:
+        """Ladder candidates for one bucket as ``(key, kwargs, golden)``
+        triples.  A quantized candidate whose codec-golden gradient
+        error exceeds the ceiling is excluded UP FRONT — there is no
+        point paying exploration dispatches for a codec the quality
+        gate would refuse to lock.  Computing the goldens runs JAX work
+        (compress/decompress compiles on first use), so callers invoke
+        this OUTSIDE the planner lock."""
+        from ..compression import registry as _creg
+        out = [("none", None, 0.0)]
+        for key, kw in COMPRESS_LADDER[1:]:
+            try:
+                err = _creg.golden_error(kw)
+            except Exception:  # noqa: BLE001 — a codec whose golden
+                continue       # cannot even run must never be chosen
+            if err <= self._error_ceiling:
+                out.append((key, kw, err))
+        return out
+
+    def plan_compression(self, nbytes: int):
+        """Compression kwargs to use right now for an unpinned tensor of
+        ``nbytes`` (``None`` = uncompressed).  Exploration is the same
+        fewest-samples-first round-robin as the chunk ladder; the CHUNK
+        dimension must lock first — racing both dimensions at once would
+        attribute a chunk candidate's wall time to a codec (and the
+        compressed path carves its own bounds anyway).  The compression
+        cutoff is checked against the TENSOR's nbytes, not the bucket's
+        state: a bucket can straddle ``min_compress_bytes``, and a
+        below-cutoff tensor planned a codec the engine then strips
+        would re-carve its bounds on every push and charge its samples
+        to the wrong candidate."""
+        if not self._tune_compress:
+            return None
+        if nbytes < max(1, self._min_compress):
+            return None
+        if not self.locked(nbytes):
+            return None
+        bucket = nbytes.bit_length()
+        with self._lock:
+            st = self._cbuckets.get(bucket)
+        if st is None:
+            # golden-error computation compiles codec programs — do it
+            # outside the lock (memoized module-level, so a racing
+            # second thread pays nothing; setdefault dedups the bucket)
+            cands = self._compress_candidates()
+            with self._lock:
+                st = self._cbuckets.setdefault(
+                    bucket, {"cands": cands, "samples": {},
+                             "locked": None})
+        with self._lock:
+            if st["locked"] is not None:
+                return next(kw for k, kw, _ in st["cands"]
+                            if k == st["locked"])
+            key = min((k for k, _, _ in st["cands"]),
+                      key=lambda k: len(st["samples"].get(k, ())))
+            return next(kw for k, kw, _ in st["cands"] if k == key)
+
+    def observe_compression(self, nbytes: int, codec: str, seconds: float,
+                            compiled: bool = False) -> None:
+        """Record one completed push of a ladder-tuned tensor under
+        ``codec`` (the candidate key, e.g. "onebit").  Compile-polluted
+        samples are discarded exactly like the chunk ladder's."""
+        if (not self._tune_compress or seconds <= 0 or compiled
+                or nbytes < max(1, self._min_compress)):
+            return
+        bucket = nbytes.bit_length()
+        locked_now = None
+        with self._lock:
+            st = self._cbuckets.get(bucket)
+            if st is None or st["locked"] is not None:
+                return
+            if codec not in {k for k, _, _ in st["cands"]}:
+                return  # pushed under an earlier ladder / retune race
+            st["samples"].setdefault(codec, []).append(seconds)
+            if any(len(st["samples"].get(k, ())) < _PLAN_SAMPLES
+                   for k, _, _ in st["cands"]):
+                return
+            best = min((k for k, _, _ in st["cands"]),
+                       key=lambda k: min(st["samples"].get(k,
+                                                           [float("inf")])))
+            st["locked"] = best
+            locked_now = best
+        if locked_now is not None:
+            # telemetry outside the planner lock: the codec-lock event is
+            # an operator-visible decision (bps_top CODEC column,
+            # /metrics, flight recorder)
+            from . import flight_recorder as _flight
+            from .telemetry import counters as _counters
+            from .telemetry import gauges as _gauges
+            _counters.inc("compression.planner_locked")
+            _gauges.set("compression.codec_locked", 1.0,
+                        bucket=bucket, codec=locked_now)
+            _flight.record("compression.codec_locked", bucket=bucket,
+                           codec=locked_now)
+
+    def compress_locked(self, nbytes: int) -> bool:
+        """True once the bucket's codec stopped moving (or the ladder is
+        off, or the tensor is under the compression cutoff — nothing to
+        explore) — the engine's cue to stop stamping measurement
+        windows."""
+        if (not self._tune_compress
+                or nbytes < max(1, self._min_compress)):
+            return True
+        with self._lock:
+            st = self._cbuckets.get(nbytes.bit_length())
+            return st is not None and st["locked"] is not None
+
     def snapshot(self) -> dict:
         """Chosen knobs for the bench JSON / telemetry: per-bucket locked
         chunk size (or exploration progress) and the credit suggestion."""
@@ -303,8 +442,20 @@ class ChunkPlanner:
                     "explored": {str(k): round(min(v), 6)
                                  for k, v in st["samples"].items() if v},
                 }
+            cbuckets = {}
+            for b, st in self._cbuckets.items():
+                cbuckets[str(b)] = {
+                    "locked_codec": st["locked"],
+                    "explored": {k: round(min(v), 6)
+                                 for k, v in st["samples"].items() if v},
+                    "golden_error": {k: round(e, 4)
+                                     for k, _, e in st["cands"]},
+                }
             return {"tuning_partition": self._tune_partition,
                     "tuning_credit": self._tune_credit,
                     "base_partition_bytes": self._base,
                     "credit_bytes": self._credit,
-                    "buckets": buckets}
+                    "buckets": buckets,
+                    "compression": {"tuning": self._tune_compress,
+                                    "error_ceiling": self._error_ceiling,
+                                    "buckets": cbuckets}}

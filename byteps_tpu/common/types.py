@@ -179,6 +179,16 @@ class TensorContext:
     # {"compressor": "onebit", "ef": "vanilla", ...})
     compression_kwargs: Dict[str, str] = dataclasses.field(default_factory=dict)
     compressor: Any = None
+    # Compressor-ladder ownership (ISSUE 11): None = undecided, False =
+    # pinned (the tensor was declared/pushed with explicit compression=
+    # kwargs, or the ladder is off — the planner never touches it), True
+    # = planner-owned (the codec may be retuned between pushes, at
+    # inflight == 0, exactly like chunk bounds)
+    compression_tuned: Optional[bool] = None
+    # explicit kwargs that arrived while a push was in flight: the pin
+    # takes ownership immediately (compression_tuned -> False) and the
+    # codec itself is applied at this tensor's next idle push
+    compression_pin: Optional[Dict[str, str]] = None
     # scatter-accumulator layout for the buffer-mode engine path:
     # ([(col_off, col_ln), ...], C) in column units of the [n_ici, C]
     # view (comm.collectives.scatter_layout), or the string "ineligible"

@@ -78,6 +78,15 @@ def _num(v):
     return v
 
 
+# Accepted decorator spellings.  A typo'd ef/momentum value used to be
+# SILENTLY skipped — a run the operator believed error-feedback-corrected
+# trained without it; now it fails at declare/create time with the
+# accepted values named.
+_EF_ON = ("vanilla", "true", "1")
+_EF_OFF = ("", "0", "false", "none", "off")
+_MOMENTUM_ON = ("nesterov",)
+
+
 def create(kwargs: Optional[Dict], numel: int, dtype=jnp.float32,
            for_server: bool = False) -> Compressor:
     """Build the compressor chain from a kwargs dict.
@@ -98,10 +107,99 @@ def create(kwargs: Optional[Dict], numel: int, dtype=jnp.float32,
             f"unknown compressor {ctype!r}; have {sorted(_REGISTRY)}")
     comp = _REGISTRY[ctype](numel, dtype, kwargs)
     ef = str(kwargs.get("ef", "")).lower()
-    if ef in ("vanilla", "true", "1"):
+    if ef in _EF_ON:
         comp = ErrorFeedback(comp)
+    elif ef not in _EF_OFF:
+        raise ValueError(
+            f"unknown ef {kwargs.get('ef')!r}: use one of {_EF_ON} to "
+            f"enable error feedback or omit the key")
     momentum = str(kwargs.get("momentum", "")).lower()
-    if momentum == "nesterov" and not for_server:
-        comp = NesterovMomentum(comp, mu=float(kwargs.get("momentum_mu",
-                                                          0.9)))
+    if momentum in _MOMENTUM_ON:
+        if not for_server:
+            comp = NesterovMomentum(comp,
+                                    mu=float(kwargs.get("momentum_mu", 0.9)))
+    elif momentum not in _EF_OFF:
+        raise ValueError(
+            f"unknown momentum {kwargs.get('momentum')!r}: use "
+            f"{_MOMENTUM_ON} or omit the key")
     return comp
+
+
+# -- declare-time validation + codec goldens --------------------------------
+
+# Memoized per canonical kwargs: validation runs on the declare/enqueue
+# hot path and golden errors feed every planner bucket.
+_VALIDATED: Dict[tuple, bool] = {}
+_GOLDEN: Dict[tuple, float] = {}
+
+# The canonical golden geometry: errors are near size-insensitive, so one
+# fixed (numel, steps, seed) makes the figure a stable, comparable
+# constant — the same number gates the planner ladder and the bench
+# quality check.
+GOLDEN_NUMEL = 16384
+GOLDEN_STEPS = 8
+
+
+def _kwargs_key(kwargs: Dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in kwargs.items()))
+
+
+def validate_kwargs(kwargs: Optional[Dict]) -> None:
+    """Eagerly validate a compression kwargs dict (declare-time check).
+
+    Builds the full worker+server chains against a tiny numel so a bad
+    codec name, decorator value, or non-numeric parameter fails HERE —
+    at declare/enqueue, in the caller's stack — instead of surfacing as
+    a KeyError deep in the server engine or a mid-dispatch crash on the
+    first push.  Memoized per kwargs; raises ValueError."""
+    if not kwargs:
+        return
+    key = _kwargs_key(kwargs)
+    if _VALIDATED.get(key):
+        return
+    try:
+        create(dict(kwargs), 256)
+        create(dict(kwargs), 256, for_server=True)
+    except ValueError as e:
+        if str(e).startswith("unknown "):
+            raise       # already names the bad key and the valid values
+        raise ValueError(
+            f"invalid compression kwargs {dict(kwargs)!r}: {e}") from e
+    except Exception as e:  # noqa: BLE001 — bad numeric params etc.
+        raise ValueError(
+            f"invalid compression kwargs {dict(kwargs)!r}: {e}") from e
+    _VALIDATED[key] = True
+
+
+def golden_error(kwargs: Optional[Dict], numel: int = GOLDEN_NUMEL,
+                 steps: int = GOLDEN_STEPS, seed: int = 0) -> float:
+    """Codec-golden gradient error: the relative mass a codec FAILS to
+    deliver over ``steps`` repeated pushes of one deterministic gradient
+    — ``||sum(delivered) - steps*x|| / (steps*||x||)``.
+
+    Error-feedback-aware by construction: an EF chain's residual feeds
+    the next step, so the cumulative figure is the one that predicts
+    convergence (a single-shot error would reject every sparsifier EF
+    makes usable).  Deterministic (fixed seed; randomized codecs draw
+    from their own seeded counter PRNG), so the planner's quality gate
+    and the bench's quality check read the same constant.  ``None``
+    kwargs (the uncompressed candidate) is exactly 0."""
+    if not kwargs:
+        return 0.0
+    key = (_kwargs_key(kwargs), int(numel), int(steps), int(seed))
+    cached = _GOLDEN.get(key)
+    if cached is not None:
+        return cached
+    import numpy as np
+    x = np.random.RandomState(seed).randn(numel).astype(np.float32)
+    comp = create(dict(kwargs), numel)
+    state = comp.init_state()
+    acc = np.zeros(numel, np.float64)
+    xj = jnp.asarray(x)
+    for _ in range(steps):
+        payload, state = comp.compress(xj, state)
+        acc += np.asarray(comp.decompress(payload), np.float64)
+    err = float(np.linalg.norm(acc - steps * x)
+                / (steps * np.linalg.norm(x) + 1e-30))
+    _GOLDEN[key] = err
+    return err

@@ -35,7 +35,8 @@ from ..comm.collectives import (_as_stacked, aot_warm_buffer_programs,
                                 push_pull_arrays_batched,
                                 push_pull_chunk_scatter, scatter_layout,
                                 stage_local_replicated, stage_local_sharded)
-from ..comm.compressed import compressed_all_reduce
+from ..comm.compressed import (aot_warm_compressed_programs,
+                               fused_compressed_push_pull)
 from ..comm.mesh import CommContext
 from ..compression import registry as compression_registry
 from ..common import jax_compat
@@ -287,6 +288,9 @@ class PushPullEngine:
         # The watchdog must be a SEPARATE thread: the captive syncer
         # cannot observe its own wedge.
         self._block = jax.block_until_ready  # patch point: tests wedge it
+        # last compression.active codec published per tensor (scrape-time
+        # gauge hygiene — see refresh_compression_gauges)
+        self._comp_gauge_codecs: Dict[str, str] = {}
         self._deadline_on = cfg.sync_deadline_s > 0
         self._sync_block_lock = threading.Lock()
         self._sync_block: Optional[tuple] = None  # (t0, [tensor names])
@@ -350,7 +354,14 @@ class PushPullEngine:
             _fault.on_step()
         if local:
             if compression:
-                raise ValueError("local fast path excludes compression")
+                raise ValueError(
+                    "compression= is not supported on the local "
+                    "(single-contribution) fast path: compressed chunks "
+                    "need materialized per-rank rows.  Pass the "
+                    "rank-stacked [R, ...] layout to push_pull_async, "
+                    "or call push_pull_local/push_pull_local_async, "
+                    "which routes compressed tensors through the "
+                    "stacked layout automatically")
             if out_shape is None:
                 out_shape = stacked.shape
         else:
@@ -361,6 +372,12 @@ class PushPullEngine:
                     f"{self.comm.num_ranks}")
             if out_shape is None:
                 out_shape = stacked.shape[1:]
+        if compression:
+            # Declare/enqueue-time validation (ISSUE 11 satellite): a
+            # typo'd codec name or decorator value fails HERE in the
+            # caller's stack with the accepted spellings named — not as
+            # a KeyError deep in the server engine on first use.
+            compression_registry.validate_kwargs(compression)
         # Planner-chosen chunk size: for uncompressed tensors over the
         # base bound the auto-tuner explores, then locks, a partition
         # bytes per size bucket; an initialized tensor re-carves its
@@ -377,22 +394,53 @@ class PushPullEngine:
         # scatter_layout) is stable only because this push already holds
         # one — a late claim would let a concurrent push re-carve the
         # bounds mid-read.
+        # Compressor-ladder plan, computed BEFORE taking ctx.lock: the
+        # first touch of a size bucket evaluates codec goldens (JAX
+        # compiles), and the sync thread's _on_done takes ctx.lock —
+        # holding it through a compile would stall every tensor's
+        # retirement.  The benign race (another push applying a newer
+        # plan first) is resolved under the lock below.
+        want_tuned = None
+        if (compression is None and self.planner.compress_active
+                and ctx.compression_tuned is not False):
+            want_tuned = self.planner.plan_compression(est_nbytes)
         with ctx.lock:
-            if ctx.inflight == 0 and ctx.partition_bytes != plan_bytes:
+            if ctx.compression_tuned is None:
+                # codec ownership decided once: explicit kwargs (this
+                # push's, or an earlier declare's) pin the tensor; bare
+                # tensors belong to the compressor ladder when it is on
+                ctx.compression_tuned = (not compression
+                                         and not ctx.compression_kwargs
+                                         and self.planner.compress_active)
+            elif compression and ctx.compression_tuned:
+                # explicit kwargs RE-PIN a ladder-owned tensor: the
+                # caller's codec wins over the planner's from now on
+                # (silently keeping the planner's choice would ship a
+                # different codec than the caller just named).  The pin
+                # takes ownership NOW; the codec itself applies at
+                # inflight == 0 — recorded on the ctx so a pin arriving
+                # with pushes in flight lands at the next idle push
+                # instead of being lost.
+                ctx.compression_tuned = False
+                ctx.compression_pin = dict(compression)
+            if ctx.compression_pin is not None and ctx.inflight == 0:
+                self.registry.retune_compression_locked(
+                    ctx, ctx.compression_pin, self.cfg.partition_bytes)
+                ctx.compression_pin = None
+            if ctx.compression_tuned and ctx.inflight == 0:
+                # compressor-ladder retune (ISSUE 11): the planner's
+                # current codec for this size bucket, applied only
+                # between pushes — the codec analog of repartitioning
+                self.registry.retune_compression_locked(
+                    ctx, want_tuned,
+                    self.cfg.partition_bytes if want_tuned else plan_bytes)
+            if (not ctx.compression_kwargs and ctx.inflight == 0
+                    and ctx.partition_bytes != plan_bytes):
                 self.registry.repartition_locked(ctx, plan_bytes)
             ctx.inflight += 1
             ctx.version += 1
             version = ctx.version
         try:
-            # Per-push planner sample: wall seconds enqueue -> completion,
-            # discarded when a program compile landed inside the window.
-            # Zero overhead once the bucket locks.
-            track_plan = (not compression
-                          and not self.planner.locked(est_nbytes))
-            if track_plan:
-                t_plan0 = time.perf_counter()
-                miss0 = counters.get("engine.compile_cache_miss")
-                part_used = ctx.partition_bytes
             if priority is None:
                 prio = -ctx.declared_key if self.cfg.enable_priority else 0
             else:
@@ -401,6 +449,25 @@ class PushPullEngine:
             if denom is None:
                 denom = self.comm.num_ranks if op == "average" else 1
             self._ensure_compression(ctx, stacked.dtype)
+            # Per-push planner sample: wall seconds enqueue -> completion,
+            # discarded when a program compile landed inside the window.
+            # Two dimensions share the window: chunk size (uncompressed
+            # pushes, until the size bucket locks) and then — for
+            # ladder-owned tensors — the compressor candidate.  Evaluated
+            # AFTER _ensure_compression so the below-cutoff kwargs strip
+            # is visible.  Zero overhead once both lock.
+            eff_compressed = bool(ctx.compression_kwargs)
+            track_plan = (not eff_compressed
+                          and not self.planner.locked(est_nbytes))
+            track_comp = (bool(ctx.compression_tuned)
+                          and self.planner.locked(est_nbytes)
+                          and not self.planner.compress_locked(est_nbytes))
+            if track_plan or track_comp:
+                t_plan0 = time.perf_counter()
+                miss0 = counters.get("engine.compile_cache_miss")
+                part_used = ctx.partition_bytes
+                codec_used = (ctx.compression_kwargs.get("compressor")
+                              or "none") if eff_compressed else "none"
             if local and ctx.compressor is not None:
                 # The tensor was declared WITH compression under this name by
                 # an earlier push: compressed chunks need materialized per-rank
@@ -495,10 +562,13 @@ class PushPullEngine:
                         self.comm, np.asarray(stacked).reshape(-1))
             else:
                 flat = stacked.reshape(stacked.shape[0], -1)
-                if ctx.compressor is None:
-                    # Stage to the mesh once; chunk programs slice in-graph (no
-                    # per-chunk device_put / eager slice materialization).
-                    flat = _as_stacked(self.comm, flat)
+                # Stage to the mesh once; chunk programs slice in-graph
+                # (no per-chunk device_put / eager slice
+                # materialization).  Since ISSUE 11 compressed chunks
+                # ride the same staging: the fused quantized program
+                # slices its chunk from the staged row, so the old
+                # per-chunk host slice copies are gone.
+                flat = _as_stacked(self.comm, flat)
             pending.local_mode = local_mode
             itemsize = np.dtype(stacked.dtype).itemsize
             if use_buffer:
@@ -513,9 +583,13 @@ class PushPullEngine:
             else:
                 bounds = ctx.chunk_bounds
             for part_idx, (off, ln) in enumerate(bounds):
-                # parts mode (compressed / debug-sample) needs the materialized
-                # chunk; buffer mode and single-chunk tensors pass the full flat
-                if nchunks > 1 and not use_buffer:
+                # uncompressed parts mode (debug-sample, odd shapes) needs
+                # the materialized chunk; buffer mode, single-chunk
+                # tensors, and COMPRESSED chunks (whose fused program
+                # slices in-graph from the staged row via offset_elems)
+                # pass the full flat
+                if (nchunks > 1 and not use_buffer
+                        and ctx.compressor is None):
                     chunk = flat[off:off + ln] if local else flat[:, off:off + ln]
                 else:
                     chunk = flat
@@ -540,6 +614,14 @@ class PushPullEngine:
             def _on_done(h):
                 with ctx.lock:
                     ctx.inflight -= 1
+                if track_comp and h.status.code == StatusCode.OK:
+                    # compressor-ladder sample: this push's wall time,
+                    # charged to the codec it actually ran under
+                    self.planner.observe_compression(
+                        est_nbytes, codec_used,
+                        time.perf_counter() - t_plan0,
+                        compiled=counters.get("engine.compile_cache_miss")
+                        != miss0)
                 if track_plan and h.status.code == StatusCode.OK:
                     self.planner.observe(
                         est_nbytes, part_used,
@@ -607,6 +689,61 @@ class PushPullEngine:
             self.scheduler.set_credit_bytes(credit)
             gauges.set("engine.credit_bytes", credit)
 
+    @staticmethod
+    def _ef_error_leaves(state):
+        """Every "error" leaf in a (possibly decorator-nested) compressor
+        state dict — the error-feedback residual accumulators."""
+        out = []
+        if isinstance(state, dict):
+            for k, v in state.items():
+                if k == "error":
+                    out.append(v)
+                else:
+                    out.extend(PushPullEngine._ef_error_leaves(v))
+        return out
+
+    def refresh_compression_gauges(self) -> None:
+        """Scrape-time compression gauges (ISSUE 11 observability): per
+        compressed tensor, the codec it currently carries
+        (``compression.active{tensor=,codec=}``) and the error-feedback
+        residual L2 norm (``compression.ef_norm{tensor=}`` — a norm that
+        grows without bound means the codec is not keeping up with the
+        gradient).  Reads device state, so it runs at scrape time
+        (/metrics refresh, /debug/state), never on the push hot path.
+
+        ``_comp_gauge_codecs`` remembers what this method last published
+        per tensor: the registry has no series removal, so a ladder
+        retune's RETIRED codec series is zeroed — a stale 1.0 would keep
+        the old codec in the bps_top CODEC column forever."""
+        for name in self.registry.names_in_declaration_order():
+            ctx = self.registry.get(name)
+            # snapshot once: a concurrent ladder retune can null
+            # ctx.compressor between a check and the loop
+            slots = ctx.compressor if ctx is not None else None
+            prev = self._comp_gauge_codecs.get(name)
+            if not slots:
+                if prev is not None:
+                    gauges.set("compression.active", 0.0, tensor=name,
+                               codec=prev)
+                    del self._comp_gauge_codecs[name]
+                continue
+            codec = ctx.compression_kwargs.get("compressor", "?")
+            if prev is not None and prev != codec:
+                gauges.set("compression.active", 0.0, tensor=name,
+                           codec=prev)
+            self._comp_gauge_codecs[name] = codec
+            gauges.set("compression.active", 1.0, tensor=name,
+                       codec=codec)
+            norm_sq, found = 0.0, False
+            for slot in slots:
+                for err in self._ef_error_leaves(slot.wstates):
+                    found = True
+                    norm_sq += float(jnp.sum(jnp.square(
+                        jnp.asarray(err, jnp.float32))))
+            if found:
+                gauges.set("compression.ef_norm", norm_sq ** 0.5,
+                           tensor=name)
+
     def declare_tensor(self, name: str, shape, dtype=np.float32, *,
                        op: str = "average", local: Optional[bool] = None,
                        compression: Optional[Dict[str, str]] = None,
@@ -630,15 +767,53 @@ class PushPullEngine:
         """
         shape = tuple(shape)
         np_dtype = np.dtype(dtype)
+        if compression:
+            # a bad codec/decorator/param fails at declare, in the
+            # caller's stack (ISSUE 11 satellite)
+            compression_registry.validate_kwargs(compression)
         est_nbytes = self._est_nbytes(shape, np_dtype)
         plan_bytes = (self.cfg.partition_bytes if compression
                       else self.planner.plan_partition(est_nbytes))
         ctx = self.registry.init_tensor(name, shape, np_dtype,
                                         compression_kwargs=compression,
                                         partition_bytes=plan_bytes)
-        if (compression or ctx.compression_kwargs
-                or jax.process_count() > 1
-                or self.cfg.debug_sample_tensor):
+        with ctx.lock:
+            if ctx.compression_tuned is None:
+                ctx.compression_tuned = (not compression
+                                         and not ctx.compression_kwargs
+                                         and self.planner.compress_active)
+        if jax.process_count() > 1 or self.cfg.debug_sample_tensor:
+            return ctx
+        if compression or ctx.compression_kwargs:
+            # ISSUE 11 tentpole: a compressed tensor pre-lowers and
+            # compiles its whole steady-state program family at declare
+            # time too — in-graph chunk slice, quantize, quantized
+            # gather, Pallas-fused dequant-accumulate, merged
+            # re-quantize, error-feedback state update — one program per
+            # chunk codec, so the compressed stream also compiles zero
+            # programs after warmup and the first push pays no stall.
+            self._ensure_compression(ctx, np_dtype)
+            if not ctx.compressor:
+                return ctx          # below the compression size cutoff
+            t0 = time.monotonic()
+            try:
+                n_compiled = aot_warm_compressed_programs(
+                    self.comm, n_flat=ctx.num_elems,
+                    dtype_name=ctx.dtype_name,
+                    chunk_bounds=ctx.chunk_bounds, slots=ctx.compressor)
+                if n_compiled:
+                    get_logger().debug(
+                        "AOT-compiled %d compressed program(s) for %s",
+                        n_compiled, name)
+                    if self.tracer.enabled:
+                        self.tracer.record_span(
+                            "engine.aot_warm", t0, time.monotonic(),
+                            tensor=name, programs=n_compiled)
+            except Exception:  # noqa: BLE001 — warm is an optimization
+                counters.inc("engine.aot_compile_failed")
+                get_logger().debug(
+                    "compressed AOT warm failed for %s; programs compile "
+                    "lazily", name, exc_info=True)
             return ctx
         if local is None:
             local = jax.process_count() == 1
@@ -742,13 +917,32 @@ class PushPullEngine:
                     ctx.compression_kwargs, ln, dtype)
                 sc = compression_registry.create(
                     ctx.compression_kwargs, ln, dtype, for_server=True)
+                # State leaves are COMMITTED to the exact shardings the
+                # fused program's in_specs declare (rank-stacked worker,
+                # replicated server).  An uncommitted default-device
+                # array would be re-sharded by every pjit call, and the
+                # declare-time AOT executable — lowered against these
+                # shardings — could not be called at all.  The shardings
+                # come from the SAME state_structs the AOT warm lowers
+                # against, so the two cannot drift.
                 wstate = jax.tree.map(
                     lambda s: jnp.broadcast_to(
                         jnp.asarray(s)[None],
                         (r,) + jnp.asarray(s).shape),
                     wc.init_state())
-                slots.append(_CompressionSlot(wc, sc, wstate,
-                                              sc.init_state()))
+                sstate = jax.tree.map(jnp.asarray, sc.init_state())
+                from ..comm.compressed import state_structs
+                w_structs, s_structs = state_structs(self.comm, wstate,
+                                                     sstate)
+                w_leaves, wdef = jax.tree.flatten(wstate)
+                s_leaves, sdef = jax.tree.flatten(sstate)
+                wstate = jax.tree.unflatten(
+                    wdef, [jax.device_put(lf, st.sharding)
+                           for lf, st in zip(w_leaves, w_structs)])
+                sstate = jax.tree.unflatten(
+                    sdef, [jax.device_put(lf, st.sharding)
+                           for lf, st in zip(s_leaves, s_structs)])
+                slots.append(_CompressionSlot(wc, sc, wstate, sstate))
             ctx.compressor = slots
 
     def _make_chunk_callback(self, pending: _PendingTensor, part_idx: int):
@@ -947,9 +1141,13 @@ class PushPullEngine:
             slot = task.compression
             rollback = None
             if slot is not None:
-                out, new_wst, new_sst = compressed_all_reduce(
-                    self.comm, task.data, slot.worker, slot.server,
-                    slot.wstates, slot.sstate)
+                # the fused quantized program: in-graph chunk slice from
+                # the staged row, quantize, quantized-payload gather,
+                # dequant-accumulate, merged re-quantize, state update —
+                # one persistent executable (AOT-compiled at declare)
+                out, new_wst, new_sst = fused_compressed_push_pull(
+                    self.comm, task.data, task.offset_elems,
+                    slot.worker, slot.server, slot.wstates, slot.sstate)
                 # Commit at dispatch time so a later step of the same
                 # chunk (which can be dispatched before this one syncs)
                 # sees the advanced EF/momentum/PRNG state; the syncer
@@ -1142,6 +1340,15 @@ class PushPullEngine:
                 wire = (task.compression.worker.payload_nbytes()
                         if task.compression is not None else task.nbytes)
                 self.speed.record(wire * 2)
+                if task.compression is not None and err_t is None:
+                    # quantized-wire accounting (ISSUE 11): what the
+                    # reduce leg actually shipped, and the raw bytes it
+                    # did NOT — the compression-ratio evidence beside
+                    # the KV store's wire_bytes counters
+                    counters.inc("compression.wire_bytes", wire)
+                    counters.inc("compression.bytes_saved",
+                                 max(0, task.nbytes - wire))
+                    counters.inc("compression.compressed_chunks")
             if task.callback is not None:
                 if err_t is not None:
                     # stale-epoch drops carry ABORTED (a recognizable,

@@ -586,7 +586,12 @@ class ServerEngine:
         with self._states_lock:
             codec = self._codecs.get(key)
         if codec is None:
-            raise KeyError(f"key {key!r} has no registered compression")
+            # actionable, not a bare KeyError three frames deep: the
+            # caller skipped (or failed) the declare-time registration
+            raise ValueError(
+                f"key {key!r} has no registered compression codec: call "
+                f"ServerEngine.register_compression(key, kwargs, numel) "
+                f"before push_compressed/pull_compressed")
         return codec
 
     def push_compressed(self, key: str, data: bytes, worker_id: int,

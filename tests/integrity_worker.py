@@ -22,6 +22,17 @@ equality is the test's headline assertion.
 Env (set by the test): BYTEPS_INTEG_RANK, BYTEPS_INTEG_PORT,
 BYTEPS_INTEG_OUT (rank 0 writes final params there), plus
 BYTEPS_FAULT_SPEC / BYTEPS_FAULT_SEED for the chaos variant.
+
+BYTEPS_INTEG_COMPRESS=<codec> (ISSUE 11): the QUANTIZED variant — every
+worker compresses its gradient with the named codec (+ error feedback)
+and ships WIRE-ENCODED payload bytes; rank 0 pushes them through
+``ServerEngine.push_compressed`` (the envelope then wraps the quantized
+frame — exactly what a real network hop would carry, and what the chaos
+bitflip corrupts), pulls the merged result re-compressed
+(``pull_compressed``) and broadcasts the merged wire bytes, which every
+rank decodes identically.  The bit-identical-final assertion therefore
+covers the compressed wire path end to end: a corrupt quantized frame
+must be NACKed and retransmitted BEFORE the decode runs.
 """
 
 from __future__ import annotations
@@ -58,6 +69,31 @@ def main() -> int:
         inj.arm(spec, seed=int(os.environ.get("BYTEPS_FAULT_SEED", "0")),
                 rank=rank)
 
+    codec = os.environ.get("BYTEPS_INTEG_COMPRESS", "")
+    comp_kw = {"compressor": codec, "ef": "vanilla"} if codec else None
+    wcomp = wstate = None
+    if comp_kw:
+        import jax.numpy as jnp  # noqa: F401 — compress runs on jax
+        from byteps_tpu.compression import create as create_compressor
+        wcomp = create_compressor(comp_kw, N)
+        wstate = wcomp.init_state()
+
+    def _my_wire(step: int, r: int) -> bytes:
+        """This rank's wire-encoded compressed gradient for ``step``
+        (error-feedback state advances across steps, deterministically
+        per rank)."""
+        nonlocal wstate
+        import jax.numpy as jnp
+        payload, wstate = wcomp.compress(jnp.asarray(_grad(step, r)),
+                                         wstate)
+        return wcomp.wire_encode(payload)
+
+    def _decode(wire: bytes) -> np.ndarray:
+        """Merged wire bytes -> values; stateless, so every rank's
+        decode of the same bytes is bit-identical."""
+        return np.asarray(wcomp.decompress(wcomp.wire_decode(wire)),
+                          np.float32)
+
     params = np.zeros(N, np.float32)
 
     if rank == 0:
@@ -73,9 +109,12 @@ def main() -> int:
             hello = _recv_obj(c)
             conns[hello["rank"]] = c
         eng = ServerEngine(num_threads=1)
+        if comp_kw:
+            eng.register_compression("grad", comp_kw, N)
         try:
             for step in range(STEPS):
-                grads = {0: _grad(step, 0)}
+                grads = {0: (_my_wire(step, 0) if comp_kw
+                             else _grad(step, 0))}
                 # fixed receive AND push order: the merge is
                 # COPY_FIRST(0) + SUM_RECV(1) + SUM_RECV(2) every run,
                 # so the float32 sum is bit-reproducible
@@ -83,11 +122,22 @@ def main() -> int:
                     msg = _recv_obj(conns[r])
                     assert msg["step"] == step, (msg["step"], step)
                     grads[r] = msg["grad"]
-                for r in (0, 1, 2):
-                    eng.push("grad", grads[r], worker_id=r, num_workers=3)
-                merged = np.asarray(eng.pull("grad", timeout=30))
-                for r in (1, 2):
-                    _send_obj(conns[r], {"step": step, "merged": merged})
+                if comp_kw:
+                    for r in (0, 1, 2):
+                        eng.push_compressed("grad", grads[r], worker_id=r,
+                                            num_workers=3)
+                    wire = eng.pull_compressed("grad", timeout=30)
+                    merged = _decode(wire)
+                    for r in (1, 2):
+                        _send_obj(conns[r], {"step": step, "merged": wire})
+                else:
+                    for r in (0, 1, 2):
+                        eng.push("grad", grads[r], worker_id=r,
+                                 num_workers=3)
+                    merged = np.asarray(eng.pull("grad", timeout=30))
+                    for r in (1, 2):
+                        _send_obj(conns[r], {"step": step,
+                                             "merged": merged})
                 params -= LR * merged
         finally:
             eng.shutdown()
@@ -113,10 +163,13 @@ def main() -> int:
         _send_obj(sock, {"rank": rank})
         try:
             for step in range(STEPS):
-                _send_obj(sock, {"step": step, "grad": _grad(step, rank)})
+                g = _my_wire(step, rank) if comp_kw else _grad(step, rank)
+                _send_obj(sock, {"step": step, "grad": g})
                 reply = _recv_obj(sock)
                 assert reply["step"] == step, (reply["step"], step)
-                params -= LR * np.asarray(reply["merged"])
+                merged = (_decode(reply["merged"]) if comp_kw
+                          else np.asarray(reply["merged"]))
+                params -= LR * merged
         finally:
             sock.close()
 

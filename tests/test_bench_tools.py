@@ -748,6 +748,15 @@ def test_bench_smoke_floor_and_gate_arithmetic(tmp_path, monkeypatch):
                 "engine_vs_fused_ratio": 0.5, "ratio_per_rep": [0.5],
                 "autotune": {}}
     monkeypatch.setattr(bs, "_measure", lambda: dict(measured))
+    # synthetic passing lanes: the compressed measurement is seconds of
+    # real pushes — its gate arithmetic is pinned separately below
+    monkeypatch.setattr(bs, "_measure_compressed", lambda: {
+        "onebit": {"wire_ratio": 0.031, "gbps": 0.02,
+                   "throughput_ratio": 0.1, "golden_error": 0.27,
+                   "zero_compile": True},
+        "randomk": {"wire_ratio": 0.5, "gbps": 0.001,
+                    "throughput_ratio": 0.01, "golden_error": 0.47,
+                    "zero_compile": True}})
     monkeypatch.setattr(bs, "setup_cpu8_mesh", lambda: None)
     monkeypatch.setenv("BENCH_SMOKE_TOLERANCE", "0.30")
     monkeypatch.setattr(sys, "argv", ["bench_smoke.py"])
@@ -762,3 +771,44 @@ def test_bench_smoke_floor_and_gate_arithmetic(tmp_path, monkeypatch):
     measured.update(engine_vs_fused_ratio=0.2,
                     engine_8MB_gbps=floor["engine_8MB_gbps"] * 0.3)
     assert bs.main() == 1
+
+
+def test_bench_smoke_compressed_floor_and_gate_arithmetic():
+    """ISSUE 11: the compressed lanes gate on wire ratio (onebit — the
+    quantized-reduce-leg contract, <= 0.35x at >= 1 MiB), the
+    codec-golden quality ceiling (deterministic, no tolerance), and the
+    throughput floor (host measurement, lane tolerance).  Pin the floor
+    file's shape and the pure gate function."""
+    from tools import bench_smoke as bs
+    with open(bs.FLOOR_PATH) as f:
+        floor = json.load(f)
+    assert 0 < floor["compressed_wire_ratio_max"] <= 0.35
+    assert 0 < floor["compressed_quality_ceiling"] <= 1
+    assert floor["compressed_throughput_floor"] >= 0
+
+    def lanes():
+        return {"onebit": {"wire_ratio": 0.031, "golden_error": 0.27,
+                           "throughput_ratio": 0.1},
+                "randomk": {"wire_ratio": 0.5, "golden_error": 0.47,
+                            "throughput_ratio": 0.01}}
+
+    good = lanes()
+    assert bs._compressed_ok(good, floor, 0.3)
+    assert good["onebit"]["ok"] and good["randomk"]["ok"]
+    # onebit shipping full-precision bytes on the reduce leg — fails
+    fat = lanes()
+    fat["onebit"]["wire_ratio"] = 0.9
+    assert not bs._compressed_ok(fat, floor, 0.3)
+    assert not fat["onebit"]["ok"] and fat["randomk"]["ok"]
+    # a codec whose golden error broke the quality ceiling — fails
+    lossy = lanes()
+    lossy["randomk"]["golden_error"] = 0.9
+    assert not bs._compressed_ok(lossy, floor, 0.3)
+    # a machinery collapse on the compressed path — fails the tput floor
+    slow = lanes()
+    slow["onebit"]["throughput_ratio"] = 0.0
+    assert not bs._compressed_ok(slow, floor, 0.3)
+    # randomk's dense wire ratio (0.5 > 0.35) is NOT gated: the wire
+    # contract is onebit's — randomk's lane reports it for the trend
+    assert lanes()["randomk"]["wire_ratio"] > floor[
+        "compressed_wire_ratio_max"]

@@ -1,0 +1,518 @@
+"""Fused quantized collectives on the AOT hot path (ISSUE 11).
+
+The tentpole's contract, pinned here:
+
+- **zero compiles at steady state, compressed**: a tensor declared WITH
+  ``compression=`` pre-lowers and compiles its whole steady-state
+  program family at declare time — in-graph chunk slice, quantize,
+  quantized-payload gather, dequant-accumulate, merged re-quantize,
+  error-feedback state update — and a compressed push stream then
+  triggers ZERO new cache programs;
+- **engine wiring correctness**: the fused multi-chunk path (staged
+  flat + traced offsets + per-chunk codec state) matches the per-chunk
+  compression pipeline computed directly from the codec module;
+- **declare-time validation**: a bad codec name / decorator value /
+  parameter fails at declare or enqueue with a ValueError in the
+  caller's stack, and the local-fast-path rejection names the supported
+  alternative (the old ``_CompressionSlot`` cold-path satellites);
+- **the compressor ladder**: per size bucket the planner explores
+  none/onebit/randomk/topk (with EF) round-robin, gates candidates on
+  the codec-golden error ceiling, locks by measured wall time, and
+  never tunes pinned tensors or multi-process worlds;
+- **elastic interaction**: a compressed push crossing a membership
+  epoch change drops-not-sums, at the engine AND the server engine.
+"""
+
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.common.config import Config, set_config
+from byteps_tpu.common.scheduler import COMPRESS_LADDER, ChunkPlanner
+from byteps_tpu.common.telemetry import counters, gauges
+
+ONEBIT_EF = {"compressor": "onebit", "ef": "vanilla"}
+
+
+@pytest.fixture
+def bps_session():
+    bps.init()
+    yield bps
+    bps.shutdown()
+
+
+@pytest.fixture
+def bps_chunked():
+    # 64 KiB partitions: a 160 KB tensor compresses as THREE chunks (two
+    # body widths + tail), exercising the per-chunk codec programs and
+    # the traced in-graph offsets
+    set_config(Config(partition_bytes=65536, min_compress_bytes=4096))
+    bps.init()
+    yield bps
+    bps.shutdown()
+
+
+@pytest.fixture
+def bps_ladder():
+    set_config(Config(partition_bytes=16384, partition_pinned=False,
+                      credit_pinned=False, compress_autotune=True,
+                      min_compress_bytes=4096))
+    bps.init()
+    yield bps
+    bps.shutdown()
+
+
+def _stacked(x):
+    return np.ascontiguousarray(
+        np.broadcast_to(np.asarray(x)[None], (bps.size(),) + x.shape))
+
+
+# ---------------------------------------------------------------- headline
+
+
+def test_compressed_steady_state_stream_compiles_nothing(bps_chunked):
+    """The regression test the acceptance criteria name: declare with
+    ``compression=`` -> warm -> N pushes -> compile counter delta == 0.
+    The declare-time warm must cover the ENTIRE compressed program set,
+    so even the FIRST push is compile-free."""
+    eng = bps.core.api._engine
+    bps.declare("cz/a", shape=(40_000,), dtype=np.float32,
+                compression=ONEBIT_EF)
+    ctx = eng.registry.get("cz/a")
+    assert len(ctx.chunk_bounds) == 3          # the multi-chunk shape
+    assert counters.get("engine.aot_compiled") >= 2   # body + tail codec
+    assert counters.get("engine.aot_compile_failed") == 0
+    m0 = counters.get("engine.compile_cache_miss")
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        x = rng.randn(40_000).astype(np.float32)
+        out = eng.push_pull_async(_stacked(x), "cz/a", op="sum",
+                                  out_shape=(40_000,)).wait()
+        out = np.asarray(out)
+        assert out.shape == (40_000,) and np.isfinite(out).all()
+    assert counters.get("engine.compile_cache_miss") == m0
+    assert counters.get("compression.compressed_chunks") >= 15
+
+
+def test_multichunk_compressed_matches_per_chunk_pipeline(bps_chunked):
+    """Engine wiring pin: the fused path (staged flat, in-graph traced
+    offsets, per-chunk EF state) must equal the per-chunk compression
+    pipeline computed directly from the codec module — all ranks push
+    identical rows, so the merged chunk is
+    D_s(C_s(R * D_w(C_w(x_chunk))))."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.compression import create as create_compressor
+
+    eng = bps.core.api._engine
+    R = bps.size()
+    rng = np.random.RandomState(7)
+    x = rng.randn(40_000).astype(np.float32)
+    out = np.asarray(eng.push_pull_async(
+        _stacked(x), "cz/m", op="sum", out_shape=(40_000,),
+        compression=ONEBIT_EF).wait())
+    ctx = eng.registry.get("cz/m")
+    assert len(ctx.chunk_bounds) == 3
+    exp = np.empty(40_000, np.float32)
+    for off, ln in ctx.chunk_bounds:
+        wc = create_compressor(ONEBIT_EF, ln)
+        sc = create_compressor(ONEBIT_EF, ln, for_server=True)
+        p, _ = wc.compress(jnp.asarray(x[off:off + ln]), wc.init_state())
+        y = R * np.asarray(wc.decompress(p), np.float32)
+        p2, _ = sc.compress(jnp.asarray(y), sc.init_state())
+        exp[off:off + ln] = np.asarray(sc.decompress(p2))
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_declare_validates_codec_name(bps_session):
+    with pytest.raises(ValueError, match="unknown compressor"):
+        bps.declare("cz/bad", shape=(65536,),
+                    compression={"compressor": "gzip"})
+
+
+def test_declare_validates_decorator_values(bps_session):
+    with pytest.raises(ValueError, match="unknown ef"):
+        bps.declare("cz/bad2", shape=(65536,),
+                    compression={"compressor": "onebit", "ef": "vanila"})
+    with pytest.raises(ValueError, match="unknown momentum"):
+        bps.declare("cz/bad3", shape=(65536,),
+                    compression={"compressor": "onebit",
+                                 "momentum": "nestorov"})
+
+
+def test_declare_validates_numeric_params(bps_session):
+    with pytest.raises(ValueError, match="invalid compression kwargs"):
+        bps.declare("cz/bad4", shape=(65536,),
+                    compression={"compressor": "topk", "k": "lots"})
+
+
+def test_push_validates_codec_at_enqueue(bps_session):
+    eng = bps.core.api._engine
+    with pytest.raises(ValueError, match="unknown compressor"):
+        eng.push_pull_async(
+            np.zeros((bps.size(), 1024), np.float32), "cz/bad5",
+            compression={"compressor": "nope"})
+
+
+def test_server_engine_unregistered_codec_is_actionable():
+    """The old cold-path failure: push_compressed on an unregistered key
+    surfaced as a bare KeyError deep in ``_codec``.  The error now names
+    the missing registration call."""
+    from byteps_tpu.server.engine import ServerEngine
+    eng = ServerEngine(num_threads=1)
+    try:
+        with pytest.raises(ValueError, match="register_compression"):
+            eng.push_compressed("ck", b"x", 0, 1)
+    finally:
+        eng.shutdown()
+
+
+def test_local_fast_path_compression_error_names_alternative(bps_session):
+    """core/engine.py's local-path rejection must name the knob and the
+    supported alternative, not a bare 'excludes compression'."""
+    eng = bps.core.api._engine
+    with pytest.raises(ValueError, match="push_pull_local"):
+        eng.push_pull_async(np.zeros(1024, np.float32), "cz/loc",
+                            local=True, compression=ONEBIT_EF)
+
+
+# ------------------------------------------------------- compressor ladder
+
+
+def _planner(ceiling=0.55, min_compress=4096, procs=1, autotune=True):
+    cfg = Config(partition_bytes=16384, partition_pinned=False,
+                 credit_pinned=False, compress_autotune=autotune,
+                 compress_error_ceiling=ceiling,
+                 min_compress_bytes=min_compress)
+    return ChunkPlanner(cfg, num_procs=procs)
+
+
+def _lock_partition(p, nbytes):
+    for _ in range(64):
+        if p.locked(nbytes):
+            return
+        p.observe(nbytes, p.plan_partition(nbytes), 0.001)
+    raise AssertionError("partition bucket never locked")
+
+
+def _feed(p, nbytes, seconds_by_codec, rounds=8):
+    for _ in range(rounds):
+        kw = p.plan_compression(nbytes)
+        key = (kw or {}).get("compressor", "none")
+        p.observe_compression(nbytes, key, seconds_by_codec(key))
+
+
+def test_ladder_locks_none_small_quantized_large():
+    """The acceptance demo: under a synthetic slow-wire regime the large
+    bucket's quantized candidate wins the wall-time race while the small
+    bucket's codec compute dominates — so the planner locks `none` small
+    and `onebit` large, with the decision visible in telemetry."""
+    p = _planner()
+    small, large = 40_000, 4_000_000
+    _lock_partition(p, small)
+    _lock_partition(p, large)
+    _feed(p, small, lambda k: 0.001 if k == "none" else 0.010)
+    _feed(p, large, lambda k: 0.002 if k == "onebit" else 0.020)
+    assert p.compress_locked(small) and p.compress_locked(large)
+    assert p.plan_compression(small) is None
+    assert p.plan_compression(large)["compressor"] == "onebit"
+    snap = p.snapshot()["compression"]
+    assert snap["buckets"][str(small.bit_length())]["locked_codec"] \
+        == "none"
+    b_large = snap["buckets"][str(large.bit_length())]
+    assert b_large["locked_codec"] == "onebit"
+    assert set(b_large["explored"]) == {k for k, _ in COMPRESS_LADDER}
+    assert b_large["golden_error"]["onebit"] > 0
+    assert counters.get("compression.planner_locked") == 2
+    locked_gauges = [k for k in gauges.snapshot()
+                     if k.startswith("compression.codec_locked{")]
+    assert any('codec="onebit"' in k for k in locked_gauges)
+
+
+def test_ladder_waits_for_partition_lock():
+    p = _planner()
+    assert p.plan_compression(4_000_000) is None
+    assert p.snapshot()["compression"]["buckets"] == {}
+
+
+def test_ladder_error_ceiling_excludes_candidates():
+    """Quality gate: with a 0.2 ceiling, onebit (golden ~0.27) and
+    randomk (~0.47) are excluded UP FRONT — never explored — while topk
+    (~0.17) stays in the race."""
+    p = _planner(ceiling=0.2)
+    n = 4_000_000
+    _lock_partition(p, n)
+    seen = set()
+    for _ in range(8):
+        kw = p.plan_compression(n)
+        key = (kw or {}).get("compressor", "none")
+        seen.add(key)
+        p.observe_compression(n, key, 0.01)
+    assert seen <= {"none", "topk"}
+    assert "onebit" not in seen and "randomk" not in seen
+
+
+def test_ladder_below_cutoff_never_explores():
+    """The compression cutoff is checked per TENSOR, not per bucket: a
+    below-cutoff tensor is never planned a codec (the engine would
+    strip it and re-carve bounds every push), never creates ladder
+    state, and reads as locked (nothing to explore)."""
+    p = _planner(min_compress=10**9)
+    n = 4_000_000
+    _lock_partition(p, n)
+    assert p.plan_compression(n) is None
+    assert p.compress_locked(n)
+    p.observe_compression(n, "none", 0.01)       # refused, not recorded
+    assert p.snapshot()["compression"]["buckets"] == {}
+
+
+def test_ladder_bucket_straddling_cutoff():
+    """Two tensors in ONE size bucket, one above and one below the
+    cutoff: the above-cutoff tensor explores and locks; the
+    below-cutoff one keeps planning None throughout (its pushes must
+    not churn codecs or pollute the bucket's samples)."""
+    p = _planner(min_compress=100_000)
+    above, below = 120_000, 80_000               # same bit_length bucket
+    assert above.bit_length() == below.bit_length()
+    _lock_partition(p, above)
+    for _ in range(8):
+        assert p.plan_compression(below) is None
+        kw = p.plan_compression(above)
+        key = (kw or {}).get("compressor", "none")
+        p.observe_compression(above, key, 0.01)
+        p.observe_compression(below, "none", 0.001)   # refused
+    assert p.compress_locked(above)
+    assert p.compress_locked(below)              # under cutoff: trivially
+    assert p.plan_compression(below) is None
+
+
+def test_ladder_multiprocess_inert():
+    p = _planner(procs=2)
+    assert not p.compress_active
+    assert p.plan_compression(4_000_000) is None
+    assert p.compress_locked(4_000_000)
+
+
+def test_ladder_off_by_default():
+    assert Config().compress_autotune is False
+    p = _planner(autotune=False)
+    assert not p.compress_active
+    assert p.plan_compression(4_000_000) is None
+
+
+def test_explicit_kwargs_pin_never_tuned(bps_ladder):
+    """Pin semantics: a tensor pushed with explicit ``compression=``
+    kwargs keeps its codec forever — the ladder never touches it, even
+    across later bare pushes."""
+    eng = bps.core.api._engine
+    x = np.random.RandomState(1).randn(40_000).astype(np.float32)
+    eng.push_pull_async(_stacked(x), "pin/c", op="sum",
+                        out_shape=(40_000,), compression=ONEBIT_EF).wait()
+    ctx = eng.registry.get("pin/c")
+    assert ctx.compression_tuned is False
+    eng.push_pull_async(_stacked(x), "pin/c", op="sum",
+                        out_shape=(40_000,)).wait()
+    assert ctx.compression_kwargs == ONEBIT_EF
+
+
+def test_explicit_kwargs_repin_ladder_owned_tensor(bps_ladder):
+    """The converse pin: a tensor FIRST pushed bare (ladder-owned) that
+    later receives explicit ``compression=`` kwargs is re-pinned to the
+    caller's codec — the planner must not keep retuning a tensor whose
+    caller just named a codec (the push would silently ship different
+    gradient semantics than asked)."""
+    eng = bps.core.api._engine
+    x = np.random.RandomState(3).randn(40_000).astype(np.float32)
+    eng.push_pull_async(_stacked(x), "repin/c", op="sum",
+                        out_shape=(40_000,)).wait()
+    ctx = eng.registry.get("repin/c")
+    assert ctx.compression_tuned is True
+    eng.push_pull_async(_stacked(x), "repin/c", op="sum",
+                        out_shape=(40_000,), compression=ONEBIT_EF).wait()
+    assert ctx.compression_tuned is False
+    assert ctx.compression_kwargs == ONEBIT_EF
+    # and it STAYS pinned across later bare pushes
+    eng.push_pull_async(_stacked(x), "repin/c", op="sum",
+                        out_shape=(40_000,)).wait()
+    assert ctx.compression_kwargs == ONEBIT_EF
+
+
+def test_explicit_kwargs_pin_survives_inflight_push(bps_ladder):
+    """A re-pin arriving while another push of the tensor is in flight
+    must not be lost: ownership flips immediately, the codec itself is
+    recorded as pending and applied at the next idle push."""
+    eng = bps.core.api._engine
+    x = np.random.RandomState(4).randn(40_000).astype(np.float32)
+    eng.push_pull_async(_stacked(x), "repin/f", op="sum",
+                        out_shape=(40_000,)).wait()
+    ctx = eng.registry.get("repin/f")
+    assert ctx.compression_tuned is True
+    with ctx.lock:
+        ctx.inflight += 1          # a concurrent push holds a claim
+    try:
+        eng.push_pull_async(_stacked(x), "repin/f", op="sum",
+                            out_shape=(40_000,),
+                            compression=ONEBIT_EF).wait()
+        assert ctx.compression_tuned is False
+        assert ctx.compression_pin == ONEBIT_EF      # deferred, not lost
+    finally:
+        with ctx.lock:
+            ctx.inflight -= 1
+    eng.push_pull_async(_stacked(x), "repin/f", op="sum",
+                        out_shape=(40_000,)).wait()
+    assert ctx.compression_pin is None
+    assert ctx.compression_kwargs == ONEBIT_EF
+
+
+def test_refresh_gauges_zeroes_retired_codec(bps_chunked):
+    """A ladder retune must not leave the previous codec's
+    ``compression.active`` series at 1.0 — the bps_top CODEC column
+    would show a codec the tensor no longer uses."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.bps_top import _codec_cell
+    eng = bps.core.api._engine
+    x = np.random.RandomState(5).randn(40_000).astype(np.float32)
+    eng.push_pull_async(_stacked(x), "gz/c", op="sum",
+                        out_shape=(40_000,), compression=ONEBIT_EF).wait()
+    eng.refresh_compression_gauges()
+    assert _codec_cell(gauges.snapshot()) == "onebit"
+    ctx = eng.registry.get("gz/c")
+    with ctx.lock:
+        eng.registry.retune_compression_locked(
+            ctx, {"compressor": "topk", "k": "0.25", "ef": "vanilla"},
+            eng.cfg.partition_bytes)
+    eng._ensure_compression(ctx, np.float32)
+    eng.refresh_compression_gauges()
+    snap = gauges.snapshot()
+    assert snap['compression.active{codec="onebit",tensor="gz/c"}'] == 0.0
+    assert snap['compression.active{codec="topk",tensor="gz/c"}'] == 1.0
+    assert _codec_cell(snap) == "topk"
+
+
+def test_engine_ladder_explores_and_applies_locked_codec(bps_ladder):
+    """Integration: a bare tensor under the ladder explores every
+    candidate across real pushes (codec swapped between pushes at
+    inflight == 0), locks, and later pushes carry the locked codec."""
+    eng = bps.core.api._engine
+    rng = np.random.RandomState(0)
+    n = 40_000
+    nbytes = n * 4
+    for _ in range(80):
+        eng.push_pull_local(rng.randn(n).astype(np.float32), "tune/w")
+        if (eng.planner.locked(nbytes)
+                and eng.planner.compress_locked(nbytes)):
+            break
+    assert eng.planner.compress_locked(nbytes)
+    snap = eng.planner.snapshot()["compression"]["buckets"][
+        str(nbytes.bit_length())]
+    assert set(snap["explored"]) == {k for k, _ in COMPRESS_LADDER}
+    locked = snap["locked_codec"]
+    eng.push_pull_local(rng.randn(n).astype(np.float32), "tune/w")
+    ctx = eng.registry.get("tune/w")
+    got = (ctx.compression_kwargs.get("compressor", "none")
+           if ctx.compression_kwargs else "none")
+    assert got == locked
+
+
+# ------------------------------------------------- elastic world changes
+
+
+@pytest.mark.chaos
+def test_compressed_push_crossing_world_change_drops_not_sums(bps_session):
+    """A compressed chunk enqueued before a membership epoch change must
+    be dropped with ABORTED, exactly like the uncompressed path — its
+    quantized contribution must never be summed into the new world."""
+    from byteps_tpu.fault import membership as mm
+    eng = bps.core.api._engine
+    ep0 = mm.current_epoch()
+    try:
+        eng.pause_dispatch()
+        x = np.ones((bps.size(), 65536), np.float32)
+        h = eng.push_pull_async(x, "cz/el", op="sum",
+                                compression=ONEBIT_EF)
+        mm.set_epoch(ep0 + 1)
+        eng.resume_dispatch()
+        with pytest.raises(RuntimeError, match="stale membership epoch"):
+            h.wait(timeout=30)
+        assert counters.get("membership.stale_chunks_dropped") >= 1
+    finally:
+        eng.resume_dispatch()
+        mm._reset_epoch_for_tests()
+
+
+@pytest.mark.chaos
+def test_server_compressed_push_stale_mepoch_dropped():
+    """ServerEngine.push_compressed stamped with a dead membership epoch
+    is dropped at the door — before the wire decode even runs — and the
+    round completes from current-epoch pushes alone."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.compression import create as create_compressor
+    from byteps_tpu.server.engine import ServerEngine
+    eng = ServerEngine(num_threads=1)
+    try:
+        kw = {"compressor": "onebit"}
+        eng.register_compression("ck", kw, 64)
+        wc = create_compressor(kw, 64)
+        p, _ = wc.compress(jnp.asarray(np.ones(64, np.float32)),
+                           wc.init_state())
+        wire = wc.wire_encode(p)
+        c0 = counters.get("membership.stale_pushes_dropped")
+        eng.push_compressed("ck", wire, 0, 1,
+                            mepoch=eng.membership_epoch + 5)
+        assert counters.get("membership.stale_pushes_dropped") == c0 + 1
+        eng.push_compressed("ck", wire, 0, 1,
+                            mepoch=eng.membership_epoch)
+        out = eng.pull("ck", timeout=10)
+        assert np.isfinite(out).all()
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------- observability
+
+
+def test_compression_counters_and_gauges(bps_chunked):
+    eng = bps.core.api._engine
+    x = np.random.RandomState(2).randn(40_000).astype(np.float32)
+    eng.push_pull_async(_stacked(x), "obs/c", op="sum",
+                        out_shape=(40_000,), compression=ONEBIT_EF).wait()
+    assert counters.get("compression.wire_bytes") > 0
+    assert counters.get("compression.bytes_saved") > 0
+    # onebit at 160 KB: payload is ~1/32 of raw — saved dwarfs shipped
+    assert counters.get("compression.bytes_saved") \
+        > 10 * counters.get("compression.wire_bytes")
+    eng.refresh_compression_gauges()
+    snap = gauges.snapshot()
+    assert any(k.startswith("compression.active{") and "onebit" in k
+               for k in snap)
+    norms = [v for k, v in snap.items()
+             if k.startswith("compression.ef_norm{")]
+    assert norms and norms[0] > 0
+
+
+def test_bps_top_codec_column():
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools import bps_top
+    cluster = {"epoch": 0, "world": [0, 1], "ranks": {
+        0: {"age_s": 0.1, "metrics": {
+            "gauges": {'compression.codec_locked{bucket="22",'
+                       'codec="onebit"}': 1.0},
+            "counters": {}, "step": {}}},
+        1: {"age_s": 0.1, "metrics": {
+            "gauges": {}, "counters": {}, "step": {}}}}}
+    text = bps_top.render(cluster)
+    assert "CODEC" in text
+    rows = text.splitlines()
+    assert any("onebit" in r for r in rows)      # rank 0 shows its codec
+    r1 = next(r for r in rows if r.strip().startswith("1 "))
+    assert " - " in r1 or r1.split()[7] == "-"   # rank 1 shows '-'

@@ -1055,7 +1055,7 @@ def test_async_drop_retry_never_double_sums():
 
 # -- the headline proof: 3-process bitflip chaos, bit-identical result ------
 
-def _run_three_workers(tmp_path, spec: str, tag: str):
+def _run_three_workers(tmp_path, spec: str, tag: str, compress: str = ""):
     port = _free_port()
     out = tmp_path / f"params-{tag}.bin"
     procs = []
@@ -1067,6 +1067,7 @@ def _run_three_workers(tmp_path, spec: str, tag: str):
             "BYTEPS_INTEG_RANK": str(rank),
             "BYTEPS_INTEG_PORT": str(port),
             "BYTEPS_INTEG_OUT": str(out),
+            "BYTEPS_INTEG_COMPRESS": compress,
             "BYTEPS_FAULT_SPEC": spec if rank == 0 else "",
             "BYTEPS_FAULT_SEED": "17",
         })
@@ -1118,4 +1119,28 @@ def test_three_process_bitflip_chaos_converges_bit_identical(tmp_path):
     assert chaos_params == clean_params, (
         "chaos-run parameters diverged from the fault-free run: "
         f"sha256 {hashlib.sha256(chaos_params).hexdigest()[:16]} != "
+        f"{hashlib.sha256(clean_params).hexdigest()[:16]}")
+
+
+@pytest.mark.chaos
+def test_three_process_compressed_bitflip_converges_bit_identical(tmp_path):
+    """ISSUE 11 satellite: the same 3-process bitflip chaos, but on the
+    QUANTIZED wire — workers ship wire-encoded onebit+EF payloads, the
+    envelope wraps the compressed frame, and every corrupt frame must be
+    NACKed and retransmitted BEFORE the decode runs (one flipped bit in
+    a packed-sign payload would otherwise decode into a silent
+    many-element error that error feedback then bakes into every later
+    step).  Finals must be BIT-IDENTICAL to the fault-free compressed
+    run."""
+    chaos_params, chaos_stats = _run_three_workers(
+        tmp_path, "bitflip:site=server_push:p=0.05", "comp-chaos",
+        compress="onebit")
+    clean_params, clean_stats = _run_three_workers(
+        tmp_path, "", "comp-clean", compress="onebit")
+    assert chaos_stats["REJECTS"] > 0, chaos_stats
+    assert chaos_stats["RETRANS"] > 0, chaos_stats
+    assert clean_stats["REJECTS"] == 0, clean_stats
+    assert chaos_params == clean_params, (
+        "compressed chaos run diverged from the fault-free compressed "
+        f"run: sha256 {hashlib.sha256(chaos_params).hexdigest()[:16]} != "
         f"{hashlib.sha256(clean_params).hexdigest()[:16]}")

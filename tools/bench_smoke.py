@@ -101,6 +101,116 @@ def _measure(nbytes=8 * MB, reps=9):
             "metrics": diag}
 
 
+def _measure_compressed(nbytes=2 * MB, reps=5):
+    """Compressed lanes (ISSUE 11): onebit and randomk through the
+    engine's fused quantized path at a >= 1 MiB tensor.  Reported per
+    lane: wire-byte ratio vs uncompressed (analytic payload bytes — the
+    quantized reduce-leg contract), engine GB/s, per-rep throughput
+    ratio vs the uncompressed engine path (interleaved, same host
+    regime — the bench_smoke pairing trick), the codec-golden quality
+    figure, and a zero-compile flag (no new cache programs during the
+    timed reps: the AOT contract on the bench path).
+
+    Gating (floor file): onebit wire ratio must stay under
+    ``compressed_wire_ratio_max``, every lane's golden error under
+    ``compressed_quality_ceiling`` (deterministic — no tolerance), and
+    the throughput ratio over ``compressed_throughput_floor`` with the
+    lane tolerance.  On a CPU mesh compression is compute-bound and
+    SLOWER than uncompressed (the wire it saves is emulated); the
+    throughput floor guards the machinery from regressing further, the
+    wire ratio is what the feature ships."""
+    import jax
+    import numpy as np
+
+    from byteps_tpu.comm.mesh import CommContext, _build_mesh
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.compression import registry as creg
+    from byteps_tpu.core.engine import PushPullEngine
+
+    devices = jax.devices()
+    comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1,
+                       n_ici=len(devices))
+    cfg = Config(telemetry_on=False, trace_on=False,
+                 min_compress_bytes=4096)
+    eng = PushPullEngine(comm, cfg)
+    lanes = {}
+    try:
+        n = nbytes // 4
+        x = np.random.RandomState(3).randn(n).astype(np.float32)
+        stacked = np.ascontiguousarray(
+            np.broadcast_to(x[None], (comm.num_ranks, n)))
+
+        def push(name, **kw):
+            h = eng.push_pull_async(stacked, name, op="sum",
+                                    out_shape=(n,), **kw)
+            out = h.wait()
+            import jax as _jax
+            _jax.block_until_ready(out)
+
+        eng.declare_tensor("cmp.base", (n,), np.float32, op="sum",
+                           local=False)
+        push("cmp.base")
+        for codec, kwargs in (
+                ("onebit", {"compressor": "onebit", "ef": "vanilla"}),
+                ("randomk", {"compressor": "randomk", "k": "0.25",
+                             "ef": "vanilla"})):
+            name = f"cmp.{codec}"
+            eng.declare_tensor(name, (n,), np.float32, op="sum",
+                               compression=kwargs)
+            push(name, compression=kwargs)      # warm (states, staging)
+            ctx = eng.registry.get(name)
+            payload = sum(s.worker.payload_nbytes()
+                          for s in (ctx.compressor or ()))
+            m0 = counters.get("engine.compile_cache_miss")
+            base_t, lane_t, ratios = [], [], []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                push("cmp.base")
+                tb = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                push(name, compression=kwargs)
+                tc = time.perf_counter() - t0
+                base_t.append(tb)
+                lane_t.append(tc)
+                ratios.append(tb / tc)   # compressed/uncompressed tput
+            def med(xs):
+                m, _, _ = quantile_stats_raw(xs)
+                return m
+            lanes[codec] = {
+                "wire_ratio": round(payload / nbytes, 4),
+                "gbps": round(nbytes / med(lane_t) / 1e9, 3),
+                "uncompressed_gbps": round(nbytes / med(base_t) / 1e9, 3),
+                "throughput_ratio": round(med(ratios), 3),
+                "golden_error": round(creg.golden_error(kwargs), 4),
+                "zero_compile": counters.get("engine.compile_cache_miss")
+                == m0,
+            }
+    finally:
+        eng.shutdown(wait=False)
+    return lanes
+
+
+def _compressed_ok(lanes: dict, floor: dict, tol: float) -> bool:
+    """The compressed gate (pure; pinned by a unit test like the
+    straggler gate): onebit's wire ratio and every lane's golden error
+    are deterministic contracts — no tolerance; the throughput ratio is
+    a host measurement and takes the lane tolerance."""
+    ratio_max = floor.get("compressed_wire_ratio_max", 0.35)
+    quality_max = floor.get("compressed_quality_ceiling", 0.55)
+    tput_floor = floor.get("compressed_throughput_floor", 0.0)
+    ok = True
+    for codec, lane in lanes.items():
+        lane_ok = lane["golden_error"] <= quality_max
+        if codec == "onebit":
+            lane_ok = lane_ok and lane["wire_ratio"] <= ratio_max
+        lane_ok = lane_ok and (lane["throughput_ratio"]
+                               >= tput_floor * (1.0 - tol))
+        lane["ok"] = lane_ok
+        ok = ok and lane_ok
+    return ok
+
+
 def _measure_serve():
     """Serving lane (ISSUE 9): pulls/sec + p99 pull latency under
     concurrent training pushes, recorded beside the push figures so the
@@ -186,11 +296,19 @@ def main() -> int:
     out = _measure()
     out["serve"] = _measure_serve()
     out["straggler"] = _measure_straggler()
+    out["compressed"] = _measure_compressed()
     if "--update-floor" in sys.argv:
+        # compressed throughput floor: half the measured worst lane —
+        # room for host noise, still catches a machinery collapse
+        worst_tput = min(lane["throughput_ratio"]
+                         for lane in out["compressed"].values())
         floor = {"engine_vs_fused_ratio": out["engine_vs_fused_ratio"],
                  "engine_8MB_gbps": out["engine_8MB_gbps"],
                  "straggler_hedge_p99_factor": 2.0,
                  "straggler_hedge_p99_abs_ms": 5.0,
+                 "compressed_wire_ratio_max": 0.35,
+                 "compressed_quality_ceiling": 0.55,
+                 "compressed_throughput_floor": round(worst_tput / 2, 3),
                  "note": "measured floor; the lane fails below "
                          "ratio * (1 - tolerance)"}
         with open(FLOOR_PATH, "w") as f:
@@ -219,7 +337,8 @@ def main() -> int:
                  or out["engine_8MB_gbps"] >= gate_a)
     straggler_ok = _straggler_ok(out["straggler"], floor)
     out["straggler"]["ok"] = straggler_ok
-    out["ok"] = engine_ok and straggler_ok
+    compressed_ok = _compressed_ok(out["compressed"], floor, tol)
+    out["ok"] = engine_ok and straggler_ok and compressed_ok
     print(json.dumps(out))
     if not engine_ok:
         print(f"bench-smoke FAIL: engine_vs_fused_ratio "
@@ -234,6 +353,16 @@ def main() -> int:
               f"{st['gate_ms']}ms (no-fault p99 {st['p99_nofault_ms']}ms, "
               f"unhedged {st['p99_unhedged_ms']}ms) — the hedge path is "
               f"no longer bounding the tail", file=sys.stderr)
+    if not compressed_ok:
+        bad = {k: v for k, v in out["compressed"].items()
+               if not v.get("ok")}
+        print(f"bench-smoke FAIL: compressed lane(s) {sorted(bad)} "
+              f"violate the floor (wire ratio max "
+              f"{floor.get('compressed_wire_ratio_max')}, quality "
+              f"ceiling {floor.get('compressed_quality_ceiling')}, "
+              f"throughput floor "
+              f"{floor.get('compressed_throughput_floor')}): {bad}",
+              file=sys.stderr)
     return 0 if out["ok"] else 1
 
 

@@ -31,7 +31,26 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "RETX",
-            "PULLS", "SLOW", "STATE", "EPOCH", "STEP", "AGE")
+            "PULLS", "CODEC", "SLOW", "STATE", "EPOCH", "STEP", "AGE")
+
+
+def _codec_cell(gauges: dict) -> str:
+    """The rank's active compression codecs, from the labeled
+    ``compression.codec_locked{bucket=..,codec=..}`` (planner-ladder
+    locks) and ``compression.active{tensor=..,codec=..}`` (explicitly
+    configured tensors) gauges.  '-' = nothing compressed on this rank;
+    multiple distinct codecs join with ','."""
+    import re
+    codecs = set()
+    for series, value in gauges.items():
+        if not value:
+            continue       # a zeroed series is a RETIRED codec
+        if series.startswith(("compression.codec_locked{",
+                              "compression.active{")):
+            m = re.search(r'codec="([^"]*)"', series)
+            if m:
+                codecs.add(m.group(1))
+    return ",".join(sorted(codecs)) if codecs else "-"
 
 
 def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
@@ -68,6 +87,8 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
         # serving plane (server/serving.py): cumulative pulls served by
         # this rank — 0 everywhere means the rank runs no read plane
         fmt(counters.get("serve.pulls", 0)),
+        # compression (ISSUE 11): which codec(s) this rank's pushes ride
+        _codec_cell(gauges),
         # gray-failure columns: the coordinator's phi suspicion of this
         # rank's step-barrier lag, and whether it is demoted right now
         fmt(slow, "{:.1f}"),

@@ -55,8 +55,20 @@ def _model(width=512, depth=8, seed=0):
     return torch.nn.Sequential(*layers)
 
 
+# --compression lanes: codec kwargs handed to the torch wrappers so the
+# overlap figures exist for compressed streams too (ISSUE 11 satellite —
+# a fused quantized pipeline that destroyed overlap would be invisible
+# to the GB/s micro-benches)
+COMPRESSION_KWARGS = {
+    "none": None,
+    "onebit": {"compressor": "onebit", "ef": "vanilla"},
+    "randomk": {"compressor": "randomk", "k": "0.25", "ef": "vanilla"},
+    "topk": {"compressor": "topk", "k": "0.25", "ef": "vanilla"},
+}
+
+
 def one_mode_pass(mode: str, steps=6, warmup=2, width=512, depth=8,
-                  batch=64):
+                  batch=64, compression=None):
     """A fresh model trained ``steps`` measured steps in one mode.
 
     A fresh model per pass keeps wrapper hooks from accumulating across
@@ -78,10 +90,10 @@ def one_mode_pass(mode: str, steps=6, warmup=2, width=512, depth=8,
     if mode == "nocomm":
         wrapped, stepper, sync = model, opt.step, lambda: None
     elif mode == "sync":
-        wrapped = DistributedDataParallel(model)
+        wrapped = DistributedDataParallel(model, compression=compression)
         stepper, sync = opt.step, lambda: None
     else:  # xb
-        xb = CrossBarrier(model, opt)
+        xb = CrossBarrier(model, opt, compression=compression)
         wrapped, stepper, sync = model, xb.step, xb.synchronize
 
     times, losses = [], []
@@ -99,7 +111,7 @@ def one_mode_pass(mode: str, steps=6, warmup=2, width=512, depth=8,
     return times, losses
 
 
-def _measure(width=512, rounds=4):
+def _measure(width=512, rounds=4, compression=None):
     """Interleave modes at round granularity: slow load drift on a shared
     host then hits every mode equally instead of whichever mode ran last
     (the round-3 artifact's failure mode).
@@ -121,7 +133,7 @@ def _measure(width=512, rounds=4):
     for _ in range(rounds):
         meds = {}
         for m in modes:
-            ts, ls = one_mode_pass(m, width=width)
+            ts, ls = one_mode_pass(m, width=width, compression=compression)
             all_times[m] += ts
             all_losses[m] = ls
             meds[m] = sorted(ts)[len(ts) // 2]
@@ -214,7 +226,17 @@ def _pin_disjoint():
             "other_threads_pinned": pinned_others}, None
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--compression", default="none",
+                    choices=sorted(COMPRESSION_KWARGS),
+                    help="gradient codec for the sync/xb modes: "
+                         "overlap_fraction is then measured on the "
+                         "fused quantized stream (nocomm is codec-free "
+                         "by construction)")
+    args = ap.parse_args(argv)
+    compression = COMPRESSION_KWARGS[args.compression]
     setup_cpu8_mesh()
     from byteps_tpu.common.config import Config
     from byteps_tpu.core import api
@@ -231,7 +253,8 @@ def main() -> int:
                  scheduling_credit=2 * width * width * 4)
     api.init(cfg)
     try:
-        out = _measure(width=width)
+        out = _measure(width=width, compression=compression)
+        out["compression"] = args.compression
         # Pinned re-measure (round-4 VERDICT task 4 path B): by now the
         # engine + XLA threads all exist, so the disjoint split reaches
         # them.  On a 1-core host the skip reason IS the datum: it
@@ -240,7 +263,7 @@ def main() -> int:
         if info is None:
             out["pinned_disjoint"] = {"skipped": reason}
         else:
-            pinned = _measure(width=width)
+            pinned = _measure(width=width, compression=compression)
             pinned["pinning"] = info
             out["pinned_disjoint"] = pinned
         # Engine-side evidence beside the end-to-end figures (ISSUE 6):

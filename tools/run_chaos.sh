@@ -13,7 +13,15 @@
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
-#               | straggler | all
+#               | straggler | compressed | all
+#         compressed: chaos on the QUANTIZED wire path — a 3-process
+#              compressed run under bitflip:site=server_push converges
+#              bit-identical (every corrupt quantized frame NACKed and
+#              retransmitted before the decode runs), a compressed push
+#              crossing an elastic world change drops-not-sums, and the
+#              declare-time validation/zero-compile pins
+#              (tests/test_compressed_aot.py, tests/test_integrity.py
+#              compressed tests)
 #         serve: the serving-plane chaos slice — replica kill under
 #              concurrent training pushes (zero failed reads, primary
 #              degradation) and serve_pull reply corruption
@@ -62,6 +70,7 @@ case "${1:-}" in
     straggler) MARK="chaos"
                KEXPR="straggler or demote or hedge or stall"
                shift ;;
+    compressed) MARK="chaos or integrity"; KEXPR="compress"; shift ;;
     all)       MARK="chaos or integrity"; shift ;;
 esac
 
