@@ -78,6 +78,26 @@ ALIGN_BYTES = 4096
 PARTITION_BYTES_DEFAULT = 4096000
 
 
+def _parse_trace_sample(spec: str) -> int:
+    """``BYTEPS_TRACE_SAMPLE`` grammar: '' / '0' = off; 'N' or '1/N' =
+    capture every Nth push.  Lives here (not common/tracing.py) so
+    Config validation needs no import of the tracer."""
+    s = (spec or "").strip()
+    if not s or s == "0":
+        return 0
+    if s.startswith("1/"):
+        s = s[2:]
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"BYTEPS_TRACE_SAMPLE must be '1/N' or an integer N (0 = "
+            f"off), got {spec!r}") from None
+    if n < 0:
+        raise ValueError(f"BYTEPS_TRACE_SAMPLE must be >= 0, got {spec!r}")
+    return n
+
+
 @dataclasses.dataclass
 class Config:
     """Process-wide configuration, normally built once via :func:`get_config`."""
@@ -345,6 +365,28 @@ class Config:
     trace_end_step: int = 20         # BYTEPS_TRACE_END_STEP
     trace_dir: str = "."             # BYTEPS_TRACE_DIR
     trace_jax: bool = False          # BYTEPS_TRACE_JAX (device profiler)
+    trace_sample: str = ""           # BYTEPS_TRACE_SAMPLE: '1/N' (or a
+    #                                  bare N) keeps a sampled causal
+    #                                  span stream live in production —
+    #                                  every Nth push is captured end to
+    #                                  end (enqueue → dispatch → wire →
+    #                                  merge → retire, flow-linked) with
+    #                                  NO step window armed; '' / '0' =
+    #                                  off.  Resolved to trace_sample_n.
+    trace_sample_n: int = -1         # resolved form of trace_sample
+    #                                  (__post_init__); -1 = derive
+    trace_capacity: int = 65536      # BYTEPS_TRACE_CAPACITY: in-memory
+    #                                  event-buffer bound; past it the
+    #                                  buffer spills to an ndjson side
+    #                                  file (folded back in at flush) and
+    #                                  unspillable events are counted in
+    #                                  trace.events_dropped, never heap
+    clock_sync_samples: int = 5      # BYTEPS_CLOCK_SYNC_SAMPLES: ping
+    #                                  round-trips used to estimate this
+    #                                  rank's wall-clock offset against
+    #                                  the membership coordinator (best =
+    #                                  min-RTT sample, NTP style) for the
+    #                                  merged cluster timeline; 0 = off
     telemetry_on: bool = True        # BYTEPS_TELEMETRY_ON
     obs_port: Optional[int] = None   # BYTEPS_OBS_PORT: per-process HTTP
     #                                  observability endpoint (/metrics,
@@ -466,6 +508,12 @@ class Config:
             raise ValueError("obs_port must be in 0..65535 (0 = ephemeral)")
         if self.flight_capacity <= 0:
             raise ValueError("flight_capacity must be positive")
+        if self.trace_sample_n < 0:
+            self.trace_sample_n = _parse_trace_sample(self.trace_sample)
+        if self.trace_capacity < 256:
+            raise ValueError("trace_capacity must be >= 256")
+        if self.clock_sync_samples < 0:
+            raise ValueError("clock_sync_samples must be >= 0 (0 = off)")
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -560,6 +608,9 @@ class Config:
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "."),
             trace_jax=_env_bool("BYTEPS_TRACE_JAX", False),
+            trace_sample=_env_str("BYTEPS_TRACE_SAMPLE", ""),
+            trace_capacity=_env_int("BYTEPS_TRACE_CAPACITY", 65536),
+            clock_sync_samples=_env_int("BYTEPS_CLOCK_SYNC_SAMPLES", 5),
             telemetry_on=_env_bool("BYTEPS_TELEMETRY_ON", True),
             obs_port=(_env_int("BYTEPS_OBS_PORT", 0)
                       if os.environ.get("BYTEPS_OBS_PORT") not in (None, "")

@@ -87,6 +87,18 @@ class FlightRecorder:
         if not self.enabled:
             return
         ev = {"t": time.time(), "mono": time.monotonic(), "kind": kind}
+        # stamp the active (step, trace_id) so a crash black box
+        # cross-references the merged timeline (ISSUE 12 satellite);
+        # explicit fields of the same name win below
+        try:
+            from . import tracing as _tracing
+            step, trace_id = _tracing.last_stamp()
+            if step:
+                ev["step"] = step
+            if trace_id:
+                ev["trace_id"] = trace_id
+        except Exception:  # noqa: BLE001 — recording must never raise
+            pass
         if fields:
             ev.update(fields)
         with self._lock:
@@ -233,10 +245,13 @@ def _sigterm_hook(signum, frame):
 def _atexit_hook():
     try:
         # a run that exits without calling bps.shutdown() still flushes
-        # its comm trace tail (Tracer.flush is idempotent)
-        from ..core import api
-        if api.initialized():
-            api._require().tracer.flush()
+        # its comm trace tail (Tracer.flush is idempotent) — and events
+        # recorded AFTER shutdown (late bus barrier closes, serving
+        # spans) land too, because the process tracer outlives the
+        # engine (common/tracing.py singleton)
+        from . import tracing as _tracing
+        if _tracing._tracer is not None:
+            _tracing._tracer.flush()
     except Exception:  # noqa: BLE001
         pass
     recorder.maybe_exit_dump()

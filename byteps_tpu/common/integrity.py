@@ -374,6 +374,22 @@ def wire_transmit(frame: bytes, *, key: str, worker: int, seq: int,
     policy = RetryPolicy(max_attempts=budget + 1, base_delay_s=0.0,
                          max_delay_s=0.0, retry_on=(IntegrityError,))
     out = policy.call(transmit, describe=f"{who} {key!r} wire")
+    dt = time.monotonic() - t0
+    # Step attribution (ISSUE 12): the hop's wall time — retransmit
+    # rounds included — is the step's "wire" component.
+    from .telemetry import attribution
+    attribution.add("wire", dt * 1e3)
+    # Causal tracing: when the caller's operation is captured, this hop
+    # lands as a span on the operation's arc (flow step "t") — the wire
+    # leg of enqueue → dispatch → wire → merge → retire.
+    ctx = _tracing_mod().current()
+    if ctx is not None:
+        tr = _tracing_mod().tracer()
+        if tr.active:
+            tr.record_traced(ctx.trace_id, f"wire:{site}", f"wire/{site}",
+                             t0, t0 + dt, key=key, worker=worker, seq=seq,
+                             attempts=attempts["n"])
+            tr.flow(ctx.trace_id, "t", f"wire/{site}", t0)
     if attempts["n"] > 1:
         record_span("retransmit", t0, key=key, worker=worker, seq=seq,
                     attempts=attempts["n"])
@@ -427,6 +443,13 @@ def screen_nonfinite(arr: np.ndarray, *, what: str, key: str,
 
 
 # -- tracing ----------------------------------------------------------------
+
+def _tracing_mod():
+    """Lazy accessor: integrity is imported very early (telemetry's
+    import chain), so the tracing module is resolved at call time."""
+    from . import tracing
+    return tracing
+
 
 def record_span(name: str, t0: float, **meta) -> None:
     """Integrity event span into the live engine's tracer (best-effort,

@@ -130,6 +130,11 @@ def debug_state() -> dict:
             "capacity": _flight.recorder._ring.maxlen,
         },
     }
+    # causal-tracing state (ISSUE 12): is the sampled stream live, how
+    # full/bounded is the buffer, and the clock alignment the merged
+    # timeline will use
+    from . import tracing as _tracing
+    doc["trace"] = _tracing.tracer().debug_state()
     # gray-failure view (utils/slowness.py): per-(site, peer) latency
     # medians + phi scores, with the labeled gauges re-stamped so a
     # /metrics scrape that follows this sees the same figures

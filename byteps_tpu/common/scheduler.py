@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import List, Optional
 
 from .config import ALIGN_BYTES
+from .telemetry import attribution as _attribution
 from .types import ChunkTask
 
 
@@ -71,10 +73,19 @@ class ChunkScheduler:
         quantum).  An interrupted call returns ``None``."""
         with self._cv:
             if block:
+                # credit-stall attribution (ISSUE 12): tasks are queued
+                # but the byte window is full — the wait about to happen
+                # is a CREDIT stall, not idleness; charge it to the
+                # step's attrib_credit_ms component
+                credit_gated = bool(self._heap) and not self._eligible_locked()
+                t0 = time.monotonic() if credit_gated else 0.0
                 self._cv.wait_for(
                     lambda: (self._eligible_locked() or self._shutdown
                              or self._interrupts > 0),
                     timeout=timeout)
+                if credit_gated:
+                    _attribution.add(
+                        "credit", (time.monotonic() - t0) * 1e3)
             if block and self._interrupts > 0:
                 self._interrupts -= 1
             if not self._eligible_locked():

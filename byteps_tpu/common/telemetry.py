@@ -39,6 +39,41 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from .metrics import (Counters, Gauges, Histograms,  # noqa: F401
                       counters, gauges, histograms, registry)
+from . import tracing as _tracing
+
+
+class AttributionSink:
+    """Process-wide wall-time accumulators for step attribution
+    (ISSUE 12 tentpole part 3).
+
+    Components that happen OFF the engine's own threads — the sealed
+    envelope wire hops (``wire``, incl. retransmit rounds), the server
+    engine's merge work (``merge``), scheduler credit-gated waits
+    (``credit``), compile stalls detected on the dispatch path
+    (``compile``) — land here as they occur; the active
+    :class:`StepStatsTracker` snapshots the totals at each step boundary
+    and publishes the per-step deltas as ``step.attrib_*`` gauges.  One
+    lock + one dict add per event: cheap enough to stay unconditional
+    (every feed site already does comparable work per call)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ms: Dict[str, float] = {}
+
+    def add(self, component: str, ms: float) -> None:
+        with self._lock:
+            self._ms[component] = self._ms.get(component, 0.0) + ms
+
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._ms)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ms.clear()
+
+
+attribution = AttributionSink()
 
 
 class SpeedMonitor:
@@ -130,6 +165,14 @@ class StepStats:
     retransmits: int
     wall_ms: float
     overlap_fraction: float
+    # ISSUE 12: per-step critical-path breakdown (ms) — queue wait,
+    # credit stall, wire (incl. retransmits), server merge, sync block,
+    # compile, plus an "other" residual so the components always account
+    # for the full wall time.  Empty dict on pre-attribution records.
+    attrib: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # the tensor whose unit retired LAST in this step — the chain the
+    # step's completion actually waited on
+    lagging_tensor: Optional[str] = None
 
     def as_dict(self) -> Dict[str, float]:
         return dataclasses.asdict(self)
@@ -159,6 +202,13 @@ class StepStatsTracker:
         self._stall_ms = 0.0
         self._retx0 = counters.get("integrity.retransmit")
         self._history: Deque[StepStats] = collections.deque(maxlen=history)
+        # step-attribution state (ISSUE 12): baseline of the process-wide
+        # sink at the step boundary, locally fed components (queue wait),
+        # and the last-retired tensor (the lagging chain)
+        self._attrib0: Dict[str, float] = attribution.totals()
+        self._comp: Dict[str, float] = {}
+        self._last_retired: Optional[str] = None
+        self._pub_attrib: set = set()   # gauge keys published last step
         if recorder is None:
             from . import flight_recorder as _flight
             recorder = _flight.recorder
@@ -180,6 +230,9 @@ class StepStatsTracker:
                     self._publish(self._finalize_locked())
                 self._step = step
                 self._t0 = time.perf_counter()
+                # flight-recorder stamp: every recorded event from here
+                # on carries this step even with tracing off
+                _tracing.note_step(step)
             self._bytes += int(nbytes)
             self._pushes += 1
 
@@ -187,11 +240,44 @@ class StepStatsTracker:
         with self._lock:
             self._stall_ms += ms
 
+    def add_component(self, component: str, ms: float) -> None:
+        """Engine-local attribution feed (e.g. ``queue`` — scheduler
+        wait of each retired unit's head chunk)."""
+        with self._lock:
+            self._comp[component] = self._comp.get(component, 0.0) + ms
+
+    def note_retire(self, name: str) -> None:
+        """The syncer names each retired unit's tensor; the last one
+        standing when the step finalizes is the lagging tensor."""
+        with self._lock:
+            self._last_retired = name
+
     # -- finalization ------------------------------------------------------
 
     def _finalize_locked(self) -> StepStats:
         wall_ms = max((time.perf_counter() - self._t0) * 1e3, 1e-6)
         retx = counters.get("integrity.retransmit")
+        # Per-step attribution (ISSUE 12): deltas of the process-wide
+        # sink (wire / merge / credit / compile / dispatch) + locally
+        # fed components (enqueue / queue / assemble) + the syncer's
+        # block time (sync).  "other" is max(0, wall - sum): components
+        # are wall-time integrals of each activity, so on a serialized
+        # profile they partition the step, while pipelined units or
+        # parallel merge/wire threads can overlap and push the sum PAST
+        # the wall (other clamps at 0) — documented in
+        # docs/observability.md.
+        now_tot = attribution.totals()
+        attrib: Dict[str, float] = {}
+        for k in set(now_tot) | set(self._attrib0):
+            d = now_tot.get(k, 0.0) - self._attrib0.get(k, 0.0)
+            if d > 0.0005:
+                attrib[k] = d
+        for k, v in self._comp.items():
+            attrib[k] = attrib.get(k, 0.0) + v
+        attrib["sync"] = attrib.get("sync", 0.0) + self._stall_ms
+        known = sum(attrib.values())
+        attrib["other"] = max(0.0, wall_ms - known)
+        attrib = {k: round(v, 3) for k, v in attrib.items()}
         stats = StepStats(
             step=self._step,
             bytes_pushed=self._bytes,
@@ -201,11 +287,16 @@ class StepStatsTracker:
             wall_ms=round(wall_ms, 3),
             overlap_fraction=round(
                 1.0 - min(1.0, self._stall_ms / wall_ms), 4),
+            attrib=attrib,
+            lagging_tensor=self._last_retired,
         )
         self._bytes = 0
         self._pushes = 0
         self._stall_ms = 0.0
         self._retx0 = retx
+        self._attrib0 = now_tot
+        self._comp = {}
+        self._last_retired = None
         self._history.append(stats)
         return stats
 
@@ -216,8 +307,23 @@ class StepStatsTracker:
         gauges.set("step.retransmits", stats.retransmits)
         gauges.set("step.wall_ms", stats.wall_ms)
         gauges.set("step.overlap_fraction", stats.overlap_fraction)
+        for comp, ms in stats.attrib.items():
+            gauges.set(f"step.attrib_{comp}_ms", ms)
+        # zero components absent THIS step (a step-5 compile stall must
+        # not haunt every later scrape — the gauge set always describes
+        # ONE step, summing to its wall_ms)
+        for comp in self._pub_attrib - set(stats.attrib):
+            gauges.set(f"step.attrib_{comp}_ms", 0.0)
+        self._pub_attrib = set(stats.attrib)
         counters.inc("step.completed")
-        self._recorder.record("step_stats", **stats.as_dict())
+        # the flight event names the lagging tensor and this rank — a
+        # crash black box says WHO the dying step was waiting on
+        try:
+            from .config import get_config
+            rank = get_config().host_id
+        except Exception:  # noqa: BLE001 — publishing must never raise
+            rank = 0
+        self._recorder.record("step_stats", rank=rank, **stats.as_dict())
 
     def flush(self) -> Optional[StepStats]:
         """Finalize the in-progress step (engine shutdown: the tail step

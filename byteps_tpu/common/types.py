@@ -154,6 +154,11 @@ class ChunkTask:
     step: int = 0
     t_enqueue: float = 0.0
     t_dispatch: float = 0.0
+    # causal tracing (ISSUE 12): the push's TraceContext id when this
+    # push was captured (windowed or sampled); 0 = uncaptured.  Shared
+    # by every chunk of one push — the flow arc is per push, not per
+    # chunk (the pending tensor tracks first/last emission).
+    trace_id: int = 0
 
     # Sort order matches the reference's addTask comparator: priority desc,
     # then key asc (scheduled_queue.cc:82-102).

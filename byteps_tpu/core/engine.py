@@ -46,9 +46,9 @@ from ..common.logging import get_logger
 from ..common.registry import TensorRegistry
 from ..common.scheduler import ChunkPlanner, ChunkScheduler
 from ..common import flight_recorder as _flight
-from ..common.telemetry import (SpeedMonitor, StepStatsTracker, counters,
-                                gauges, histograms)
-from ..common.tracing import Tracer
+from ..common import tracing as _tracing
+from ..common.telemetry import (SpeedMonitor, StepStatsTracker, attribution,
+                                counters, gauges, histograms)
 from ..common.types import ChunkTask, Status, StatusCode, TensorContext
 from ..fault import injector as _fault
 from ..fault import membership as _membership
@@ -204,6 +204,13 @@ class _PendingTensor:
         # old one is dropped, not delivered — the whole-world analog of
         # ServerEngine.reset_key's per-key epoch
         self.mepoch = _membership.current_epoch()
+        # causal tracing (ISSUE 12): one TraceContext per captured push;
+        # the flow arc is emitted once per push (s at the first chunk's
+        # retirement record, f at the last's) — both touched only on the
+        # single syncer thread
+        self.trace = None
+        self.trace_started = False
+        self.trace_left = self.total
         self._done = 0
         self.lock = threading.Lock()
 
@@ -252,7 +259,11 @@ class PushPullEngine:
         self.handles = HandleManager()
         self.scheduler = self._make_scheduler(cfg)
         self.speed = SpeedMonitor()
-        self.tracer = Tracer()
+        # ONE tracer per process (common/tracing.py): the engine, the
+        # membership bus, the wire hops and the serving plane all emit
+        # into the same per-rank trace file, so a push's flow arc can
+        # cross component boundaries
+        self.tracer = _tracing.tracer()
         # Per-step stats (bytes pushed, sync stall, retransmits, overlap
         # fraction) — surfaced through /metrics (step.* gauges), the
         # flight recorder, and the bench tools (ISSUE 6).
@@ -524,15 +535,26 @@ class PushPullEngine:
             pending = _PendingTensor(handle, ctx, out_shape, op, denom,
                                      use_buffer, comm=self.comm, scale=scale,
                                      shard_out=shard_out)
-            if self.tracer.enabled:
-                step = self.tracer.on_push(name)
-                t_enq = self.tracer.now()
+            if self.tracer.active:
+                # windowed AND/OR sampled capture decided here; tctx is
+                # None for pushes that record nothing
+                step, tctx = self.tracer.start_push(name)
             else:  # keep the hot enqueue path lock-free when tracing is off
-                step, t_enq = 0, 0.0
+                step, tctx = 0, None
+            if tctx is not None or self.cfg.telemetry_on:
+                # caller-side prep starts here: staging/validation wall
+                # until the tasks actually enter the queue is the step's
+                # "enqueue" component (the queued span/queue component
+                # begin at the LATER t_enq stamp, so the two never
+                # double-count)
+                t_api0 = time.monotonic()
+            else:
+                t_api0 = 0.0
             if self.cfg.telemetry_on:
                 # per-step accounting: same per-tensor step definition as
                 # the tracer, independent of the trace window
                 self.step_stats.on_push(name, est_nbytes)
+            pending.trace = tctx
             local_mode = local
             if local:
                 if use_buffer:
@@ -582,6 +604,15 @@ class PushPullEngine:
                 bounds = col_layout
             else:
                 bounds = ctx.chunk_bounds
+            if t_api0:
+                # tasks enter the queue NOW: the queued span / queue
+                # component start here; the prep above is "enqueue"
+                t_enq = time.monotonic()
+                if self.cfg.telemetry_on:
+                    self.step_stats.add_component(
+                        "enqueue", (t_enq - t_api0) * 1e3)
+            else:
+                t_enq = 0.0
             for part_idx, (off, ln) in enumerate(bounds):
                 # uncompressed parts mode (debug-sample, odd shapes) needs
                 # the materialized chunk; buffer mode, single-chunk
@@ -604,6 +635,7 @@ class PushPullEngine:
                     scale=scale,
                     pending=pending,
                     step=step, t_enqueue=t_enq,
+                    trace_id=tctx.trace_id if tctx is not None else 0,
                 )
                 task.callback = self._make_chunk_callback(pending, part_idx)
                 self.scheduler.add_task(task)
@@ -628,7 +660,7 @@ class PushPullEngine:
                         time.perf_counter() - t_plan0,
                         compiled=counters.get("engine.compile_cache_miss")
                         != miss0)
-                    if self.planner.locked(est_nbytes) and self.tracer.enabled:
+                    if self.planner.locked(est_nbytes) and self.tracer.active:
                         # lock transition (track_plan implies it was unlocked
                         # at enqueue): the moment exploration ended, with the
                         # winning chunk size, visible in the timeline
@@ -805,7 +837,7 @@ class PushPullEngine:
                     get_logger().debug(
                         "AOT-compiled %d compressed program(s) for %s",
                         n_compiled, name)
-                    if self.tracer.enabled:
+                    if self.tracer.active:
                         self.tracer.record_span(
                             "engine.aot_warm", t0, time.monotonic(),
                             tensor=name, programs=n_compiled)
@@ -824,7 +856,7 @@ class PushPullEngine:
             if n_compiled:
                 get_logger().debug("AOT-compiled %d program(s) for %s",
                                    n_compiled, name)
-                if self.tracer.enabled:
+                if self.tracer.active:
                     # compile stalls belong in the timeline at declare
                     # time, where they were paid — not smeared over the
                     # first push's span
@@ -1078,12 +1110,30 @@ class PushPullEngine:
                 if self.cfg.telemetry_on:
                     histograms.observe("engine.dispatch_unit_width",
                                        len(unit))
+                    # compile attribution (ISSUE 12): jit compiles are
+                    # synchronous inside the dispatch call (execution is
+                    # async), so a unit whose dispatch crossed a cache
+                    # miss spent its wall time compiling — charge it to
+                    # the step's attrib_compile_ms component
+                    t_d0 = time.perf_counter()
+                    miss0 = counters.get("engine.compile_cache_miss")
                 if kind == "run":
                     self._dispatch_buffer_run(unit)
                 elif kind == "group":
                     self._dispatch_parts_group(unit)
                 else:
                     self._dispatch_single(unit[0])
+                if self.cfg.telemetry_on:
+                    # a unit whose dispatch crossed a cache miss spent
+                    # its wall compiling; otherwise it was ordinary
+                    # program-launch work — both are real critical-path
+                    # segments (dispatch is synchronous, execution async)
+                    dt_d = (time.perf_counter() - t_d0) * 1e3
+                    if (counters.get("engine.compile_cache_miss")
+                            != miss0):
+                        attribution.add("compile", dt_d)
+                    else:
+                        attribution.add("dispatch", dt_d)
 
     def _dispatch_buffer_run(self, run: List[ChunkTask]):
         """One device program for a contiguous run of column-slab chunks:
@@ -1091,7 +1141,8 @@ class PushPullEngine:
         block-sharded accumulator (donated, in place)."""
         t0 = run[0]
         pending = t0.pending
-        now = self.tracer.now() if self.tracer.enabled else 0.0
+        now = (time.monotonic()
+               if self.cfg.telemetry_on or self.tracer.active else 0.0)
         for t in run:
             t.t_dispatch = now
         self.stats["dispatches"] += 1
@@ -1115,7 +1166,8 @@ class PushPullEngine:
         tensors (push_pull_arrays_batched): one dispatch replaces k, the
         per-chunk results come back separately so every downstream
         consumer (assembly, debug sampling, callbacks) is unchanged."""
-        now = self.tracer.now() if self.tracer.enabled else 0.0
+        now = (time.monotonic()
+               if self.cfg.telemetry_on or self.tracer.active else 0.0)
         t0 = group[0]
         for t in group:
             t.t_dispatch = now
@@ -1134,7 +1186,7 @@ class PushPullEngine:
             self._sync_q.put((group, None, None, e, 0.0))
 
     def _dispatch_single(self, task: ChunkTask):
-        task.t_dispatch = self.tracer.now()
+        task.t_dispatch = time.monotonic()
         self.stats["dispatches"] += 1
         self.stats["chunks"] += 1
         try:
@@ -1259,13 +1311,32 @@ class PushPullEngine:
                     histograms.observe(
                         "engine.unit_sync_ms",
                         (time.perf_counter() - t_disp) * 1e3)
+                if self.cfg.telemetry_on:
+                    # queue-wait attribution: how long this unit's head
+                    # chunk sat in the priority queue before dispatch —
+                    # plus the lagging-tensor bookkeeping (the LAST
+                    # retired unit before a step finalizes names the
+                    # chain the step actually waited on)
+                    head = tasks[0]
+                    if head.t_dispatch and head.t_enqueue:
+                        self.step_stats.add_component(
+                            "queue",
+                            (head.t_dispatch - head.t_enqueue) * 1e3)
+                    self.step_stats.note_retire(tasks[-1].name)
                 # Legacy-runtime serial mode (common/jax_compat.py): the
                 # callbacks below run eager assembly ops on this thread
                 # while the dispatcher executes programs on its own — the
                 # exact concurrency the old CPU runtime deadlocks on.
                 # Null context on modern runtimes.
+                t_fb0 = time.perf_counter() if self.cfg.telemetry_on else 0.0
                 with jax_compat.runtime_lock():
                     self._finish_batch(tasks, out, err)
+                if self.cfg.telemetry_on:
+                    # assembly + callback wall: the retirement work after
+                    # the device block — the tail segment of a push's
+                    # critical path (step attribution, ISSUE 12)
+                    self.step_stats.add_component(
+                        "assemble", (time.perf_counter() - t_fb0) * 1e3)
 
     def _deadline_loop(self):
         """Per-unit sync-deadline watchdog (BYTEPS_SYNC_DEADLINE_S): a
@@ -1326,14 +1397,37 @@ class PushPullEngine:
                 self._debug_sample(task, out_t)
             # credits for this task were returned in the sync loop's bulk
             # report_finish — nothing per-chunk here
-            if self.tracer.enabled:
-                t_done = self.tracer.now()
-                self.tracer.record(task.name, task.key, "queued",
-                                   task.t_enqueue, task.t_dispatch,
-                                   task.step, task.nbytes)
-                self.tracer.record(task.name, task.key, "push_pull",
-                                   task.t_dispatch, t_done, task.step,
-                                   task.nbytes)
+            if task.trace_id and self.tracer.active:
+                # captured push (window or sample): record the chunk's
+                # two spans against its trace id — NOT window-gated, the
+                # capture decision was made at start_push — and the
+                # per-PUSH flow arc: ``s`` anchored in the first chunk's
+                # queued span, ``f`` at the last chunk's retirement.
+                # This runs only on the single syncer thread, so the
+                # pending's trace bookkeeping needs no lock.
+                t_done = time.monotonic()
+                # a chunk dropped before dispatch (stale epoch) has no
+                # dispatch stamp: its whole life was the queue
+                t_disp = task.t_dispatch or t_done
+                self.tracer.record_traced(
+                    task.trace_id, "queued", task.name,
+                    task.t_enqueue, t_disp,
+                    key=task.key, step=task.step, bytes=task.nbytes)
+                if task.t_dispatch:
+                    self.tracer.record_traced(
+                        task.trace_id, "push_pull", task.name,
+                        t_disp, t_done,
+                        key=task.key, step=task.step, bytes=task.nbytes)
+                p = task.pending
+                if p is not None and p.trace is not None:
+                    if not p.trace_started:
+                        self.tracer.flow(task.trace_id, "s", task.name,
+                                         task.t_enqueue)
+                        p.trace_started = True
+                    p.trace_left -= 1
+                    if p.trace_left == 0:
+                        self.tracer.flow(task.trace_id, "f", task.name,
+                                         t_done)
             if self.cfg.telemetry_on:
                 # push + pull wire bytes; compressed chunks report
                 # payload size, which is the point of the feature

@@ -394,6 +394,47 @@ def bus_request(addr: Tuple[str, int], msg: dict,
         s.close()
 
 
+def estimate_clock_offset(addr: Tuple[str, int],
+                          samples: Optional[int] = None,
+                          timeout: float = 2.0) -> Optional[Tuple[float,
+                                                                  float]]:
+    """NTP-style wall-clock offset of THIS process against the bus host
+    (ISSUE 12, the merged cluster timeline): ``samples`` ping
+    round-trips, each yielding ``offset = midpoint(local) - t_wall(bus)``;
+    the minimum-RTT sample wins (its midpoint bounds the true offset
+    tightest).  The estimate is published to
+    :func:`byteps_tpu.common.tracing.set_clock_offset` so every trace
+    file this process flushes carries it; returns ``(offset_s, err_s)``
+    or None when no sample landed.  Cost: ``samples`` sub-ms TCP round
+    trips — run at membership start and after coordinator changes, not
+    per step."""
+    from ..common import tracing as _tracing
+    from ..common.config import get_config
+    if samples is None:
+        samples = get_config().clock_sync_samples
+    best: Optional[Tuple[float, float]] = None   # (rtt, offset)
+    for _ in range(max(0, samples)):
+        t0 = time.time()
+        try:
+            reply = bus_request(tuple(addr), {"op": "ping"},
+                                timeout=timeout)
+        except (ConnectionError, MembershipTimeout):
+            continue
+        t1 = time.time()
+        if not reply.get("ok") or "t_wall" not in reply:
+            continue
+        rtt = t1 - t0
+        offset = (t0 + t1) / 2.0 - float(reply["t_wall"])
+        if best is None or rtt < best[0]:
+            best = (rtt, offset)
+    if best is None:
+        return None
+    rtt, offset = best
+    _tracing.set_clock_offset(offset, rtt / 2.0,
+                              source="bus %s:%d" % tuple(addr))
+    return offset, rtt / 2.0
+
+
 class _BusServer:
     """The coordinator-side membership endpoint.
 
@@ -453,6 +494,11 @@ class _BusServer:
         # scored (scoring runs once per completed barrier)
         self._arrive: Dict[Tuple[int, int], Dict[int, float]] = {}
         self._scored: set = set()
+        # (epoch, step) -> {rank: flow id}: causal-tracing ids members
+        # attached to their syncs (ISSUE 12) — the bus closes each arc
+        # when the barrier completes, so the merged cluster timeline
+        # shows every rank's step flowing into ONE barrier span
+        self._sync_trace: Dict[Tuple[int, int], Dict[int, int]] = {}
         self._slow_rounds: Dict[int, int] = {}   # consecutive slow barriers
         self._deadline_seen: Dict[int, int] = {}  # last seen trip counters
         # rank -> {"since": wall ts, "score": phi at demotion}: demoted
@@ -646,6 +692,8 @@ class _BusServer:
             # arrival stamp: the straggler signal is WHEN each rank
             # reached this barrier relative to the round's first arrival
             self._arrive.setdefault(key, {})[rank] = time.monotonic()
+            if msg.get("trace"):
+                self._sync_trace.setdefault(key, {})[rank] = msg["trace"]
             self._sync.setdefault(key, {})[rank] = msg.get("payload")
             if msg.get("state") is not None:
                 # the state a member carries at step s is its state
@@ -660,6 +708,7 @@ class _BusServer:
                 self._sync.pop(k, None)
                 self._snapshots.pop(k, None)
                 self._arrive.pop(k, None)
+                self._sync_trace.pop(k, None)
                 self._scored.discard(k)
             self._cv.notify_all()
             while not self._stop.is_set():
@@ -718,6 +767,40 @@ class _BusServer:
                 "probation": sorted(self._probation),
                 "epoch": self.epoch, "world": sorted(self.world)}
 
+    def _emit_barrier_trace(self, key: Tuple[int, int]) -> None:
+        """Close the round's cross-rank flow arcs (ISSUE 12): one
+        ``bus.step_barrier`` span on this process's timeline covering
+        first→last arrival, and a flow ``f`` per member that attached a
+        trace id to its sync — the member emitted the matching ``s`` on
+        ITS OWN timeline, so after ``tools/bps_trace.py`` merges the
+        per-rank files each rank's step visibly flows into the one
+        barrier that gated it.  Caller holds the condition; runs once
+        per round (the ``_scored`` latch)."""
+        flows = self._sync_trace.pop(key, None)
+        if not flows:
+            return
+        try:
+            from ..common import tracing as _tracing
+            tr = _tracing.tracer()
+            if not tr.active:
+                return
+            arrivals = self._arrive.get(key) or {}
+            if not arrivals:
+                return
+            t_first = min(arrivals.values())
+            t_last = max(max(arrivals.values()), t_first + 1e-5)
+            epoch, step = key
+            tr.record_traced(next(iter(flows.values())),
+                             "bus.step_barrier", "bus/step_sync",
+                             t_first, t_last, step=step, epoch=epoch,
+                             ranks=sorted(flows))
+            for r, fid in flows.items():
+                ts = min(max(arrivals.get(r, t_last), t_first), t_last)
+                tr.flow(fid, "f", "bus/step_sync", ts)
+        except Exception:  # noqa: BLE001 — tracing is best-effort
+            get_logger().debug("barrier trace emission failed",
+                               exc_info=True)
+
     def _score_round(self, key: Tuple[int, int]) -> None:
         """Score one COMPLETED step barrier (caller holds the condition;
         runs once per round).
@@ -738,6 +821,7 @@ class _BusServer:
         if key in self._scored:
             return
         self._scored.add(key)
+        self._emit_barrier_trace(key)
         arrivals = self._arrive.get(key) or {}
         if len(arrivals) < 2:
             return
@@ -887,6 +971,8 @@ class _BusServer:
         self._sync = {k: v for k, v in self._sync.items() if k[0] >= epoch}
         self._arrive = {k: v for k, v in self._arrive.items()
                         if k[0] >= epoch}
+        self._sync_trace = {k: v for k, v in self._sync_trace.items()
+                            if k[0] >= epoch}
         self._scored = {k for k in self._scored if k[0] >= epoch}
         # a pending demotion is consumed by the agreement that applied
         # it; consecutive-slow counters restart under the new world
@@ -982,6 +1068,9 @@ class _BusServer:
                     "coordinator": min(self.world) if self.world else None,
                     "standby": self._standby_rank(),
                     "bus_rank": self.host_rank,
+                    # wall-clock sample for the trace clock-offset
+                    # estimator (ISSUE 12): stamped as late as possible
+                    "t_wall": time.time(),
                     "probation": sorted(self._probation)}
 
 
@@ -1058,6 +1147,10 @@ class ElasticMembership:
         # the latest replica snapshot piggybacked to this rank while it
         # is the standby — the seed a failover bus resumes from
         self._replica: Optional[dict] = None
+        # step_sync retries the trace clock-offset estimate while it is
+        # missing, but BOUNDED: each failing attempt costs blocking ping
+        # round trips, which must not tax every step barrier forever
+        self._clock_retries = 0
         # membership-managed heartbeat (host_heartbeat): rebuilt on every
         # applied world change so the UDP server follows the coordinator
         self._hb = None
@@ -1072,6 +1165,7 @@ class ElasticMembership:
         set_epoch(self._view.epoch)
         self._ensure_bus(self._view)
         _active_ref = weakref.ref(self)
+        self._sync_clock()
         return self
 
     def stop(self) -> None:
@@ -1093,6 +1187,22 @@ class ElasticMembership:
 
     def view(self) -> MembershipView:
         return self._view
+
+    def _sync_clock(self) -> None:
+        """Trace-timeline clock alignment (ISSUE 12): estimate this
+        rank's wall-clock offset against the bus host.  Gated on an
+        active tracer — an untraced run must not pay the ping round
+        trips — and entirely best-effort."""
+        from ..common import tracing as _tracing
+        from ..common.config import get_config
+        try:
+            if (get_config().clock_sync_samples <= 0
+                    or not _tracing.tracer().active):
+                return
+            estimate_clock_offset(tuple(self.bus_addr))
+        except Exception:  # noqa: BLE001 — alignment is best-effort
+            get_logger().debug("clock-offset estimation failed",
+                               exc_info=True)
 
     @property
     def is_coordinator(self) -> bool:
@@ -1422,10 +1532,19 @@ class ElasticMembership:
         the detection path for failures *after* the first).
         """
         view = self._view
+        # causal tracing (ISSUE 12): attach a flow id so the bus can
+        # close the arc when the barrier completes — the ONE hop that
+        # genuinely crosses rank boundaries today
+        from ..common import tracing as _tracing
+        _tr = _tracing.tracer()
+        _tctx = _tr.maybe_sample("step_sync") if _tr.active else None
+        _t_sync0 = time.monotonic()
         msg: Dict[str, Any] = {"op": "sync", "rank": self.rank,
                                "epoch": view.epoch, "step": step,
                                "payload": payload,
                                "metrics": self._local_metrics()}
+        if _tctx is not None:
+            msg["trace"] = _tctx.trace_id
         if state is not None and self._join_hint:
             if not isinstance(state, bytes):
                 from ..utils.checkpoint import pack_state
@@ -1438,6 +1557,26 @@ class ElasticMembership:
         reply = self._request(msg, timeout=self.sync_timeout_s + 15.0)
         if reply.get("ok"):
             self._join_hint = bool(reply.get("join_waiting"))
+            if (_tr.active and self._clock_retries < 3
+                    and _tracing.clock_offset()["offset_s"] is None):
+                # start()'s estimate can race the coordinator's bus
+                # bind (nothing answered pings yet); the bus just
+                # answered a sync, so the estimate usually lands on the
+                # first retry — bounded at 3 attempts so a network that
+                # syncs-but-drops-pings cannot tax every later barrier
+                # with the full ping budget
+                self._clock_retries += 1
+                self._sync_clock()
+            if _tctx is not None:
+                # emitted only for a COMPLETED round: the bus registered
+                # the id and closed the arc with its ``f``, so the ``s``
+                # here never dangles (a retried/stale sync gets a fresh
+                # id next attempt)
+                now = time.monotonic()
+                _tr.record_traced(_tctx.trace_id, "membership.step_sync",
+                                  "membership", _t_sync0, now, step=step,
+                                  epoch=view.epoch, rank=self.rank)
+                _tr.flow(_tctx.trace_id, "s", "membership", _t_sync0)
             return self._view, reply["payloads"]
         if reply.get("stale"):
             new = MembershipView(reply["epoch"], tuple(reply["world"]))
@@ -1728,6 +1867,10 @@ class ElasticMembership:
             from ..server import serving as _serving
             _serving.notify_world_change(view)
             self._ensure_bus(view, prev_coordinator=old.coordinator)
+            if view.coordinator != old.coordinator:
+                # the clock reference moved with the coordinator: later
+                # trace flushes must carry the offset to the NEW bus
+                self._sync_clock()
             # heartbeat re-hosting: the UDP server follows the NEW
             # coordinator and every survivor re-points its beats; fresh
             # monitors also reset the fired-once latch, so "rank 0 down"

@@ -35,8 +35,10 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..common import integrity as _integrity
+from ..common import tracing as _tracing
 from ..common.logging import get_logger
 from ..common.retry import RetryPolicy
+from ..common.telemetry import attribution as _attribution
 from ..common.telemetry import counters
 from ..fault import injector as _fault
 from ..fault import membership as _membership
@@ -57,6 +59,9 @@ class _Msg:
     #                     lets a quarantine drop exactly the blamed
     #                     round's queued messages, not earlier complete
     #                     rounds still waiting in the queue
+    trace_id: int = 0   # causal-tracing id of a CAPTURED push (ISSUE 12):
+    #                     the merge thread closes the push's flow arc
+    #                     with it; 0 = uncaptured
 
 
 class PriorityQueue:
@@ -322,6 +327,15 @@ class ServerEngine:
                 "%d (current %d)", key, mepoch, self._membership_epoch)
             return
         arr = np.asarray(value)
+        # Causal tracing (ISSUE 12): join the caller's captured trace
+        # (engine/async-opt pushes under a context) or make a sampling
+        # decision here; the wire hop below and the merge thread both
+        # stamp their spans with the same id — the push's journey is one
+        # flow arc: caller push(s) → wire(t) → merge(f).
+        tctx = _tracing.current()
+        if tctx is None:
+            tctx = _tracing.tracer().maybe_sample("server_push")
+        t_push0 = time.monotonic() if tctx is not None else 0.0
         if _integrity.enabled():
             if _integrity.loopback_fast() and not _fault.ENABLED:
                 # In-process hop with no chaos armed: the "wire" is the
@@ -343,24 +357,38 @@ class ServerEngine:
                 # verify-on-receive, with bounded NACK-driven retransmit
                 # from the sealed source copy.  A frame still corrupt past
                 # the budget raises IntegrityError to the caller.
-                arr = self._wire_recv_array(key, arr, worker_id)
+                with _tracing.use(tctx):
+                    arr = self._wire_recv_array(key, arr, worker_id)
         elif _fault.ENABLED:
             # integrity off: the bitflip lands silently in this worker's
             # contribution — the unprotected baseline the envelope fixes
             arr = np.asarray(_fault.corrupt("server_push", arr))
             _fault.fire("server_push")
-        self._push_checked(key, arr, worker_id, num_workers)
+        enqueued = self._push_checked(key, arr, worker_id, num_workers,
+                                      trace_id=tctx.trace_id if tctx else 0)
+        if tctx is not None:
+            tr = _tracing.tracer()
+            now = time.monotonic()
+            tr.record_traced(tctx.trace_id, "server.push", f"server/{key}",
+                             t_push0, now, worker=worker_id)
+            if enqueued:
+                # flow start only for pushes that actually reached the
+                # merge queue: the merge thread closes the arc, and a
+                # quarantine-dropped push must not leave an orphan ``s``
+                tr.flow(tctx.trace_id, "s", f"server/{key}", t_push0)
 
     def _push_checked(self, key: str, arr: np.ndarray, worker_id: int,
-                      num_workers: int) -> None:
+                      num_workers: int, trace_id: int = 0) -> bool:
         """Post-wire half of push(): non-finite screen, shape/dtype
-        validation, round accounting, enqueue."""
+        validation, round accounting, enqueue.  Returns True when the
+        message reached a merge queue (False = dropped/quarantined —
+        the caller must not open a flow arc nothing will close)."""
         st = self._state(key)
         if _integrity.enabled():
             with st.lock:
                 st.known_workers.add(worker_id)
                 if self._drop_if_quarantined(st, key, worker_id):
-                    return
+                    return False
             arr = _integrity.screen_nonfinite(arr, what="push", key=key,
                                               worker=worker_id)
             if arr is None:  # skip policy: quarantine the whole round
@@ -370,11 +398,11 @@ class ServerEngine:
                 # restarted round too
                 with st.lock:
                     if self._drop_if_quarantined(st, key, worker_id):
-                        return
+                        return False
                     quarantined = self._quarantine_round_locked(
                         st, key, worker_id, num_workers)
                 self._fulfill_quarantined(key, quarantined)
-                return
+                return False
         with st.lock:
             # re-checked atomically with round entry: a quarantine firing
             # between the pre-screen check and here would otherwise count
@@ -382,7 +410,7 @@ class ServerEngine:
             # the one-shot drop armed against the next legitimate push
             if _integrity.enabled() and self._drop_if_quarantined(
                     st, key, worker_id):
-                return
+                return False
             if st.poisoned:
                 raise RuntimeError(f"key {key!r} is poisoned by an "
                                    "earlier merge failure")
@@ -402,7 +430,8 @@ class ServerEngine:
         q = self.queues[self.thread_id(key, arr.nbytes)]
         q.push(_Msg(key=key, value=arr, worker_id=worker_id,
                     num_workers=num_workers, epoch=epoch,
-                    round_no=round_no))
+                    round_no=round_no, trace_id=trace_id))
+        return True
 
     # -- the loopback wire (integrity envelopes) ---------------------------
 
@@ -616,6 +645,10 @@ class ServerEngine:
             return
         comp = self._codec(key).comp
         if _integrity.enabled():
+            tctx = _tracing.current()
+            if tctx is None:
+                tctx = _tracing.tracer().maybe_sample("server_push")
+            t_c0 = time.monotonic() if tctx is not None else 0.0
             if _integrity.loopback_fast() and not _fault.ENABLED:
                 # same in-process fast path as push(): the wire bytes are
                 # already the caller's buffer, nothing to re-CRC
@@ -624,13 +657,22 @@ class ServerEngine:
                 seq = next(self._wire_seq)
                 frame = _integrity.seal_bytes(data, key=key, seq=seq,
                                               worker=worker_id)
-                data = _integrity.wire_transmit(
-                    frame, key=key, worker=worker_id, seq=seq,
-                    site="server_push", opener=_integrity.open_bytes,
-                    who="server engine")
+                with _tracing.use(tctx):
+                    data = _integrity.wire_transmit(
+                        frame, key=key, worker=worker_id, seq=seq,
+                        site="server_push", opener=_integrity.open_bytes,
+                        who="server engine")
             value = np.asarray(comp.decompress(comp.wire_decode(
                 bytes(data))))
-            self._push_checked(key, value, worker_id, num_workers)
+            enq = self._push_checked(key, value, worker_id, num_workers,
+                                     trace_id=tctx.trace_id if tctx else 0)
+            if tctx is not None:
+                tr = _tracing.tracer()
+                tr.record_traced(tctx.trace_id, "server.push",
+                                 f"server/{key}", t_c0, time.monotonic(),
+                                 worker=worker_id, compressed=True)
+                if enq:
+                    tr.flow(tctx.trace_id, "s", f"server/{key}", t_c0)
             return
         value = np.asarray(comp.decompress(comp.wire_decode(data)))
         self.push(key, value, worker_id, num_workers)
@@ -712,6 +754,7 @@ class ServerEngine:
             msg = q.wait_and_pop()
             if msg.kind == "stop":
                 return
+            t_m0 = time.monotonic()
             try:
                 self._process(msg, q)
             except Exception:  # noqa: BLE001 — push() pre-validates
@@ -734,6 +777,17 @@ class ServerEngine:
                 q.clear_counter(msg.key)
                 for fulfill in parked:
                     fulfill(None)
+            # merge attribution + the arc's closing hop, on success AND
+            # on the poison path (the push's journey ended either way)
+            _attribution.add("merge", (time.monotonic() - t_m0) * 1e3)
+            if msg.trace_id:
+                tr = _tracing.tracer()
+                if tr.active:
+                    now = time.monotonic()
+                    tr.record_traced(msg.trace_id, "server.merge",
+                                     f"server/{msg.key}", t_m0, now,
+                                     worker=msg.worker_id)
+                    tr.flow(msg.trace_id, "f", f"server/{msg.key}", now)
 
     def _process(self, msg: _Msg, q: PriorityQueue) -> None:
         st = self._state(msg.key)

@@ -39,11 +39,13 @@ from __future__ import annotations
 import contextlib
 import itertools
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..common import integrity as _integrity
+from ..common import tracing as _tracing
 from ..common.logging import get_logger
 from ..common.telemetry import counters
 from ..fault import injector as _fault
@@ -352,6 +354,10 @@ class KVStore:
         subscribers outside the lock — even when the ack is then
         chaos-dropped (the sum DID apply)."""
         landed: Optional[int] = None
+        # causal tracing (ISSUE 12): join the caller's captured trace or
+        # sample one; the sealed-envelope hop below stamps its span with
+        # the same id (the async-PS push's wire leg)
+        tctx, t_kv0 = _tracing.begin_sample("kv.push")
         try:
             with self._lock:
                 if self._stale(key, mepoch):
@@ -372,8 +378,10 @@ class KVStore:
                     # deltas dwarf the compressed traffic and wreck the
                     # waste ratio; raw rejects stay visible in
                     # integrity.crc_reject/retransmit
-                    arr = self._wire_recv(key, frame, worker_id, seq_env,
-                                          _integrity.open_array, 0)
+                    with _tracing.use(tctx):
+                        arr = self._wire_recv(key, frame, worker_id,
+                                              seq_env,
+                                              _integrity.open_array, 0)
                     arr = _integrity.screen_nonfinite(
                         arr, what="delta", key=key, worker=worker_id)
                     if arr is None:  # skip policy: drop this contribution
@@ -394,6 +402,10 @@ class KVStore:
                 self._maybe_drop_ack(key, version, seq)
                 return version
         finally:
+            if tctx is not None:
+                _tracing.tracer().record_traced(
+                    tctx.trace_id, "kv.push", f"kv/{key}", t_kv0,
+                    time.monotonic(), worker=worker_id)
             if landed is not None:
                 self._notify(key, landed)
 
@@ -447,6 +459,7 @@ class KVStore:
         NACKed and retransmitted before the decode runs — the codec
         never sees unverified bytes."""
         landed: Optional[int] = None
+        tctx, t_kv0 = _tracing.begin_sample("kv.push")
         try:
             with self._lock:
                 if self._stale(key, mepoch):
@@ -465,9 +478,10 @@ class KVStore:
                                else next(self._wire_seq))
                     frame = _integrity.seal_bytes(data, key=key, seq=env_seq,
                                                   worker=worker_id)
-                    verified = bytes(self._wire_recv(
-                        key, frame, worker_id, env_seq,
-                        _integrity.open_bytes, len(data)))
+                    with _tracing.use(tctx):
+                        verified = bytes(self._wire_recv(
+                            key, frame, worker_id, env_seq,
+                            _integrity.open_bytes, len(data)))
                 else:
                     verified = data
                     if _fault.ENABLED:
@@ -496,6 +510,10 @@ class KVStore:
                 self._maybe_drop_ack(key, version, seq)
                 return version
         finally:
+            if tctx is not None:
+                _tracing.tracer().record_traced(
+                    tctx.trace_id, "kv.push", f"kv/{key}", t_kv0,
+                    time.monotonic(), worker=worker_id, compressed=True)
             if landed is not None:
                 self._notify(key, landed)
 

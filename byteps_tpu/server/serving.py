@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import integrity as _integrity
+from ..common import tracing as _tracing
 from ..common.logging import get_logger
 from ..common.telemetry import counters, gauges, histograms
 from ..fault import injector as _fault
@@ -311,6 +312,8 @@ class SnapshotServer:
         the live store — a mid-update multi-key read is impossible by
         construction).  ``since_id`` unknown or aged out of retention →
         full snapshot."""
+        tctx = _tracing.current()
+        t_ep0 = time.monotonic() if tctx is not None else 0.0
         if not self.alive:
             counters.inc("serve.unavailable")
             raise ServeUnavailable(
@@ -382,6 +385,16 @@ class SnapshotServer:
         counters.inc("serve.full_pulls" if full else "serve.delta_pulls")
         counters.inc("serve.pull_keys", len(items))
         counters.inc("serve.pull_bytes", wire_total)
+        if tctx is not None:
+            # the captured pull's serving leg: span on this endpoint's
+            # track, closing flow ``f`` — the router opened the arc
+            tr = _tracing.tracer()
+            now = time.monotonic()
+            tr.record_traced(tctx.trace_id, "serve.pull",
+                             f"serve/{self.server_id}", t_ep0, now,
+                             snapshot_id=snap.id, full=full,
+                             keys=len(items))
+            tr.flow(tctx.trace_id, "f", f"serve/{self.server_id}", now)
         return ServeReply(snapshot_id=snap.id, full=full, items=items,
                           wire_bytes=wire_total, server_id=self.server_id)
 
@@ -591,6 +604,10 @@ class ServingPlane:
         the backup fires after the hedge delay and the first response
         wins, so no single slow endpoint owns the tail."""
         t0 = time.perf_counter()
+        # causal tracing (ISSUE 12): sample this pull; the context is
+        # installed around the SEQUENTIAL candidate chain only — hedge
+        # attempts run on worker threads the contextvar does not reach
+        tctx, t_tr0 = _tracing.begin_sample("serve.route")
         # resolve keys=None to the latest snapshot's key list, NOT
         # store.keys(): the hot read path must not contend on the live
         # store lock — and a partial replica needs the explicit list to
@@ -603,24 +620,36 @@ class ServingPlane:
             self.assigner.record_pulls(wanted)
         cands = self._read_candidates(wanted, since_id)
         use_hedge = self._hedge if hedge is None else bool(hedge)
-        if use_hedge and cands:
+        hedged = bool(use_hedge and cands)
+        if hedged:
             reply = self._pull_hedged(cands, since_id, keys, wanted)
         else:
-            reply = None
-            for rep in cands:
-                try:
-                    reply = rep.pull(since_id=since_id, keys=wanted)
-                    counters.inc("serve.replica_reads")
-                    break
-                except ServeUnavailable:
-                    counters.inc("serve.replica_fallback")
-                    continue
-            if reply is None:
-                reply = self.primary.pull(since_id=since_id, keys=keys)
-                counters.inc("serve.primary_reads")
+            with _tracing.use(tctx):
+                reply = None
+                for rep in cands:
+                    try:
+                        reply = rep.pull(since_id=since_id, keys=wanted)
+                        counters.inc("serve.replica_reads")
+                        break
+                    except ServeUnavailable:
+                        counters.inc("serve.replica_fallback")
+                        continue
+                if reply is None:
+                    reply = self.primary.pull(since_id=since_id, keys=keys)
+                    counters.inc("serve.primary_reads")
         counters.inc("serve.pulls")
         histograms.observe("serve.pull_ms",
                            (time.perf_counter() - t0) * 1e3)
+        if tctx is not None:
+            tr = _tracing.tracer()
+            now = time.monotonic()
+            tr.record_traced(tctx.trace_id, "serve.route", "serve/plane",
+                             t_tr0, now, keys=len(wanted), hedged=hedged)
+            if not hedged:
+                # the winning endpoint closed this arc with its ``f``;
+                # hedged attempts ran outside the context, so opening an
+                # arc here would leave an orphan ``s``
+                tr.flow(tctx.trace_id, "s", "serve/plane", t_tr0)
         return reply
 
     # -- hedging -------------------------------------------------------------

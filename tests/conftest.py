@@ -32,6 +32,15 @@ if "BYTEPS_FLIGHT_DIR" not in os.environ:
     os.environ["BYTEPS_FLIGHT_DIR"] = tempfile.mkdtemp(
         prefix="bps_flight_test_")
 
+# Same hygiene for trace flushes (Tracer defaults trace_dir to cwd): a
+# test arming BYTEPS_TRACE_ON/TRACE_SAMPLE without an explicit dir must
+# not shed bps_trace_rank*.json files into the repo root.
+if "BYTEPS_TRACE_DIR" not in os.environ:
+    import tempfile
+
+    os.environ["BYTEPS_TRACE_DIR"] = tempfile.mkdtemp(
+        prefix="bps_trace_test_")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -95,9 +104,13 @@ def _fresh_telemetry():
     from byteps_tpu.common import flight_recorder as _flight
     from byteps_tpu.common import metrics as _metrics
     from byteps_tpu.common import obs_server as _obs
+    from byteps_tpu.common import tracing as _btracing
+    from byteps_tpu.common.telemetry import attribution as _attribution
     from byteps_tpu.utils import slowness as _slowness
     _obs.stop_server()
     _metrics.registry.reset()
     _metrics._reset_components_for_tests()
     _flight._reset_for_tests()
     _slowness._reset_for_tests()
+    _btracing._reset_for_tests()
+    _attribution.reset()

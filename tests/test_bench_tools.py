@@ -757,6 +757,11 @@ def test_bench_smoke_floor_and_gate_arithmetic(tmp_path, monkeypatch):
         "randomk": {"wire_ratio": 0.5, "gbps": 0.001,
                     "throughput_ratio": 0.01, "golden_error": 0.47,
                     "zero_compile": True}})
+    # the trace lane is likewise seconds of real pushes; its gate
+    # arithmetic is pinned in tests/test_trace_merge.py
+    monkeypatch.setattr(bs, "_measure_trace", lambda: {
+        "sample_n": 4, "overhead_ratio": 0.95, "events_buffered": 8,
+        "events_dropped": 0})
     monkeypatch.setattr(bs, "setup_cpu8_mesh", lambda: None)
     monkeypatch.setenv("BENCH_SMOKE_TOLERANCE", "0.30")
     monkeypatch.setattr(sys, "argv", ["bench_smoke.py"])

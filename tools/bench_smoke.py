@@ -211,6 +211,78 @@ def _compressed_ok(lanes: dict, floor: dict, tol: float) -> bool:
     return ok
 
 
+def _measure_trace(nbytes=4 * MB, reps=9, sample_n=4):
+    """Sampled-tracing overhead lane (ISSUE 12 acceptance: the ratio
+    gate still passes with ``BYTEPS_TRACE_SAMPLE`` armed — sampled
+    tracing is cheap enough to leave on in production).
+
+    Interleaved per-rep pairs on ONE engine: each rep times a push with
+    the process tracer's sampled stream OFF then ON (``sample_n`` far
+    denser than a production 1/64, so the gate bounds a worst case).
+    The ratio (off wall / on wall) cancels host regime exactly like the
+    engine-vs-fused pairing; gated against
+    ``trace_sample_overhead_floor`` with the lane tolerance."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from byteps_tpu.comm.mesh import CommContext, _build_mesh
+    from byteps_tpu.common import tracing as _tracing
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.core.engine import PushPullEngine
+
+    devices = jax.devices()
+    comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1,
+                       n_ici=len(devices))
+    tmp = tempfile.mkdtemp(prefix="bps_trace_bench_")
+    tr = _tracing.set_tracer(_tracing.Tracer(
+        enabled=False, sample_n=0, out_dir=tmp, capacity=1 << 16))
+    cfg = Config(telemetry_on=True, trace_on=False)
+    eng = PushPullEngine(comm, cfg)
+    try:
+        x = np.random.RandomState(1).randn(nbytes // 4).astype(np.float32)
+        eng.declare_tensor("trace.pp", x.shape, np.float32)
+        for _ in range(24):
+            eng.push_pull_local(x, "trace.pp")
+            if eng.planner.locked(nbytes):
+                break
+        tr.sample_n = sample_n       # warm the sampled path's branches
+        eng.push_pull_local(x, "trace.pp")
+        ratios = []
+        for _ in range(reps):
+            tr.sample_n = 0
+            t0 = time.perf_counter()
+            eng.push_pull_local(x, "trace.pp")
+            t_off = time.perf_counter() - t0
+            tr.sample_n = sample_n
+            t0 = time.perf_counter()
+            eng.push_pull_local(x, "trace.pp")
+            t_on = time.perf_counter() - t0
+            ratios.append(t_off / t_on)   # sampled/unsampled throughput
+        def med(xs):
+            m, _, _ = quantile_stats_raw(xs)
+            return m
+        return {"sample_n": sample_n,
+                "overhead_ratio": round(med(ratios), 3),
+                "ratio_per_rep": [round(r, 3) for r in sorted(ratios)],
+                "events_buffered": tr.debug_state()["events_buffered"],
+                "events_dropped": tr.dropped}
+    finally:
+        eng.shutdown(wait=False)
+        _tracing.set_tracer(None)
+
+
+def _trace_ok(trc: dict, floor: dict, tol: float) -> bool:
+    """Sampled tracing must not cost more than the floor allows AND the
+    sampled stream must actually have recorded something (a 1.0 ratio
+    with zero events would mean the lane silently stopped tracing)."""
+    gate = floor.get("trace_sample_overhead_floor", 0.7) * (1.0 - tol)
+    trc["gate_ratio"] = round(gate, 3)
+    return (trc["overhead_ratio"] >= gate
+            and trc["events_buffered"] > 0)
+
+
 def _measure_serve():
     """Serving lane (ISSUE 9): pulls/sec + p99 pull latency under
     concurrent training pushes, recorded beside the push figures so the
@@ -297,6 +369,7 @@ def main() -> int:
     out["serve"] = _measure_serve()
     out["straggler"] = _measure_straggler()
     out["compressed"] = _measure_compressed()
+    out["trace"] = _measure_trace()
     if "--update-floor" in sys.argv:
         # compressed throughput floor: half the measured worst lane —
         # room for host noise, still catches a machinery collapse
@@ -309,6 +382,7 @@ def main() -> int:
                  "compressed_wire_ratio_max": 0.35,
                  "compressed_quality_ceiling": 0.55,
                  "compressed_throughput_floor": round(worst_tput / 2, 3),
+                 "trace_sample_overhead_floor": 0.7,
                  "note": "measured floor; the lane fails below "
                          "ratio * (1 - tolerance)"}
         with open(FLOOR_PATH, "w") as f:
@@ -338,7 +412,9 @@ def main() -> int:
     straggler_ok = _straggler_ok(out["straggler"], floor)
     out["straggler"]["ok"] = straggler_ok
     compressed_ok = _compressed_ok(out["compressed"], floor, tol)
-    out["ok"] = engine_ok and straggler_ok and compressed_ok
+    trace_ok = _trace_ok(out["trace"], floor, tol)
+    out["trace"]["ok"] = trace_ok
+    out["ok"] = engine_ok and straggler_ok and compressed_ok and trace_ok
     print(json.dumps(out))
     if not engine_ok:
         print(f"bench-smoke FAIL: engine_vs_fused_ratio "
@@ -362,6 +438,15 @@ def main() -> int:
               f"ceiling {floor.get('compressed_quality_ceiling')}, "
               f"throughput floor "
               f"{floor.get('compressed_throughput_floor')}): {bad}",
+              file=sys.stderr)
+    if not trace_ok:
+        trc = out["trace"]
+        print(f"bench-smoke FAIL: sampled tracing "
+              f"(BYTEPS_TRACE_SAMPLE=1/{trc['sample_n']}) costs too "
+              f"much: throughput ratio {trc['overhead_ratio']} < gate "
+              f"{trc['gate_ratio']} (or the sampled stream recorded "
+              f"nothing: {trc['events_buffered']} events) — always-on "
+              f"sampling is no longer cheap enough to leave armed",
               file=sys.stderr)
     return 0 if out["ok"] else 1
 

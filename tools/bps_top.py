@@ -30,8 +30,28 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-_COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "RETX",
-            "PULLS", "CODEC", "SLOW", "STATE", "EPOCH", "STEP", "AGE")
+_COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "ATTRIB",
+            "RETX", "PULLS", "CODEC", "SLOW", "STATE", "EPOCH", "STEP",
+            "AGE")
+
+
+def _attrib_cell(step: dict) -> str:
+    """The last step's DOMINANT attribution component as 'comp:NN%'
+    (share of step wall time) — the one-glance answer to "what is this
+    rank's step time going to".  '-' = no attribution yet (engine idle,
+    telemetry off, or a pre-attribution snapshot); 'other' only shows
+    when nothing measured dominates."""
+    at = step.get("attrib") or {}
+    wall = step.get("wall_ms") or 0.0
+    if not at or not wall:
+        return "-"
+    comps = {k: v for k, v in at.items() if k != "other" and v > 0}
+    if not comps:
+        comps = {k: v for k, v in at.items() if v > 0}
+    if not comps:
+        return "-"
+    k = max(comps, key=comps.get)
+    return f"{k}:{min(999, round(100.0 * comps[k] / wall))}%"
 
 
 def _codec_cell(gauges: dict) -> str:
@@ -83,6 +103,9 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
                   gauges.get("engine.sched_pending"))),
         fmt(m.get("bytes_in_flight")),
         fmt(stall, "{:.0f}"),
+        # causal attribution (ISSUE 12): where the last step's wall time
+        # went, from the step.attrib_* breakdown riding the snapshot
+        _attrib_cell(step),
         fmt(counters.get("integrity.retransmit", 0)),
         # serving plane (server/serving.py): cumulative pulls served by
         # this rank — 0 everywhere means the rank runs no read plane

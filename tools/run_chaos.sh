@@ -13,7 +13,15 @@
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
-#               | straggler | compressed | all
+#               | straggler | compressed | trace | all
+#         trace: the causal-tracing slice (ISSUE 12) — a real 3-process
+#              run with BYTEPS_TRACE_SAMPLE armed writes per-rank trace
+#              files that tools/bps_trace.py merges into ONE aligned
+#              timeline with --validate clean (every flow `s` paired
+#              with its `f`, clock-aligned timestamps, cross-process
+#              barrier arcs), plus the step-attribution pins
+#              (tests/test_trace_merge.py, tests/test_observability.py
+#              attribution tests)
 #         compressed: chaos on the QUANTIZED wire path — a 3-process
 #              compressed run under bitflip:site=server_push converges
 #              bit-identical (every corrupt quantized frame NACKed and
@@ -71,6 +79,7 @@ case "${1:-}" in
                KEXPR="straggler or demote or hedge or stall"
                shift ;;
     compressed) MARK="chaos or integrity"; KEXPR="compress"; shift ;;
+    trace)     MARK="chaos"; KEXPR="trace or attrib"; shift ;;
     all)       MARK="chaos or integrity"; shift ;;
 esac
 
