@@ -18,7 +18,6 @@ through ``jax.distributed.initialize`` with a coordinator address, which
 from __future__ import annotations
 
 import dataclasses
-import os
 import threading
 from typing import List, Optional, Sequence
 
@@ -123,7 +122,7 @@ def bootstrap(cfg: Optional[Config] = None,
                 _rendezvous, describe="jax.distributed.initialize")
         if devices is None:
             devices = jax.devices()
-        n_dcn = int(os.environ.get("BYTEPS_DCN_SIZE", "0")) or (
+        n_dcn = cfg.dcn_size or (
             jax.process_count() if jax.process_count() > 1 else 1)
         from ..fault import membership as _membership
         _comm = CommContext(mesh=_build_mesh(devices, n_dcn), n_dcn=n_dcn,

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import tempfile
 from typing import Optional
 
 
@@ -78,6 +79,27 @@ ALIGN_BYTES = 4096
 PARTITION_BYTES_DEFAULT = 4096000
 
 
+def _default_trace_dir() -> str:
+    """Default trace output location when ``BYTEPS_TRACE_DIR`` is unset:
+    a stable per-USER tmp subdir (the Tracer mkdirs it at flush).  The
+    uid suffix matters on shared hosts: a bare /tmp/byteps_traces owned
+    by the first user to trace would make every other user's best-effort
+    flush fail silently."""
+    try:
+        who = str(os.getuid())
+    except AttributeError:  # no getuid (non-POSIX)
+        who = os.environ.get("USERNAME") or os.environ.get("USER") or "user"
+    return os.path.join(tempfile.gettempdir(), f"byteps_traces_{who}")
+
+
+def trace_dir_from_env() -> str:
+    """``BYTEPS_TRACE_DIR`` if set and non-empty, else the per-user tmp
+    default — the ONE derivation shared by the Config field default,
+    ``Config.from_env`` and ``tools/bps_trace.py`` (a set-but-EMPTY var,
+    e.g. a launch script's unset ``$VAR``, must not send traces to cwd)."""
+    return os.environ.get("BYTEPS_TRACE_DIR") or _default_trace_dir()
+
+
 def _parse_trace_sample(spec: str) -> int:
     """``BYTEPS_TRACE_SAMPLE`` grammar: '' / '0' = off; 'N' or '1/N' =
     capture every Nth push.  Lives here (not common/tracing.py) so
@@ -109,6 +131,19 @@ class Config:
     local_size: int = 1             # BYTEPS_LOCAL_SIZE
     coordinator_address: Optional[str] = None  # DMLC_PS_ROOT_URI:PORT equivalent
     force_distributed: bool = False  # BYTEPS_FORCE_DISTRIBUTED
+    dcn_size: int = dataclasses.field(
+        default_factory=lambda: _env_int("BYTEPS_DCN_SIZE", 0))
+    #                                  BYTEPS_DCN_SIZE: ICI slices in the
+    #                                  mesh (constructs the (dcn, ici)
+    #                                  axes); 0 = derive from
+    #                                  jax.process_count().  Env-backed
+    #                                  default even for explicit
+    #                                  Config(...) constructions — the
+    #                                  mesh shape must follow the
+    #                                  launcher's environment, not
+    #                                  whichever cwd/env a Config()
+    #                                  happened to be built under (same
+    #                                  rationale as flight_dir)
 
     # --- partitioning / scheduling ---
     partition_bytes: int = PARTITION_BYTES_DEFAULT  # BYTEPS_PARTITION_BYTES
@@ -339,6 +374,33 @@ class Config:
     #                                  prefix fails the connection instead
     #                                  of parking a multi-petabyte recv
 
+    # --- lock-order witness (common/lock_witness.py) ---
+    lock_witness: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("BYTEPS_LOCK_WITNESS", False))
+    #                                  BYTEPS_LOCK_WITNESS: wrap the
+    #                                  high-traffic named locks (KV
+    #                                  store, scheduler, planner,
+    #                                  serving, membership bus, flight
+    #                                  recorder, metrics registry) in a
+    #                                  runtime acquisition-order witness
+    #                                  that raises LockOrderError on a
+    #                                  cycle (FreeBSD WITNESS style).
+    #                                  Read at lock CONSTRUCTION time:
+    #                                  witness_enabled() consults the
+    #                                  INSTALLED config first (so
+    #                                  set_config(Config(
+    #                                  lock_witness=True)) arms every
+    #                                  lock built after it), falling
+    #                                  back to the env var for locks
+    #                                  built before any config exists
+    #                                  (module-level singletons like
+    #                                  the metrics registry are only
+    #                                  witnessed via the env var).  The
+    #                                  env-backed default keeps an
+    #                                  explicit Config(...) under the
+    #                                  chaos lanes armed.  See
+    #                                  docs/dev_invariants.md
+
     # --- fault injection (fault/injector.py) ---
     fault_spec: str = ""             # BYTEPS_FAULT_SPEC: chaos schedule
     #                                  (kill:rank=1:step=40, delay:site=dcn:
@@ -363,7 +425,20 @@ class Config:
     trace_on: bool = False           # BYTEPS_TRACE_ON
     trace_start_step: int = 10       # BYTEPS_TRACE_START_STEP
     trace_end_step: int = 20         # BYTEPS_TRACE_END_STEP
-    trace_dir: str = "."             # BYTEPS_TRACE_DIR
+    trace_dir: str = dataclasses.field(
+        default_factory=lambda: trace_dir_from_env())
+    #                                  BYTEPS_TRACE_DIR: trace output
+    #                                  directory.  Default is a tmp
+    #                                  subdir, NOT cwd — bench/chaos
+    #                                  runs from the repo root used to
+    #                                  litter it with per-pid
+    #                                  bps_trace_rank*.json files.  The
+    #                                  env var backs the default even
+    #                                  for explicit Config(...)
+    #                                  constructions (a sampled trace
+    #                                  must land where the operator or
+    #                                  harness pointed, same rationale
+    #                                  as flight_dir)
     trace_jax: bool = False          # BYTEPS_TRACE_JAX (device profiler)
     trace_sample: str = ""           # BYTEPS_TRACE_SAMPLE: '1/N' (or a
     #                                  bare N) keeps a sampled causal
@@ -441,6 +516,9 @@ class Config:
             self.partition_bytes += ALIGN_BYTES - r
         if self.num_hosts < 1:
             raise ValueError("num_hosts must be >= 1")
+        if self.dcn_size < 0:
+            raise ValueError("dcn_size must be >= 0 (0 = derive from "
+                             "the process count)")
         if not 0 < self.failure_exit_code < 256:
             raise ValueError(
                 f"failure_exit_code {self.failure_exit_code} is not "
@@ -527,6 +605,7 @@ class Config:
             local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
             coordinator_address=coord,
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED", False),
+            dcn_size=_env_int("BYTEPS_DCN_SIZE", 0),
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES",
                                      PARTITION_BYTES_DEFAULT),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
@@ -595,6 +674,7 @@ class Config:
             nonfinite_policy=_env_str("BYTEPS_NONFINITE_POLICY",
                                       "raise").strip().lower(),
             bus_max_frame=_env_int("BYTEPS_BUS_MAX_FRAME", 1 << 30),
+            lock_witness=_env_bool("BYTEPS_LOCK_WITNESS", False),
             fault_spec=_env_str("BYTEPS_FAULT_SPEC", ""),
             fault_seed=_env_int("BYTEPS_FAULT_SEED", 0),
             restart_limit=_env_int("BYTEPS_RESTART_LIMIT", 0),
@@ -606,7 +686,7 @@ class Config:
             trace_on=_env_bool("BYTEPS_TRACE_ON", False),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 10),
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 20),
-            trace_dir=_env_str("BYTEPS_TRACE_DIR", "."),
+            trace_dir=trace_dir_from_env(),
             trace_jax=_env_bool("BYTEPS_TRACE_JAX", False),
             trace_sample=_env_str("BYTEPS_TRACE_SAMPLE", ""),
             trace_capacity=_env_int("BYTEPS_TRACE_CAPACITY", 65536),

@@ -40,6 +40,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .lock_witness import named_lock
+
 _DEFAULT_CAPACITY = 4096
 
 
@@ -52,7 +54,7 @@ class FlightRecorder:
         # signal can land while that same thread is inside record()
         # holding this lock — a plain Lock would deadlock the handler
         # and leave the process neither dumped nor dead
-        self._lock = threading.RLock()
+        self._lock = named_lock("flight_recorder", reentrant=True)
         self._ring: "deque[dict]" = deque(maxlen=capacity)
         self.enabled = enabled
         self._out_dir: Optional[str] = None   # None = resolve from config

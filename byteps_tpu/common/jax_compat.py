@@ -57,6 +57,7 @@ def serialize(fn):
     @functools.wraps(fn)
     def call(*args, **kwargs):
         with _LOCK:
+            # bpslint: ignore[lock-discipline] reason=serializing fn IS this lock's purpose (legacy-runtime single XLA entry); fn is a compiled executable, not a user callback, and acquires no other lock
             return fn(*args, **kwargs)
 
     return call

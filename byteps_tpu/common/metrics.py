@@ -31,6 +31,8 @@ import threading
 import weakref
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from .lock_witness import named_lock
+
 # label set canonical form: sorted (key, value) tuple — hashable, and
 # the render order is deterministic regardless of call-site kwarg order
 _Labels = Tuple[Tuple[str, str], ...]
@@ -97,7 +99,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.registry")
         self._counters: Dict[_Key, int] = {}
         self._gauges: Dict[_Key, float] = {}
         self._hist: Dict[_Key, Dict[int, int]] = {}

@@ -26,6 +26,7 @@ import time
 from typing import List, Optional
 
 from .config import ALIGN_BYTES
+from .lock_witness import named_lock
 from .telemetry import attribution as _attribution
 from .types import ChunkTask
 
@@ -40,7 +41,8 @@ class ChunkScheduler:
         self._in_flight = 0
         self._heap: List[tuple] = []
         self._seq = 0
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(
+            named_lock("scheduler.cv", reentrant=True))
         self._interrupts = 0   # one-shot wakeups (pause handshake)
         self._shutdown = False  # latched wake (engine teardown)
 
@@ -229,7 +231,7 @@ class ChunkPlanner:
         self._min_compress = cfg.min_compress_bytes
         self._cbuckets = {}         # bucket -> compressor-ladder state
         self._buckets = {}          # bucket -> state dict
-        self._lock = threading.Lock()
+        self._lock = named_lock("planner")
         self._credit = 0            # 0 = leave the scheduler unlimited
 
     @property

@@ -42,6 +42,24 @@ from .metrics import (Counters, Gauges, Histograms,  # noqa: F401
 from . import tracing as _tracing
 
 
+# The full gauge name of every attribution component — one literal per
+# name, NOT an f-string at the emit site, so the docs/observability.md
+# established-names table stays machine-checkable against the code
+# (tools/bpslint metric-name rule) and every name is greppable.
+ATTRIB_GAUGE_NAMES = {
+    "enqueue": "step.attrib_enqueue_ms",
+    "queue": "step.attrib_queue_ms",
+    "credit": "step.attrib_credit_ms",
+    "wire": "step.attrib_wire_ms",
+    "merge": "step.attrib_merge_ms",
+    "sync": "step.attrib_sync_ms",
+    "compile": "step.attrib_compile_ms",
+    "dispatch": "step.attrib_dispatch_ms",
+    "assemble": "step.attrib_assemble_ms",
+    "other": "step.attrib_other_ms",
+}
+
+
 class AttributionSink:
     """Process-wide wall-time accumulators for step attribution
     (ISSUE 12 tentpole part 3).
@@ -308,12 +326,16 @@ class StepStatsTracker:
         gauges.set("step.wall_ms", stats.wall_ms)
         gauges.set("step.overlap_fraction", stats.overlap_fraction)
         for comp, ms in stats.attrib.items():
-            gauges.set(f"step.attrib_{comp}_ms", ms)
+            # KeyError here is deliberate: a new attribution component
+            # must be added to ATTRIB_GAUGE_NAMES (and the doc table) —
+            # an f-string fallback would silently bypass the bpslint
+            # metric-name check the map exists for
+            gauges.set(ATTRIB_GAUGE_NAMES[comp], ms)
         # zero components absent THIS step (a step-5 compile stall must
         # not haunt every later scrape — the gauge set always describes
         # ONE step, summing to its wall_ms)
         for comp in self._pub_attrib - set(stats.attrib):
-            gauges.set(f"step.attrib_{comp}_ms", 0.0)
+            gauges.set(ATTRIB_GAUGE_NAMES[comp], 0.0)
         self._pub_attrib = set(stats.attrib)
         counters.inc("step.completed")
         # the flight event names the lagging tensor and this rank — a

@@ -185,6 +185,7 @@ def resume(config: Optional[Config] = None,
     if num_servers is not None:
         os.environ["DMLC_NUM_SERVER"] = str(num_servers)
     if global_rank is not None:
+        # bpslint: ignore[env-knob] reason=reference-parity marker WRITTEN for BytePSBasics.resume compatibility, never read by this stack; recorded in the env.md disposition table
         os.environ["BYTEPS_GLOBAL_RANK"] = str(global_rank)
         os.environ["DMLC_WORKER_ID"] = str(global_rank)
     if config is None and (num_workers is not None

@@ -122,8 +122,11 @@ def _reset_lifetime_for_tests() -> None:
 _exit = os._exit
 
 VALID_KINDS = ("bitflip", "delay", "drop", "kill", "slow", "straggler")
-VALID_SITES = ("coordinator", "dcn", "dispatch", "heartbeat", "kv_push",
-               "serve_pull", "server_pull", "server_push", "sync")
+VALID_SITES = (
+    # bpslint: ignore[chaos-site] reason=kill-only predicate matched in on_step (die while hosting the control plane), never a woven fire() site
+    "coordinator",
+    "dcn", "dispatch", "heartbeat", "kv_push",
+    "serve_pull", "server_pull", "server_push", "sync")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
 CORRUPT_SITES = ("kv_push", "serve_pull", "server_push")

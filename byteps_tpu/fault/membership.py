@@ -111,6 +111,7 @@ import weakref
 from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..common import flight_recorder as _flight
+from ..common.lock_witness import named_lock
 from ..common.logging import get_logger
 from ..common.retry import RetryPolicy
 from ..common.telemetry import counters
@@ -464,7 +465,8 @@ class _BusServer:
         self.world: Set[int] = set(view.world)
         self._rdv_timeout = rendezvous_timeout_s
         self._sync_timeout = sync_timeout_s
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(
+            named_lock("membership.bus", reentrant=True))
         # (epoch, step) -> {rank: payload}
         self._sync: Dict[Tuple[int, int], Dict[int, Any]] = {}
         # (epoch, step) -> (state bytes, declared names, state's step)
@@ -1138,7 +1140,7 @@ class ElasticMembership:
         self._retry = retry or RetryPolicy.from_config(
             cfg, retry_on=(_BusUnreachable,),
             max_attempts=max(cfg.retry_max_attempts, 64))
-        self._apply_lock = threading.Lock()
+        self._apply_lock = named_lock("membership.apply")
         self._ready_cv = threading.Condition()
         self._bus: Optional[_BusServer] = None
         # True once a sync reply advertised a parked joiner: the next
@@ -1462,6 +1464,7 @@ class ElasticMembership:
             host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         if port is None:
             port = int(os.environ.get(
+                # bpslint: ignore[env-knob] reason=default is derived from DMLC_PS_ROOT_PORT+1 per resolved view; a Config snapshot cannot express it and the bind validates the value
                 "BYTEPS_HEARTBEAT_PORT",
                 str(int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1)))
         return host, port

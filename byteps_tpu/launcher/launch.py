@@ -50,6 +50,7 @@ def launch_worker(cmd: list, restart_limit: Optional[int] = None) -> int:
     # host's chip count (resolved lazily by bps.init()).
     env.setdefault("BYTEPS_LOCAL_RANK", "0")
     env.setdefault("DMLC_ROLE", "worker")
+    # bpslint: ignore[env-knob] reason=launcher-side wrapper knob applied to the worker argv before any Python/Config starts in the worker
     if env.get("BYTEPS_ENABLE_GDB", "0") == "1":
         # debug wrapping, reference launch.py:146-149: run the worker
         # under gdb so a crash drops a backtrace instead of dying silently
@@ -57,8 +58,12 @@ def launch_worker(cmd: list, restart_limit: Optional[int] = None) -> int:
                "--args"] + list(cmd)
     if env.get("BYTEPS_TRACE_ON", "0") == "1":
         # reference launch.py:150-175: create the per-rank trace dir so
-        # the engine's timeline writer never races on mkdir
-        trace_dir = env.get("BYTEPS_TRACE_DIR", ".")
+        # the engine's timeline writer never races on mkdir.  The
+        # unset-var default comes from the ONE source of truth in
+        # config.py — a second hardcoded copy here is how the old "."
+        # default drifted
+        from ..common.config import _default_trace_dir
+        trace_dir = env.get("BYTEPS_TRACE_DIR") or _default_trace_dir()
         os.makedirs(trace_dir, exist_ok=True)
     if restart_limit is None:
         restart_limit = _env_int("BYTEPS_RESTART_LIMIT", 0)

@@ -30,6 +30,7 @@ DCN_COMPRESS_MIN_BYTES = 2 * 1024 * 1024
 
 def dcn_compress_min_bytes() -> int:
     from ..common.config import _env_int
+    # bpslint: ignore[env-knob] reason=read per trace so a mid-session env override re-gates the next compile (tests/test_wire_bytes.py); a Config snapshot would freeze it — documented in env.md Compression table
     return _env_int("BYTEPS_DCN_COMPRESS_MIN_BYTES",
                     DCN_COMPRESS_MIN_BYTES)
 

@@ -46,6 +46,7 @@ import numpy as np
 
 from ..common import integrity as _integrity
 from ..common import tracing as _tracing
+from ..common.lock_witness import named_lock
 from ..common.logging import get_logger
 from ..common.telemetry import counters
 from ..fault import injector as _fault
@@ -71,7 +72,7 @@ def _copy_outside_lock(arr: np.ndarray) -> np.ndarray:
 
 class KVStore:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("kvstore")
         self._store: Dict[str, np.ndarray] = {}
         self._versions: Dict[str, int] = {}
         self._codecs: Dict[str, object] = {}

@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import integrity as _integrity
+from ..common.lock_witness import named_lock
 from ..common import tracing as _tracing
 from ..common.logging import get_logger
 from ..common.telemetry import counters, gauges, histograms
@@ -114,7 +115,7 @@ class SnapshotRing:
         if retention < 1:
             raise ValueError("retention must be >= 1")
         self.retention = retention
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.ring")
         self._snaps: "collections.OrderedDict[int, Snapshot]" = \
             collections.OrderedDict()
         self._latest: Optional[Snapshot] = None
@@ -168,7 +169,7 @@ class SnapshotStore:
         self.ring = SnapshotRing(cfg.serve_retention if retention is None
                                  else retention)
         self._ids = itertools.count(1)
-        self._cut_lock = threading.Lock()
+        self._cut_lock = named_lock("serve.cut_throttle")
         self._last_cut = 0.0
         self._interval = cut_interval_s
         self._cut_fn = cut_fn if cut_fn is not None else self.cut
@@ -469,8 +470,8 @@ class ServingPlane:
             num_servers=n, fn="djb2", mixed_mode=False, bound=101,
             replicas=n, hot_keys=(cfg.serve_hot_keys if hot_keys is None
                                   else hot_keys))
-        self._lock = threading.Lock()
-        self._cut_serial = threading.Lock()
+        self._lock = named_lock("serving_plane")
+        self._cut_serial = named_lock("serving_plane.cut")
         self._rr = 0
         # key -> replica endpoint ids mirroring it (rebuilt at each cut)
         self._mirrored: Dict[str, List[int]] = {}

@@ -61,6 +61,19 @@ def test_trace_off_writes_nothing(tmp_path):
 
 # --- DDP -------------------------------------------------------------------
 
+def test_trace_dir_empty_env_falls_back_to_tmp_default(monkeypatch):
+    # review regression: BYTEPS_TRACE_DIR exported EMPTY (a launch
+    # script's unset $VAR) must behave like unset — os.path.join("", f)
+    # would resurrect the repo-root trace litter the tmp default fixed
+    from byteps_tpu.common.config import Config, _default_trace_dir
+    monkeypatch.setenv("BYTEPS_TRACE_DIR", "")
+    assert Config().trace_dir == _default_trace_dir()
+    assert Config.from_env().trace_dir == _default_trace_dir()
+    assert "byteps_traces_" in _default_trace_dir()
+    monkeypatch.setenv("BYTEPS_TRACE_DIR", "/explicit/dir")
+    assert Config().trace_dir == "/explicit/dir"
+
+
 def test_ddp_matches_plain_training(session):
     from byteps_tpu.torch.parallel import DistributedDataParallel
     torch.manual_seed(4)

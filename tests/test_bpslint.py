@@ -1,0 +1,469 @@
+"""bpslint: the project-invariant analyzer (tools/bpslint, ISSUE 13).
+
+Per rule family: a fixture snippet proving the rule FIRES (positive) and
+that an ``# bpslint: ignore[rule] reason=...`` pragma suppresses it
+(negative), plus config validation and the tier-1 acceptance pin
+``test_tree_is_clean`` — the analyzer runs over this very repository and
+must exit 0.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.bpslint import (BpslintConfig, BpslintConfigError, load_config,
+                           run)
+from tools.bpslint.rules_env import doc_rows
+from tools.bpslint.rules_metrics import doc_names
+
+REPO = Path(__file__).resolve().parents[1]
+
+_ENV_DOC = """\
+# Env
+
+| Variable | Default | Meaning |
+|---|---|---|
+| `BYTEPS_GOOD_KNOB` | 0 | a documented, validated, consumed knob |
+"""
+
+_OBS_DOC = """\
+# Obs
+
+| Name | Kind | Meaning |
+|---|---|---|
+| `good.metric` | counter | a documented, emitted metric |
+"""
+
+_CONFIG_SRC = """\
+import os
+GOOD = os.environ.get("BYTEPS_GOOD_KNOB")
+"""
+
+_INJECTOR_SRC = """\
+VALID_SITES = (
+    "good_site",
+)
+"""
+
+_BASE_SRC = """\
+import os
+from x import counters, _fault
+
+def baseline():
+    os.environ.get("BYTEPS_GOOD_KNOB")
+    counters.inc("good.metric")
+    _fault.fire("good_site")
+"""
+
+
+def make_tree(tmp_path, extra=None, env_doc=_ENV_DOC, obs_doc=_OBS_DOC,
+              injector=_INJECTOR_SRC, config_src=_CONFIG_SRC):
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "config.py").write_text(config_src)
+    (pkg / "injector.py").write_text(injector)
+    (pkg / "base.py").write_text(_BASE_SRC)
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "env.md").write_text(env_doc)
+    (docs / "obs.md").write_text(obs_doc)
+    for name, src in (extra or {}).items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return BpslintConfig(
+        paths=["mypkg", "docs"], package="mypkg",
+        config_module="mypkg/config.py", env_doc="docs/env.md",
+        metrics_doc="docs/obs.md", injector_module="mypkg/injector.py")
+
+
+def lint(tmp_path, **kw):
+    cfg = make_tree(tmp_path, **kw)
+    return run(tmp_path, cfg)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_clean_fixture_tree_is_clean(tmp_path):
+    assert lint(tmp_path) == []
+
+
+# -- env-knob ---------------------------------------------------------------
+
+def test_env_knob_fires_on_unvalidated_and_undocumented(tmp_path):
+    fs = lint(tmp_path, extra={"bad.py": """\
+        import os
+        V = os.environ.get("BYTEPS_ROGUE_KNOB")
+    """})
+    msgs = [f.message for f in fs if f.rule == "env-knob"]
+    assert any("never validated" in m for m in msgs)
+    assert any("no row" in m for m in msgs)
+    assert all(f.path == "mypkg/bad.py" for f in fs)
+
+
+def test_env_knob_dead_doc_row_fires(tmp_path):
+    doc = _ENV_DOC + "| `BYTEPS_DEAD_KNOB` | 0 | consumed by nothing |\n"
+    fs = lint(tmp_path, env_doc=doc)
+    assert len(fs) == 1 and fs[0].rule == "env-knob"
+    assert fs[0].path == "docs/env.md" and "dead doc row" in fs[0].message
+
+
+def test_env_knob_pragma_with_reason_suppresses(tmp_path):
+    fs = lint(tmp_path, extra={"ok.py": """\
+        import os
+        # bpslint: ignore[env-knob] reason=marker var written for a child process
+        V = os.environ.get("BYTEPS_ROGUE_KNOB")
+    """})
+    assert fs == []
+
+
+def test_pragma_without_reason_is_itself_a_finding(tmp_path):
+    fs = lint(tmp_path, extra={"bad.py": """\
+        import os
+        # bpslint: ignore[env-knob]
+        V = os.environ.get("BYTEPS_ROGUE_KNOB")
+    """})
+    assert "pragma" in rules_of(fs)
+    assert any("no reason" in f.message for f in fs)
+
+
+def test_pragma_unknown_rule_is_a_finding(tmp_path):
+    fs = lint(tmp_path, extra={"bad.py": """\
+        X = 1  # bpslint: ignore[not-a-rule] reason=whatever
+    """})
+    assert rules_of(fs) == ["pragma"]
+    assert "unknown rule" in fs[0].message
+
+
+def test_pragma_syntax_inside_docstring_is_not_a_pragma(tmp_path):
+    # regression: the scanner reads COMMENT tokens, so documentation
+    # QUOTING the pragma grammar must neither suppress nor be flagged
+    fs = lint(tmp_path, extra={"doc.py": '''\
+        def f():
+            """Use `# bpslint: ignore[env-knob] reason=...` to suppress."""
+            return 1
+    '''})
+    assert fs == []
+
+
+def test_env_knob_message_strings_are_not_consumption(tmp_path):
+    # a knob NAMED inside a longer message string is not a read: the
+    # doc row for it still counts as dead
+    doc = _ENV_DOC + "| `BYTEPS_NAMED_KNOB` | 0 | named in an error |\n"
+    fs = lint(tmp_path, env_doc=doc, extra={"msg.py": """\
+        ERR = "set BYTEPS_NAMED_KNOB to a positive value"
+    """})
+    assert len(fs) == 1 and "dead doc row" in fs[0].message
+
+
+def test_env_doc_parser_skips_disposition_table():
+    lines = [
+        "| Variable | Meaning |", "|---|---|",
+        "| `BYTEPS_LIVE` | live |", "",
+        "| Reference variable | Status | Notes |", "|---|---|---|",
+        "| `BYTEPS_HISTORICAL` | dropped | gone |",
+    ]
+    rows = doc_rows(lines)
+    assert "BYTEPS_LIVE" in rows and "BYTEPS_HISTORICAL" not in rows
+
+
+# -- metric-name ------------------------------------------------------------
+
+def test_metric_name_fires_on_undocumented_emission(tmp_path):
+    fs = lint(tmp_path, extra={"bad.py": """\
+        from x import gauges
+        gauges.set("rogue.gauge", 1.0)
+    """})
+    assert rules_of(fs) == ["metric-name"]
+    assert "no row" in fs[0].message and fs[0].line == 2
+
+
+def test_metric_name_dead_doc_row_fires(tmp_path):
+    doc = _OBS_DOC + "| `ghost.metric` | counter | emitted by nothing |\n"
+    fs = lint(tmp_path, obs_doc=doc)
+    assert len(fs) == 1 and fs[0].path == "docs/obs.md"
+    assert "dead doc row" in fs[0].message
+
+
+def test_metric_name_literal_name_table_satisfies_doc_row(tmp_path):
+    # dynamic emitters are covered by a module-level literal name table
+    # (the step.attrib_* pattern in common/telemetry.py)
+    doc = _OBS_DOC + "| `dyn.metric_a` / `dyn.metric_b` | gauge | dyn |\n"
+    fs = lint(tmp_path, obs_doc=doc, extra={"dyn.py": """\
+        from x import gauges
+        NAMES = {"a": "dyn.metric_a", "b": "dyn.metric_b"}
+        def publish(k, v):
+            gauges.set(NAMES[k], v)
+    """})
+    assert fs == []
+
+
+def test_metric_name_pragma_suppresses(tmp_path):
+    fs = lint(tmp_path, extra={"ok.py": """\
+        from x import counters
+        # bpslint: ignore[metric-name] reason=test-only canary series
+        counters.inc("rogue.counter")
+    """})
+    assert fs == []
+
+
+def test_metric_doc_parser_expands_row_prefix_shorthand():
+    lines = [
+        "| Name | Kind | Meaning |", "|---|---|---|",
+        "| `integrity.rejected` / `skipped` / `zeroed` | counter | x |",
+        "| `slowness.score{site=,rank=}` | gauge | labeled |",
+        "| `wire_bytes` / `wire_bytes_wasted` | counter | no prefix |",
+    ]
+    names = doc_names(lines)
+    assert {"integrity.rejected", "integrity.skipped",
+            "integrity.zeroed", "slowness.score", "wire_bytes",
+            "wire_bytes_wasted"} <= set(names)
+
+
+# -- chaos-site -------------------------------------------------------------
+
+def test_chaos_site_fires_on_unknown_site(tmp_path):
+    fs = lint(tmp_path, extra={"bad.py": """\
+        from x import _fault
+        _fault.fire("typo_site")
+    """})
+    assert rules_of(fs) == ["chaos-site"]
+    assert "typo_site" in fs[0].message
+
+
+def test_chaos_site_fires_on_unwoven_valid_site(tmp_path):
+    inj = 'VALID_SITES = (\n    "good_site",\n    "orphan_site",\n)\n'
+    fs = lint(tmp_path, injector=inj)
+    assert rules_of(fs) == ["chaos-site"]
+    assert fs[0].path == "mypkg/injector.py" and fs[0].line == 3
+    assert "never woven" in fs[0].message
+
+
+def test_chaos_site_pragma_on_tuple_line_suppresses(tmp_path):
+    inj = ('VALID_SITES = (\n    "good_site",\n'
+           '    # bpslint: ignore[chaos-site] reason=kill-only predicate\n'
+           '    "orphan_site",\n)\n')
+    assert lint(tmp_path, injector=inj) == []
+
+
+def test_chaos_site_pragma_at_call_suppresses(tmp_path):
+    fs = lint(tmp_path, extra={"ok.py": """\
+        from x import _fault
+        # bpslint: ignore[chaos-site] reason=site registered by a plugin at runtime
+        _fault.fire("typo_site")
+    """})
+    assert fs == []
+
+
+# -- lock-discipline --------------------------------------------------------
+
+def test_lock_discipline_fires_on_sleep_under_lock(tmp_path):
+    fs = lint(tmp_path, extra={"bad.py": """\
+        import time, threading
+        _lock = threading.Lock()
+        def f():
+            with _lock:
+                time.sleep(1)
+    """})
+    assert rules_of(fs) == ["lock-discipline"]
+    assert "time.sleep" in fs[0].message and fs[0].line == 5
+
+
+def test_lock_discipline_fires_on_callback_under_lock(tmp_path):
+    fs = lint(tmp_path, extra={"bad.py": """\
+        class S:
+            def notify(self):
+                with self._lock:
+                    for fn in self._subs:
+                        fn(1, 2)
+    """})
+    assert rules_of(fs) == ["lock-discipline"]
+    assert "user callback fn" in fs[0].message
+
+
+def test_lock_discipline_ignores_calls_outside_and_deferred(tmp_path):
+    fs = lint(tmp_path, extra={"ok.py": """\
+        import time, threading
+        _lock = threading.Lock()
+        def f():
+            with _lock:
+                subs = list(range(3))
+            time.sleep(0)            # outside the body: fine
+            with _lock:
+                def later():          # deferred body: fine
+                    time.sleep(1)
+                return later
+    """})
+    assert fs == []
+
+
+def test_lock_discipline_condvar_wait_not_flagged(tmp_path):
+    fs = lint(tmp_path, extra={"ok.py": """\
+        import threading
+        class S:
+            def f(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: True, timeout=1)
+    """})
+    assert fs == []
+
+
+def test_lock_discipline_nested_locks_report_once(tmp_path):
+    # review regression: a blocking call under TWO nested lock-shaped
+    # `with` blocks is one defect, not one finding per enclosing lock
+    fs = lint(tmp_path, extra={"bad.py": """\
+        import time, threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+        def f():
+            with _a_lock:
+                with _b_lock:
+                    time.sleep(1)
+    """})
+    assert len(fs) == 1 and fs[0].rule == "lock-discipline"
+    assert "_a_lock" in fs[0].message   # attributed to the outermost
+
+
+def test_lock_discipline_pragma_suppresses(tmp_path):
+    fs = lint(tmp_path, extra={"ok.py": """\
+        import time, threading
+        _lock = threading.Lock()
+        def f():
+            with _lock:
+                # bpslint: ignore[lock-discipline] reason=0s sleep is a scheduler yield, lock is leaf
+                time.sleep(0)
+    """})
+    assert fs == []
+
+
+# -- configuration ----------------------------------------------------------
+
+def test_config_unknown_key_rejected(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.bpslint]\nwrong-key = true\n")
+    with pytest.raises(BpslintConfigError, match="unknown key.*wrong-key"):
+        load_config(tmp_path)
+
+
+def test_config_unknown_rule_in_disable_rejected(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.bpslint]\ndisable = ["not-a-rule"]\n')
+    with pytest.raises(BpslintConfigError, match="unknown rule"):
+        load_config(tmp_path)
+
+
+def test_config_type_error_rejected(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.bpslint]\npaths = "byteps_tpu"\n')
+    with pytest.raises(BpslintConfigError, match="array of strings"):
+        load_config(tmp_path)
+
+
+def test_config_disable_disables_rule(tmp_path):
+    cfg = make_tree(tmp_path, extra={"bad.py": """\
+        from x import _fault
+        _fault.fire("typo_site")
+    """})
+    cfg.disable = ["chaos-site"]
+    assert run(tmp_path, cfg) == []
+
+
+def test_config_malformed_toml_is_a_config_error(tmp_path):
+    # review regression: on 3.11+ a TOML syntax error anywhere in
+    # pyproject.toml must exit 2 (config error), not traceback as
+    # findings; the 3.10 mini parser only reads [tool.bpslint*] tables
+    # so a global syntax error outside them is invisible there
+    try:
+        import tomllib  # noqa: F401
+    except ModuleNotFoundError:
+        pytest.skip("no tomllib: the mini parser only sees bpslint tables")
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.other\nbroken = \n")
+    with pytest.raises(BpslintConfigError, match="not valid TOML"):
+        load_config(tmp_path)
+
+
+def test_repo_config_parses_with_mini_parser():
+    # the repo's own [tool.bpslint] section must stay inside the
+    # 3.10-compatible TOML subset the fallback parser reads
+    from tools.bpslint.config import _parse_tables_mini
+    tables = _parse_tables_mini((REPO / "pyproject.toml").read_text())
+    assert tables[""]["paths"] == ["byteps_tpu", "docs", "tools"]
+    assert "sleep" in tables["lock-discipline"]["blocking-calls"]
+
+
+def test_path_subset_run_seeds_consumption_from_config_paths(tmp_path):
+    # review regression: `bpslint some/file.py` must not report every
+    # doc row as dead and every site as unwoven just because the
+    # consumers live outside the requested subset — the bidirectional
+    # sets are seeded from the CONFIGURED paths, findings restricted to
+    # the requested files
+    cfg = make_tree(tmp_path)
+    assert run(tmp_path, cfg, paths=["mypkg/config.py"]) == []
+    # and a real violation inside the subset still fires
+    (tmp_path / "mypkg" / "viol.py").write_text(
+        'import os\nV = os.environ.get("BYTEPS_ROGUE_KNOB")\n')
+    fs = run(tmp_path, cfg, paths=["mypkg/viol.py"])
+    assert fs and all(f.path == "mypkg/viol.py" for f in fs)
+    # while a violation OUTSIDE the subset stays silent on this run
+    assert run(tmp_path, cfg, paths=["mypkg/config.py"]) == []
+
+
+def test_path_subset_suppresses_reverse_direction_findings(tmp_path):
+    # review regression: dead doc rows and unwoven sites live on files
+    # OUTSIDE a `bpslint some/file.py` subset — they must not leak into
+    # its report (the full run still catches them)
+    env_doc = _ENV_DOC + "| `BYTEPS_DEAD_KNOB` | 0 | consumed by " \
+                         "nothing |\n"
+    inj = 'VALID_SITES = (\n    "good_site",\n    "orphan_site",\n)\n'
+    cfg = make_tree(tmp_path, env_doc=env_doc, injector=inj)
+    full = run(tmp_path, cfg)
+    assert {f.path for f in full} == {"docs/env.md", "mypkg/injector.py"}
+    assert run(tmp_path, cfg, paths=["mypkg/base.py"]) == []
+
+
+def test_explicit_non_py_path_is_usage_error(tmp_path):
+    # review regression: an explicitly requested non-.py FILE used to be
+    # silently skipped — rc 0 looked like "linted clean"
+    cfg = make_tree(tmp_path)
+    with pytest.raises(FileNotFoundError, match="not a Python source"):
+        run(tmp_path, cfg, paths=["docs/env.md"])
+
+
+# -- the acceptance pin -----------------------------------------------------
+
+def test_tree_is_clean():
+    """`python -m tools.bpslint` on this repository exits 0: every
+    contract the analyzer enforces holds on the tree that ships it."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.bpslint"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"bpslint findings:\n{r.stdout}\n{r.stderr}"
+
+
+def test_cli_exit_codes(tmp_path):
+    make_tree(tmp_path, extra={"bad.py": """\
+        from x import _fault
+        _fault.fire("typo_site")
+    """})
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.bpslint]\npaths = ["mypkg", "docs"]\n'
+        'package = "mypkg"\nconfig-module = "mypkg/config.py"\n'
+        'env-doc = "docs/env.md"\nmetrics-doc = "docs/obs.md"\n'
+        'injector-module = "mypkg/injector.py"\n')
+    env = {"PYTHONPATH": str(REPO)}
+    r = subprocess.run([sys.executable, "-m", "tools.bpslint",
+                        "--root", str(tmp_path)],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO, env={**__import__("os").environ, **env})
+    assert r.returncode == 1 and "typo_site" in r.stdout
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.bpslint]\nbogus = 1\n")
+    r2 = subprocess.run([sys.executable, "-m", "tools.bpslint",
+                         "--root", str(tmp_path)],
+                        capture_output=True, text=True, timeout=120,
+                        cwd=REPO, env={**__import__("os").environ, **env})
+    assert r2.returncode == 2 and "configuration error" in r2.stderr

@@ -1,0 +1,201 @@
+"""Lock-order witness (byteps_tpu/common/lock_witness.py, ISSUE 13).
+
+The acceptance pin: an AB/BA acquisition pattern across two threads
+raises :class:`LockOrderError` at the second thread's acquire — before
+the deadlock — and the message names BOTH witnessed code sites.
+"""
+
+import re
+import threading
+
+import pytest
+
+from byteps_tpu.common import lock_witness as lw
+
+
+@pytest.fixture(autouse=True)
+def _armed_witness():
+    lw._force_for_tests(True)
+    lw.reset_witness_for_tests()
+    yield
+    lw._force_for_tests(None)
+    lw.reset_witness_for_tests()
+
+
+def test_disabled_returns_plain_locks():
+    lw._force_for_tests(False)
+    plain = lw.named_lock("x")
+    # a bare threading lock: no wrapper attribute, no witness cost
+    assert not isinstance(plain, lw._WitnessLock)
+    r = lw.named_lock("x", reentrant=True)
+    r.acquire(); r.acquire(); r.release(); r.release()
+
+
+def test_consistent_order_never_raises():
+    a = lw.named_lock("WA")
+    b = lw.named_lock("WB")
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+        except lw.LockOrderError as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert ("WA", "WB") in lw.witness_edges()
+
+
+def test_ab_ba_cycle_raises_naming_both_sites():
+    a = lw.named_lock("WA")
+    b = lw.named_lock("WB")
+    recorded = threading.Event()
+    errs = []
+
+    def t1():
+        with a:
+            with b:          # records WA -> WB at THIS line
+                pass
+        recorded.set()
+
+    def t2():
+        recorded.wait(5)
+        try:
+            with b:
+                with a:      # closes the cycle: raises HERE
+                    pass
+        except lw.LockOrderError as e:
+            errs.append(str(e))
+
+    x = threading.Thread(target=t1)
+    y = threading.Thread(target=t2)
+    x.start(); y.start(); x.join(5); y.join(5)
+    assert len(errs) == 1, "the reversed acquisition must raise"
+    msg = errs[0]
+    # both lock names and both witnessed sites (two distinct lines of
+    # THIS file) are in the message — the operator sees where each
+    # ordering was established, not just that a cycle exists
+    assert "'WA'" in msg and "'WB'" in msg
+    lines = {int(m) for m in
+             re.findall(r"test_lock_witness\.py:(\d+)", msg)}
+    assert len(lines) >= 2, msg
+    # and the second thread did NOT deadlock: both locks are free again
+    assert a.acquire(blocking=False)
+    a.release()
+    assert b.acquire(blocking=False)
+    b.release()
+
+
+def test_transitive_cycle_detected():
+    a, b, c = (lw.named_lock(n) for n in ("TA", "TB", "TC"))
+    done = threading.Event()
+
+    def chain():
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        done.set()
+
+    t = threading.Thread(target=chain)
+    t.start(); t.join(5)
+    assert done.is_set()
+    with pytest.raises(lw.LockOrderError):
+        with c:
+            with a:
+                pass
+
+
+def test_reentrant_reacquire_is_not_a_cycle():
+    r = lw.named_lock("WR", reentrant=True)
+    other = lw.named_lock("WO")
+    with r:
+        with other:
+            with r:          # re-entry: no WO -> WR ordering event
+                pass
+    assert ("WO", "WR") not in lw.witness_edges()
+    assert ("WR", "WO") in lw.witness_edges()
+
+
+def test_try_acquire_skips_order_check():
+    a = lw.named_lock("QA")
+    b = lw.named_lock("QB")
+    with a:
+        with b:
+            pass
+    with b:
+        # non-blocking acquire against the recorded order: deadlock-free
+        # by construction, so no raise — and no reverse edge recorded
+        assert a.acquire(blocking=False)
+        a.release()
+    assert ("QB", "QA") not in lw.witness_edges()
+
+
+def test_condition_wait_through_witnessed_lock():
+    cv = threading.Condition(lw.named_lock("WCV", reentrant=True))
+    hits = []
+
+    def waiter():
+        with cv:
+            if cv.wait(timeout=5):
+                hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # let the waiter park (wait() fully releases the witnessed lock)
+    for _ in range(500):
+        with cv:
+            parked = bool(cv._waiters)
+        if parked:
+            break
+        threading.Event().wait(0.01)
+    with cv:
+        cv.notify_all()
+    t.join(5)
+    assert hits == [1]
+
+
+def test_installed_config_arms_witness_without_env(monkeypatch):
+    # review regression: Config.lock_witness must be LIVE —
+    # set_config(Config(lock_witness=True)) arms locks constructed
+    # after it, with no env var exported
+    from byteps_tpu.common.config import Config, reset_config, set_config
+    lw._force_for_tests(None)
+    monkeypatch.delenv("BYTEPS_LOCK_WITNESS", raising=False)
+    try:
+        set_config(Config(lock_witness=True))
+        assert isinstance(lw.named_lock("cfg_armed"), lw._WitnessLock)
+        set_config(Config(lock_witness=False))
+        assert not isinstance(lw.named_lock("cfg_off"), lw._WitnessLock)
+        # env-backed default: an explicit Config built under the chaos
+        # lanes' exported var stays armed
+        monkeypatch.setenv("BYTEPS_LOCK_WITNESS", "1")
+        set_config(Config())
+        assert isinstance(lw.named_lock("env_default"), lw._WitnessLock)
+    finally:
+        reset_config()
+        lw._force_for_tests(True)
+
+
+def test_adopted_components_construct_witnessed():
+    # the high-traffic locks adopt named_lock: with the witness forced
+    # on, a fresh registry/store construct witnessed locks (the chaos
+    # lanes run this way end to end)
+    from byteps_tpu.common.metrics import MetricsRegistry
+    r = MetricsRegistry()
+    assert isinstance(r._lock, lw._WitnessLock)
+    r.inc("x")
+    assert r.get_counter("x") == 1
+    from byteps_tpu.server.kv_store import KVStore
+    s = KVStore()
+    assert isinstance(s._lock, lw._WitnessLock)

@@ -21,7 +21,9 @@ Usage:
     python tools/bps_trace.py [--dir DIR] [--out merged.json] [--validate]
 
     --dir       directory of per-rank trace files
-                (default: $BYTEPS_TRACE_DIR or .)
+                (default: $BYTEPS_TRACE_DIR, else the per-user tmp
+                trace dir the engine writes to — byteps_tpu.common
+                .config.trace_dir_from_env, the one source of truth)
     --out       merged output path (default: <dir>/bps_trace_merged.json)
     --validate  check the merged timeline and exit nonzero on:
                   * any flow ``s`` without a matching ``f`` (same id)
@@ -222,10 +224,18 @@ def summarize(merged: dict) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--dir", default=os.environ.get("BYTEPS_TRACE_DIR", "."))
+    ap.add_argument("--dir", default=None)
     ap.add_argument("--out", default=None)
     ap.add_argument("--validate", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.dir is None:
+        # same derivation the engine flushes to — the tool must look
+        # where the tracer wrote, not at a second hardcoded default
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from byteps_tpu.common.config import trace_dir_from_env
+        args.dir = trace_dir_from_env()
 
     docs = load_trace_files(args.dir)
     if not docs:

@@ -1,0 +1,27 @@
+"""bpslint: project-invariant static analysis for this repository.
+
+Four rule families keep the hand-maintained cross-file contracts
+machine-checked (docs/dev_invariants.md):
+
+- ``env-knob``      — every BYTEPS_* literal Config-validated AND
+                      documented in docs/env.md; every doc row consumed
+- ``metric-name``   — facade metric names <-> docs/observability.md table
+- ``chaos-site``    — fire()/should_drop()/corrupt() literals <->
+                      the injector's VALID_SITES, both directions
+- ``lock-discipline`` — no blocking call / user callback lexically
+                      inside a ``with <lock>:`` body
+
+Run: ``python -m tools.bpslint byteps_tpu docs tools`` (exit 0 clean,
+1 findings, 2 usage/config error).  Suppress a finding with
+``# bpslint: ignore[rule] reason=...`` — the reason is mandatory.
+
+The runtime complement is the lock-order witness
+(``byteps_tpu/common/lock_witness.py``, ``BYTEPS_LOCK_WITNESS=1``).
+"""
+
+from .config import (BpslintConfig, BpslintConfigError, RULE_NAMES,
+                     load_config)
+from .core import Finding, LintTree, run
+
+__all__ = ["BpslintConfig", "BpslintConfigError", "RULE_NAMES",
+           "load_config", "Finding", "LintTree", "run"]

@@ -1,0 +1,58 @@
+"""CLI: ``python -m tools.bpslint [paths...]``.
+
+Paths default to the ``[tool.bpslint] paths`` entry in pyproject.toml
+(which defaults to ``byteps_tpu docs tools``).  Exit status: 0 = clean,
+1 = findings, 2 = configuration/usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .config import RULE_NAMES, BpslintConfigError, load_config
+from .core import run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bpslint",
+        description="Project-invariant analyzer: env-knob / metric-name /"
+                    " chaos-site / lock-discipline drift, bidirectional.")
+    ap.add_argument("paths", nargs="*",
+                    help="directories/files to scan (default: "
+                         "[tool.bpslint] paths from pyproject.toml)")
+    ap.add_argument("--root", default=".",
+                    help="repository root holding pyproject.toml "
+                         "(default: cwd)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule names and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULE_NAMES:
+            print(r)
+        return 0
+
+    root = Path(args.root).resolve()
+    try:
+        cfg = load_config(root)
+        findings = run(root, cfg, args.paths or None)
+    except BpslintConfigError as e:
+        print(f"bpslint: configuration error: {e}", file=sys.stderr)
+        return 2
+    except FileNotFoundError as e:
+        print(f"bpslint: {e}", file=sys.stderr)
+        return 2
+
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"bpslint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,288 @@
+"""``[tool.bpslint]`` configuration: parsed from pyproject.toml, validated
+eagerly with actionable errors (the same contract as the fault injector's
+spec parser — a typo'd key must fail the run loudly, not silently lint
+nothing).
+
+Python 3.10 has no ``tomllib``, so a minimal TOML-subset reader backs it
+up: only the ``[tool.bpslint*]`` tables are read, supporting string /
+bool / int scalars and (possibly multi-line) string arrays — exactly the
+shapes this config uses.  Anything else inside those tables is a
+configuration error, reported with the offending line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, List, Optional
+
+# Rule names are the analyzer's public contract: pragma rule lists and
+# the enable/disable config are validated against this set.
+RULE_NAMES = ("env-knob", "metric-name", "chaos-site", "lock-discipline")
+
+# bare "sleep" matches any receiver (time.sleep included); a dotted
+# entry would narrow a spec to one receiver, so none is needed here
+_DEFAULT_BLOCKING = ["sleep", "block_until_ready", "_request", "_block"]
+_DEFAULT_CALLBACKS = ["fn", "cb", "callback", "hook"]
+
+
+class BpslintConfigError(ValueError):
+    """A [tool.bpslint] entry the analyzer cannot honor."""
+
+
+@dataclasses.dataclass
+class BpslintConfig:
+    """Resolved analyzer configuration (defaults match this repo)."""
+
+    paths: List[str] = dataclasses.field(
+        default_factory=lambda: ["byteps_tpu", "docs", "tools"])
+    disable: List[str] = dataclasses.field(default_factory=list)
+    # the code tree whose BYTEPS_*/metric literals are ENFORCED (other
+    # scanned paths only count as consumers)
+    package: str = "byteps_tpu"
+    config_module: str = "byteps_tpu/common/config.py"
+    env_doc: str = "docs/env.md"
+    metrics_doc: str = "docs/observability.md"
+    injector_module: str = "byteps_tpu/fault/injector.py"
+    blocking_calls: List[str] = dataclasses.field(
+        default_factory=lambda: list(_DEFAULT_BLOCKING))
+    callback_names: List[str] = dataclasses.field(
+        default_factory=lambda: list(_DEFAULT_CALLBACKS))
+
+    def enabled_rules(self) -> List[str]:
+        return [r for r in RULE_NAMES if r not in self.disable]
+
+
+def _fail(msg: str) -> BpslintConfigError:
+    return BpslintConfigError(f"[tool.bpslint] {msg}")
+
+
+_TOP_KEYS = {
+    "paths": ("paths", list),
+    "disable": ("disable", list),
+    "package": ("package", str),
+    "config-module": ("config_module", str),
+    "env-doc": ("env_doc", str),
+    "metrics-doc": ("metrics_doc", str),
+    "injector-module": ("injector_module", str),
+}
+_LOCK_KEYS = {
+    "blocking-calls": ("blocking_calls", list),
+    "callback-names": ("callback_names", list),
+}
+
+
+def parse_tables(text: str) -> Dict[str, Dict[str, object]]:
+    """Extract the ``[tool.bpslint*]`` tables from a pyproject document.
+
+    Prefers stdlib ``tomllib`` when available; otherwise reads the
+    subset described in the module docstring.  Returns
+    ``{table_suffix: {key: value}}`` where the suffix of
+    ``[tool.bpslint]`` itself is ``""`` and of
+    ``[tool.bpslint.lock-discipline]`` is ``"lock-discipline"``.
+    """
+    try:
+        import tomllib  # Python >= 3.11
+    except ModuleNotFoundError:
+        return _parse_tables_mini(text)
+    try:
+        doc = tomllib.loads(text)
+    except tomllib.TOMLDecodeError as e:
+        # a config error, not a lint finding: exit 2, matching the
+        # mini parser's behavior on Python 3.10
+        raise _fail(f"pyproject.toml is not valid TOML: {e}") from None
+    node = doc.get("tool", {}).get("bpslint")
+    if node is None:
+        return {}
+    out: Dict[str, Dict[str, object]] = {"": {}}
+    for k, v in node.items():
+        if isinstance(v, dict):
+            out[k] = dict(v)
+        else:
+            out[""][k] = v
+    return out
+
+
+def _parse_tables_mini(text: str) -> Dict[str, Dict[str, object]]:
+    out: Dict[str, Dict[str, object]] = {}
+    current: Optional[str] = None  # table suffix, None = not ours
+    pending_key: Optional[str] = None
+    pending_buf = ""
+    pending_line = 0
+
+    def _finish(value_text: str, lineno: int):
+        nonlocal pending_key
+        assert current is not None and pending_key is not None
+        out.setdefault(current, {})[pending_key] = _parse_value(
+            value_text, pending_key, lineno)
+        pending_key = None
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if pending_key is not None:
+            pending_buf += " " + line
+            if _array_closed(pending_buf):
+                _finish(pending_buf, lineno)
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^\[(.+?)\]$", line)
+        if m:
+            name = m.group(1).strip()
+            if name == "tool.bpslint":
+                current = ""
+            elif name.startswith("tool.bpslint."):
+                current = name[len("tool.bpslint."):]
+            else:
+                current = None
+            if current is not None:
+                out.setdefault(current, {})
+            continue
+        if current is None:
+            continue
+        m = re.match(r"^([A-Za-z0-9_\-]+)\s*=\s*(.*)$", line)
+        if not m:
+            raise _fail(f"cannot parse line {lineno}: {raw!r} (expected "
+                        f"`key = value`)")
+        key, value_text = m.group(1), m.group(2).strip()
+        if value_text.startswith("[") and not _array_closed(value_text):
+            pending_key, pending_buf, pending_line = key, value_text, lineno
+            continue
+        out[current][key] = _parse_value(value_text, key, lineno)
+    if pending_key is not None:
+        raise _fail(f"unterminated array for key {pending_key!r} "
+                    f"(started at line {pending_line})")
+    return out
+
+
+def _array_closed(s: str) -> bool:
+    # good enough for string arrays: balanced bracket outside quotes
+    depth = 0
+    in_str: Optional[str] = None
+    for c in s:
+        if in_str:
+            if c == in_str:
+                in_str = None
+        elif c in "\"'":
+            in_str = c
+        elif c == "[":
+            depth += 1
+        elif c == "]":
+            depth -= 1
+    return depth == 0 and in_str is None
+
+
+def _parse_value(s: str, key: str, lineno: int):
+    s = s.strip()
+    # strip a trailing comment (outside quotes)
+    out, in_str = [], None
+    for c in s:
+        if in_str:
+            out.append(c)
+            if c == in_str:
+                in_str = None
+        elif c in "\"'":
+            in_str = c
+            out.append(c)
+        elif c == "#":
+            break
+        else:
+            out.append(c)
+    s = "".join(out).strip()
+    if s in ("true", "false"):
+        return s == "true"
+    if re.fullmatch(r"-?\d+", s):
+        return int(s)
+    if len(s) >= 2 and s[0] in "\"'" and s[-1] == s[0]:
+        return s[1:-1]
+    if s.startswith("[") and s.endswith("]"):
+        inner = s[1:-1].strip()
+        if not inner:
+            return []
+        items = []
+        for part in _split_commas(inner):
+            part = part.strip()
+            if not part:
+                continue
+            if len(part) >= 2 and part[0] in "\"'" and part[-1] == part[0]:
+                items.append(part[1:-1])
+            else:
+                raise _fail(f"key {key!r} (line {lineno}): array elements "
+                            f"must be quoted strings, got {part!r}")
+        return items
+    raise _fail(f"key {key!r} (line {lineno}): unsupported value {s!r} "
+                f"(strings, booleans, integers and string arrays only)")
+
+
+def _split_commas(s: str) -> List[str]:
+    parts, buf, in_str = [], "", None
+    for c in s:
+        if in_str:
+            buf += c
+            if c == in_str:
+                in_str = None
+        elif c in "\"'":
+            in_str = c
+            buf += c
+        elif c == ",":
+            parts.append(buf)
+            buf = ""
+        else:
+            buf += c
+    if buf.strip():
+        parts.append(buf)
+    return parts
+
+
+def load_config(root: Path) -> BpslintConfig:
+    """Read and validate ``[tool.bpslint]`` from ``root/pyproject.toml``.
+    A missing file or missing section yields the defaults."""
+    pj = root / "pyproject.toml"
+    if not pj.is_file():
+        return BpslintConfig()
+    tables = parse_tables(pj.read_text())
+    if not tables:
+        return BpslintConfig()
+    cfg = BpslintConfig()
+    known_tables = {"", "lock-discipline"}
+    for suffix in tables:
+        if suffix not in known_tables:
+            raise _fail(
+                f"unknown table [tool.bpslint.{suffix}]; known sub-tables: "
+                f"lock-discipline")
+    for key, value in tables.get("", {}).items():
+        if key not in _TOP_KEYS:
+            raise _fail(f"unknown key {key!r}; valid keys: "
+                        f"{', '.join(sorted(_TOP_KEYS))}")
+        attr, typ = _TOP_KEYS[key]
+        _check_type(key, value, typ)
+        setattr(cfg, attr, value)
+    for key, value in tables.get("lock-discipline", {}).items():
+        if key not in _LOCK_KEYS:
+            raise _fail(f"[lock-discipline] unknown key {key!r}; valid "
+                        f"keys: {', '.join(sorted(_LOCK_KEYS))}")
+        attr, typ = _LOCK_KEYS[key]
+        _check_type(key, value, typ)
+        setattr(cfg, attr, value)
+    bad = [r for r in cfg.disable if r not in RULE_NAMES]
+    if bad:
+        raise _fail(f"disable names unknown rule(s) {bad}; valid rules: "
+                    f"{', '.join(RULE_NAMES)}")
+    if not cfg.paths:
+        raise _fail("paths must name at least one directory to scan")
+    for p in cfg.paths:
+        if not isinstance(p, str) or not p:
+            raise _fail(f"paths entries must be non-empty strings, "
+                        f"got {p!r}")
+    return cfg
+
+
+def _check_type(key: str, value: object, typ: type) -> None:
+    if typ is list:
+        if not isinstance(value, list) or any(
+                not isinstance(x, str) for x in value):
+            raise _fail(f"key {key!r} must be an array of strings, "
+                        f"got {value!r}")
+    elif not isinstance(value, typ):
+        raise _fail(f"key {key!r} must be a {typ.__name__}, got {value!r}")

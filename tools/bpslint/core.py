@@ -1,0 +1,300 @@
+"""bpslint engine: file walking, pragma handling, AST helpers, runner.
+
+The analyzer is pure stdlib-``ast`` — it never imports the package it
+checks, so a tree with an import-time bug still lints (and the lint can
+run in CI before any heavyweight dependency exists).
+
+Pragma contract (docs/dev_invariants.md): a finding is suppressed by
+
+    # bpslint: ignore[rule-name] reason=why this exception is sound
+
+on the finding's line or the line directly above it.  The ``reason=`` is
+*required*: an ignore that cannot say why it is safe is itself reported
+(rule ``pragma``), as is an ignore naming a rule that does not exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .config import RULE_NAMES, BpslintConfig
+
+_PRAGMA_RE = re.compile(
+    r"#\s*bpslint:\s*ignore\[([^\]]*)\]\s*(?:reason=(.*))?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # root-relative, slash-separated
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass
+class Pragma:
+    line: int
+    rules: Set[str]
+    reason: str
+
+
+class PyFile:
+    """One parsed source file: text, AST, pragmas, literal index."""
+
+    def __init__(self, root: Path, path: Path):
+        self.path = path
+        # False when the file was scanned only to seed the global
+        # consumption/emission/fired sets (a path-subset CLI run):
+        # rules report findings only on requested files
+        self.requested = True
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        self.pragmas: Dict[int, Pragma] = {}
+        self.bad_pragmas: List[Tuple[int, str]] = []
+        for i, comment in self._comments():
+            m = _PRAGMA_RE.search(comment)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            unknown = sorted(r for r in rules if r not in RULE_NAMES)
+            if unknown:
+                self.bad_pragmas.append(
+                    (i, f"ignore pragma names unknown rule(s) "
+                        f"{', '.join(unknown)}; valid rules: "
+                        f"{', '.join(RULE_NAMES)}"))
+                continue
+            if not rules:
+                self.bad_pragmas.append(
+                    (i, "ignore pragma lists no rules — use "
+                        "ignore[rule-name]"))
+                continue
+            if not reason:
+                self.bad_pragmas.append(
+                    (i, "ignore pragma carries no reason= — every "
+                        "suppression must say why the exception is sound"))
+                continue
+            self.pragmas[i] = Pragma(i, rules, reason)
+
+    def _comments(self) -> List[Tuple[int, str]]:
+        """(line, text) of every real COMMENT token — pragma syntax
+        quoted inside a docstring or string literal is documentation,
+        not a suppression."""
+        try:
+            return [(tok.start[0], tok.string) for tok in
+                    tokenize.generate_tokens(io.StringIO(self.text).readline)
+                    if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            # unparseable file: fall back to the lexical scan (the file
+            # already carries a parse finding)
+            return [(i, ln) for i, ln in enumerate(self.lines, 1)
+                    if "#" in ln]
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            p = self.pragmas.get(ln)
+            if p and rule in p.rules:
+                return True
+        return False
+
+    # -- AST helpers -------------------------------------------------------
+
+    def string_constants(self) -> Iterable[Tuple[str, int]]:
+        """Every string Constant in the file with its line, docstrings
+        excluded (a knob named in prose must not count as consumption)."""
+        if self.tree is None:
+            return
+        doc_ids = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                body = getattr(node, "body", [])
+                if (body and isinstance(body[0], ast.Expr)
+                        and isinstance(body[0].value, ast.Constant)
+                        and isinstance(body[0].value.value, str)):
+                    doc_ids.add(id(body[0].value))
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and id(node) not in doc_ids):
+                yield node.value, node.lineno
+
+    def calls(self) -> Iterable[ast.Call]:
+        if self.tree is None:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+def call_target(call: ast.Call) -> Tuple[Optional[str], str]:
+    """(receiver terminal name | None, callee name) of a call:
+    ``counters.inc(...)`` -> ("counters", "inc"); ``fire(...)`` ->
+    (None, "fire"); ``a.b.c(...)`` -> ("b", "c")."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return None, f.id
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name):
+            return v.id, f.attr
+        if isinstance(v, ast.Attribute):
+            return v.attr, f.attr
+        return "", f.attr
+    return None, ""
+
+
+def first_str_arg(call: ast.Call) -> Optional[Tuple[str, int]]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value, call.args[0].lineno
+    return None
+
+
+class LintTree:
+    """The scanned tree: source files by role, with caching."""
+
+    def __init__(self, root: Path, cfg: BpslintConfig,
+                 paths: Optional[Sequence[str]] = None):
+        self.root = root
+        self.cfg = cfg
+        self.paths = list(paths) if paths else list(cfg.paths)
+        self._files: Dict[str, PyFile] = {}
+        self.py_files: List[PyFile] = []
+        seen: Set[str] = set()
+
+        def _scan(p: str, requested: bool, must_exist: bool) -> None:
+            base = (root / p).resolve()
+            if not base.exists():
+                if must_exist:
+                    raise FileNotFoundError(
+                        f"scan path {p!r} does not exist under {root}")
+                return
+            if base.is_file() and base.suffix != ".py":
+                if must_exist:
+                    raise FileNotFoundError(
+                        f"scan path {p!r} is not a Python source — the "
+                        f"analyzer lints .py files (doc files are "
+                        f"checked as the doc side of the bidirectional "
+                        f"rules, from the configured paths)")
+                return
+            cands = [base] if base.is_file() else sorted(
+                base.rglob("*.py"))
+            for f in cands:
+                if f.suffix != ".py" or "__pycache__" in f.parts:
+                    continue
+                rel = f.relative_to(root).as_posix()
+                if rel in seen:
+                    continue
+                seen.add(rel)
+                pf = PyFile(root, f)
+                pf.requested = requested
+                self._files[rel] = pf
+                self.py_files.append(pf)
+
+        # requested paths first (their files carry findings) ...
+        for p in self.paths:
+            _scan(p, requested=True, must_exist=True)
+        # ... then the configured paths, so the bidirectional rules'
+        # consumption/emission/fired sets see the WHOLE project even on
+        # a path-subset run — otherwise `bpslint some/file.py` would
+        # report every doc row as dead and every site as unwoven
+        for p in cfg.paths:
+            _scan(p, requested=False, must_exist=False)
+
+    def requested_path(self, rel: str) -> bool:
+        """True when ``rel`` falls under one of this run's requested
+        scan paths — reverse-direction findings (dead doc rows, unwoven
+        sites) are reported only on requested targets, so a path-subset
+        run stays restricted to the files it was asked about."""
+        for p in self.paths:
+            q = p.rstrip("/")
+            if rel == q or rel.startswith(q + "/"):
+                return True
+        return False
+
+    def scan_scope(self) -> str:
+        """Human-readable scope the consumption/emission/fired sets were
+        seeded from: the requested paths plus the configured paths."""
+        return ", ".join(dict.fromkeys(
+            list(self.paths) + list(self.cfg.paths)))
+
+    def file(self, rel: str) -> Optional[PyFile]:
+        """A role file (config module, injector) — loaded on demand even
+        when outside the scan paths."""
+        if rel in self._files:
+            return self._files[rel]
+        p = self.root / rel
+        if not p.is_file():
+            return None
+        pf = PyFile(self.root, p)
+        self._files[rel] = pf
+        return pf
+
+    def package_files(self) -> List[PyFile]:
+        pkg = self.cfg.package.rstrip("/") + "/"
+        return [f for f in self.py_files
+                if f.rel.startswith(pkg) or f.rel == self.cfg.package]
+
+    def doc_text(self, rel: str) -> Optional[List[str]]:
+        p = self.root / rel
+        if not p.is_file():
+            return None
+        return p.read_text(encoding="utf-8", errors="replace").splitlines()
+
+
+def run(root: Path, cfg: Optional[BpslintConfig] = None,
+        paths: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run every enabled rule over the tree; returns unsuppressed
+    findings sorted by (path, line)."""
+    from . import rules_chaos, rules_env, rules_locks, rules_metrics
+    if cfg is None:
+        from .config import load_config
+        cfg = load_config(root)
+    tree = LintTree(root, cfg, paths)
+
+    findings: List[Finding] = []
+    # parse errors and pragma hygiene are not disableable — they gate
+    # the analyzer's own ability to mean anything
+    for pf in tree.py_files:
+        if not pf.requested:
+            continue
+        if pf.parse_error:
+            findings.append(Finding("parse", pf.rel, 1, pf.parse_error))
+        for line, msg in pf.bad_pragmas:
+            findings.append(Finding("pragma", pf.rel, line, msg))
+
+    checkers = {
+        "env-knob": rules_env.check,
+        "metric-name": rules_metrics.check,
+        "chaos-site": rules_chaos.check,
+        "lock-discipline": rules_locks.check,
+    }
+    for rule in cfg.enabled_rules():
+        findings.extend(checkers[rule](tree))
+
+    out: List[Finding] = []
+    for f in findings:
+        pf = tree._files.get(f.path)
+        if pf is not None and f.rule in RULE_NAMES \
+                and pf.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

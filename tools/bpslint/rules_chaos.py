@@ -1,0 +1,100 @@
+"""Rule ``chaos-site``: fault-injection site drift, bidirectionally.
+
+Contract (docs/dev_invariants.md):
+
+1. every literal site passed to the injector delegates — ``fire(...)``,
+   ``should_drop(...)``, ``corrupt(...)``, ``corrupt_bytes(...)`` —
+   must be a member of the injector's ``VALID_SITES`` tuple (a typo'd
+   site would validate specs against a site that never fires); and
+2. every ``VALID_SITES`` entry must be woven somewhere — passed as a
+   literal to one of those delegates outside the injector itself — so a
+   site that was unwired during a refactor fails the lint instead of
+   silently accepting specs that inject nothing.
+
+Entries that are deliberately not woven code sites (e.g. the
+``coordinator`` kill-only predicate) carry an inline ignore pragma on
+their own line of the tuple, with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, LintTree, call_target, first_str_arg
+
+_DELEGATES = {"fire", "should_drop", "corrupt", "corrupt_bytes"}
+
+
+def valid_sites(injector_pf) -> Optional[Dict[str, int]]:
+    """``{site: line}`` from the injector's ``VALID_SITES = (...)``
+    assignment — per-element linenos, so an unwoven site is reported
+    (and pragma-suppressible) on its own line."""
+    if injector_pf is None or injector_pf.tree is None:
+        return None
+    for node in ast.walk(injector_pf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "VALID_SITES"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return None
+        out: Dict[str, int] = {}
+        for elt in node.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out[elt.value] = elt.lineno
+        return out
+    return None
+
+
+def check(tree: LintTree) -> List[Finding]:
+    cfg = tree.cfg
+    injector_pf = tree.file(cfg.injector_module)
+    sites = valid_sites(injector_pf)
+    if sites is None:
+        return [Finding("chaos-site", cfg.injector_module, 1,
+                        "cannot locate a literal VALID_SITES tuple — the "
+                        "chaos-site rule has no source of truth")]
+
+    findings: List[Finding] = []
+    fired: Set[str] = set()
+    pkg = cfg.package.rstrip("/") + "/"
+    seen: Set[Tuple[str, str]] = set()
+    for pf in tree.py_files:
+        if not pf.rel.startswith(pkg) or pf.rel == cfg.injector_module:
+            continue
+        for call in pf.calls():
+            _, meth = call_target(call)
+            if meth not in _DELEGATES:
+                continue
+            lit = first_str_arg(call)
+            if lit is None:
+                continue   # dynamic site (e.g. wire_transmit's) — the
+                # values flowing in are themselves literals elsewhere
+            site, line = lit
+            fired.add(site)
+            if not pf.requested:
+                continue
+            if site not in sites and (site, pf.rel) not in seen:
+                seen.add((site, pf.rel))
+                findings.append(Finding(
+                    "chaos-site", pf.rel, line,
+                    f"chaos site {site!r} is not in the injector's "
+                    f"VALID_SITES — specs naming it are rejected at "
+                    f"init, so this hook can never fire (add the site, "
+                    f"or fix the typo; valid: "
+                    f"{', '.join(sorted(sites))})"))
+
+    if not tree.requested_path(cfg.injector_module):
+        return findings
+    for site, line in sorted(sites.items()):
+        if site not in fired:
+            findings.append(Finding(
+                "chaos-site", cfg.injector_module, line,
+                f"VALID_SITES entry {site!r} is never woven — no "
+                f"fire/should_drop/corrupt call passes it, so a spec "
+                f"targeting it injects nothing (wire it up, remove it, "
+                f"or pragma its tuple line with the reason it is not a "
+                f"woven site)"))
+    return findings

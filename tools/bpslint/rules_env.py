@@ -1,0 +1,124 @@
+"""Rule ``env-knob``: BYTEPS_* environment-knob drift, bidirectionally.
+
+Contract (docs/dev_invariants.md):
+
+1. every ``BYTEPS_*`` string literal in the package must be **validated**
+   — the same name appears in ``common/config.py`` (the typed Config is
+   the single parse/validate point for knobs); and
+2. the same name must have a **row in docs/env.md** (any table whose
+   header column is ``Variable``; the reference-disposition table is
+   historical record, not live documentation, and is excluded); and
+3. every documented knob must be **consumed** — the name appears as a
+   literal somewhere in the scanned code — so a deleted knob cannot
+   leave a live-looking doc row behind (the
+   ``BYTEPS_SERVE_CUT_INTERVAL`` failure mode: defined, documented,
+   consumed by nothing).
+
+Only full-string literals count (``"BYTEPS_FOO"``), never substrings of
+messages or docstrings — an error string *naming* a knob is not a read.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, LintTree
+
+_KNOB = re.compile(r"^BYTEPS_[A-Z0-9_]+$")
+_KNOB_IN_ROW = re.compile(r"BYTEPS_[A-Z0-9_]+")
+
+
+def doc_rows(lines: List[str]) -> Dict[str, int]:
+    """``{knob: first line}`` from every markdown table whose header
+    row's first column is exactly ``Variable``.  Knob names are taken
+    from the WHOLE row (a knob explained in another row's meaning cell
+    — e.g. a renamed fallback — is documented there)."""
+    out: Dict[str, int] = {}
+    in_table = False
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if stripped.startswith("|"):
+            cells = [c.strip() for c in stripped.strip("|").split("|")]
+            first = cells[0].strip("`* ") if cells else ""
+            if first == "Variable":
+                in_table = True
+                continue
+            if in_table:
+                if set(first) <= set("-: "):
+                    continue  # the |---|---| separator
+                for m in _KNOB_IN_ROW.finditer(stripped):
+                    out.setdefault(m.group(0), i)
+        else:
+            in_table = False
+    return out
+
+
+def check(tree: LintTree) -> List[Finding]:
+    cfg = tree.cfg
+    findings: List[Finding] = []
+
+    config_pf = tree.file(cfg.config_module)
+    if config_pf is None or config_pf.tree is None:
+        return [Finding("env-knob", cfg.config_module, 1,
+                        "config module missing or unparseable — the "
+                        "env-knob rule has no validation source")]
+    config_names: Set[str] = {
+        s for s, _ in config_pf.string_constants() if _KNOB.match(s)}
+
+    lines = tree.doc_text(cfg.env_doc)
+    if lines is None:
+        return [Finding("env-knob", cfg.env_doc, 1,
+                        "env doc missing — the env-knob rule has no "
+                        "documentation source")]
+    documented = doc_rows(lines)
+
+    # all consumers (package + tools + any other scanned py), for the
+    # dead-doc-row direction
+    consumed: Set[str] = set()
+    # package literals, for the validated+documented direction
+    pkg_literals: List[Tuple[str, str, int]] = []   # (knob, rel, line)
+    pkg = cfg.package.rstrip("/") + "/"
+    for pf in tree.py_files:
+        for s, line in pf.string_constants():
+            if not _KNOB.match(s):
+                continue
+            consumed.add(s)
+            if pf.requested and pf.rel.startswith(pkg):
+                pkg_literals.append((s, pf.rel, line))
+    # the config module itself may sit outside the scan paths
+    for s, _ in config_pf.string_constants():
+        if _KNOB.match(s):
+            consumed.add(s)
+
+    seen: Set[Tuple[str, str, str]] = set()
+    for knob, rel, line in pkg_literals:
+        is_config = rel == cfg.config_module
+        if not is_config and knob not in config_names:
+            key = (knob, rel, "validate")
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(
+                    "env-knob", rel, line,
+                    f"env knob {knob} is read here but never validated "
+                    f"in {cfg.config_module} — add a Config field (or "
+                    f"an ignore pragma saying why this read cannot go "
+                    f"through Config)"))
+        if knob not in documented:
+            key = (knob, rel, "doc")
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(
+                    "env-knob", rel, line,
+                    f"env knob {knob} has no row in {cfg.env_doc} — "
+                    f"document it (operators discover knobs there)"))
+
+    if tree.requested_path(cfg.env_doc):
+        for knob, line in sorted(documented.items()):
+            if knob not in consumed:
+                findings.append(Finding(
+                    "env-knob", cfg.env_doc, line,
+                    f"documented knob {knob} is consumed nowhere in "
+                    f"{tree.scan_scope()} — dead doc row (delete it, or "
+                    f"wire the knob back up)"))
+    return findings

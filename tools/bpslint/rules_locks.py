@@ -1,0 +1,120 @@
+"""Rule ``lock-discipline``: no blocking call or user callback under a
+held lock, lexically.
+
+Contract (docs/dev_invariants.md): inside the body of a
+``with <something lock-shaped>:`` statement, a call to a known-blocking
+function (``time.sleep``, ``jax.block_until_ready`` / the engine's
+injectable ``_block`` hook, the membership bus's socket ``_request``) or
+to a user-supplied callback (a bare ``fn(...)`` / ``cb(...)`` /
+``callback(...)`` / ``hook(...)``) is flagged.  Both failure modes are
+from this repo's own review history: subscriber hooks fired inside
+``KVStore._lock`` (PR 8) and a SIGTERM handler deadlocking on a held
+non-reentrant flight-recorder lock (PR 6).
+
+Lexical scope: nested ``def``/``lambda``/``class`` bodies are *not*
+"under the lock" (they run later); nested ``with`` bodies are.  The
+check is deliberately shallow — it cannot see a blocking call two
+frames down — which is what the runtime lock-order witness
+(``byteps_tpu/common/lock_witness.py``) complements at chaos time.
+
+A context expression is lock-shaped when its terminal identifier ends in
+``lock``/``mutex``/``mu`` (``self._lock``, ``_graph_mu``, …).
+Condition variables (``self._cv``) are deliberately NOT matched:
+``Condition.wait`` releases its lock, so waiting under one is the
+correct pattern, not a bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from .core import Finding, LintTree, call_target
+
+_LOCKISH = re.compile(r"(?:^|_)(?:lock|mutex|mu)$", re.IGNORECASE)
+
+
+def _terminal_name(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        # `with named_lock(...)`-style: look at the callee name
+        _, callee = call_target(expr)
+        return callee or None
+    return None
+
+
+def _lockish(expr: ast.expr) -> bool:
+    name = _terminal_name(expr)
+    return bool(name and _LOCKISH.search(name))
+
+
+def _body_calls(stmts: Iterable[ast.stmt]) -> Iterable[ast.Call]:
+    """Calls lexically executed within these statements: descends
+    everything except deferred bodies (function/class/lambda)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_match(call: ast.Call, blocking: List[str],
+                    callbacks: List[str]) -> Optional[str]:
+    recv, callee = call_target(call)
+    for spec in blocking:
+        if "." in spec:
+            srecv, sname = spec.rsplit(".", 1)
+            if recv == srecv and callee == sname:
+                return spec
+        elif callee == spec:
+            return (f"{recv}.{callee}" if recv else callee)
+    if recv is None and callee in callbacks:
+        return f"user callback {callee}"
+    return None
+
+
+def check(tree: LintTree) -> List[Finding]:
+    cfg = tree.cfg
+    findings: List[Finding] = []
+    pkg = cfg.package.rstrip("/") + "/"
+    for pf in tree.py_files:
+        if not pf.requested or not pf.rel.startswith(pkg) \
+                or pf.tree is None:
+            continue
+        # nested lock-shaped `with` blocks both see the same call via
+        # _body_calls — report it once, attributed to the outermost
+        # (first-visited) lock, which is held for the whole region
+        reported: Set[int] = set()
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = [it.context_expr for it in node.items
+                    if _lockish(it.context_expr)]
+            if not held:
+                continue
+            lock_desc = ", ".join(
+                ast.unparse(h) if hasattr(ast, "unparse") else "lock"
+                for h in held)
+            for call in _body_calls(node.body):
+                hit = _blocking_match(call, cfg.blocking_calls,
+                                      cfg.callback_names)
+                if hit is None or id(call) in reported:
+                    continue
+                reported.add(id(call))
+                findings.append(Finding(
+                    "lock-discipline", pf.rel, call.lineno,
+                    f"{hit}(...) called inside `with {lock_desc}:` "
+                    f"(held since line {node.lineno}) — a blocking call "
+                    f"or user callback under a held lock stalls every "
+                    f"contender and can re-enter the component; move it "
+                    f"outside the lock, or pragma this line with the "
+                    f"reason it cannot block/re-enter"))
+    return findings

@@ -13,7 +13,13 @@
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
-#               | straggler | compressed | trace | all
+#               | straggler | compressed | trace | lint | all
+#         lint: the project-invariant analyzer (tools/bpslint,
+#              docs/dev_invariants.md) over the tree — env-knob /
+#              metric-name / chaos-site / lock-discipline drift, exit
+#              nonzero on any finding; plus its fixture tests and the
+#              lock-order witness unit tests (tests/test_bpslint.py,
+#              tests/test_lock_witness.py)
 #         trace: the causal-tracing slice (ISSUE 12) — a real 3-process
 #              run with BYTEPS_TRACE_SAMPLE armed writes per-rank trace
 #              files that tools/bps_trace.py merges into ONE aligned
@@ -81,6 +87,18 @@ case "${1:-}" in
     compressed) MARK="chaos or integrity"; KEXPR="compress"; shift ;;
     trace)     MARK="chaos"; KEXPR="trace or attrib"; shift ;;
     all)       MARK="chaos or integrity"; shift ;;
+    lint)
+        shift
+        # static half: the analyzer itself (no JAX, fails on findings),
+        # then the rule-fixture and witness unit tests
+        python -m tools.bpslint || exit $?
+        exec timeout -k 15 "$LANE" \
+            env JAX_PLATFORMS=cpu python -m pytest \
+            tests/test_bpslint.py tests/test_lock_witness.py -q \
+            -p tools.chaos_timeout_plugin --chaos-timeout "$PER_TEST" \
+            -p no:cacheprovider -p no:xdist -p no:randomly \
+            "$@"
+        ;;
 esac
 
 # Fail fast on an invalid ambient BYTEPS_FAULT_SPEC: the workers that
@@ -100,8 +118,14 @@ parse_spec(os.environ['BYTEPS_FAULT_SPEC'])" 2>&1); then
     fi
 fi
 
+# Every chaos lane runs with the lock-order witness armed
+# (byteps_tpu/common/lock_witness.py): the high-traffic locks record
+# their acquisition order and RAISE on a cycle, so each fault-injection
+# run doubles as a deadlock hunt across every thread the lane spawns
+# (worker subprocesses inherit the env and are witnessed too).
 exec timeout -k 15 "$LANE" \
-    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$MARK" \
+    env JAX_PLATFORMS=cpu BYTEPS_LOCK_WITNESS=1 \
+    python -m pytest tests/ -q -m "$MARK" \
     ${KEXPR:+-k "$KEXPR"} \
     -p tools.chaos_timeout_plugin --chaos-timeout "$PER_TEST" \
     -p no:cacheprovider -p no:xdist -p no:randomly \

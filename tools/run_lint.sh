@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# bpslint entry point: the project-invariant analyzer (tools/bpslint,
+# docs/dev_invariants.md).  Exit 0 = clean, 1 = findings, 2 = config
+# error.  Pure stdlib — no JAX import, safe as the first CI step.
+#
+# Usage: tools/run_lint.sh [paths...]     (default: [tool.bpslint] paths)
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec python -m tools.bpslint "$@"
