@@ -343,6 +343,49 @@ class Config:
     #                                  subscribes to its KVStore (0 = cut
     #                                  on every consistent write point)
 
+    # --- TCP transport (comm/transport.py, docs/transport.md) ---
+    transport_hosts: str = ""        # BYTEPS_TRANSPORT_HOSTS: per-rank
+    #                                  "host[:port]" list (comma-separated,
+    #                                  indexed by rank) naming where each
+    #                                  rank's transport server listens —
+    #                                  the data-plane analog of
+    #                                  BYTEPS_MEMBERSHIP_HOSTS; empty =
+    #                                  derive 127.0.0.1 + port base
+    transport_port_base: int = 0     # BYTEPS_TRANSPORT_PORT_BASE: rank
+    #                                  R's transport server listens on
+    #                                  port_base + R when the host map
+    #                                  is unset; 0 = ephemeral bind (the
+    #                                  peer then needs the host map or
+    #                                  an explicit address)
+    transport_connect_timeout_s: float = 5.0
+    #                                  BYTEPS_TRANSPORT_CONNECT_TIMEOUT:
+    #                                  per-attempt TCP connect timeout;
+    #                                  the supervisor retries with
+    #                                  full-jitter backoff until closed
+    transport_send_deadline_s: float = 10.0
+    #                                  BYTEPS_TRANSPORT_SEND_DEADLINE:
+    #                                  per-request reply deadline — a
+    #                                  send unanswered past it surfaces
+    #                                  as integrity.AckLost (the
+    #                                  existing retry machinery), NEVER
+    #                                  a hang
+    transport_keepalive_s: float = 5.0
+    #                                  BYTEPS_TRANSPORT_KEEPALIVE: idle
+    #                                  keepalive interval per connection
+    #                                  (a dead-but-ESTABLISHED socket is
+    #                                  discovered within ~2 intervals);
+    #                                  0 = no keepalives
+    transport_max_inflight: int = 64 << 20
+    #                                  BYTEPS_TRANSPORT_MAX_INFLIGHT:
+    #                                  bound on unacknowledged request
+    #                                  bytes per connection; past it the
+    #                                  sender blocks (backpressure into
+    #                                  the pushing thread — which holds
+    #                                  the scheduler credit it consumed,
+    #                                  so the credit window upstream
+    #                                  throttles too), counted in
+    #                                  transport.backpressure_stalls
+
     # --- data integrity (common/integrity.py) ---
     integrity_on: bool = True        # BYTEPS_INTEGRITY: CRC32C-checksummed
     #                                  envelopes + non-finite quarantine on
@@ -542,6 +585,21 @@ class Config:
             raise ValueError("sync_deadline_s must be >= 0 (0 = off)")
         if not 0 <= self.membership_port < 65536:
             raise ValueError("membership_port must be in 0..65535")
+        if not 0 <= self.transport_port_base < 65536:
+            raise ValueError("transport_port_base must be in 0..65535 "
+                             "(0 = ephemeral)")
+        if self.transport_connect_timeout_s <= 0:
+            raise ValueError("transport_connect_timeout_s must be positive")
+        if self.transport_send_deadline_s <= 0:
+            raise ValueError(
+                "transport_send_deadline_s must be positive — the "
+                "per-send deadline is what turns a partitioned peer "
+                "into AckLost instead of a hang")
+        if self.transport_keepalive_s < 0:
+            raise ValueError("transport_keepalive_s must be >= 0 (0 = "
+                             "no keepalives)")
+        if self.transport_max_inflight <= 0:
+            raise ValueError("transport_max_inflight must be positive")
         if self.nonfinite_policy not in ("raise", "skip", "zero"):
             raise ValueError(
                 f"BYTEPS_NONFINITE_POLICY must be raise, skip, or zero — "
@@ -667,6 +725,16 @@ class Config:
                                              0.5),
             serve_cut_interval_s=_env_float("BYTEPS_SERVE_CUT_INTERVAL",
                                             0.05),
+            transport_hosts=_env_str("BYTEPS_TRANSPORT_HOSTS", ""),
+            transport_port_base=_env_int("BYTEPS_TRANSPORT_PORT_BASE", 0),
+            transport_connect_timeout_s=_env_float(
+                "BYTEPS_TRANSPORT_CONNECT_TIMEOUT", 5.0),
+            transport_send_deadline_s=_env_float(
+                "BYTEPS_TRANSPORT_SEND_DEADLINE", 10.0),
+            transport_keepalive_s=_env_float(
+                "BYTEPS_TRANSPORT_KEEPALIVE", 5.0),
+            transport_max_inflight=_env_int(
+                "BYTEPS_TRANSPORT_MAX_INFLIGHT", 64 << 20),
             integrity_on=_env_bool("BYTEPS_INTEGRITY", True),
             integrity_loopback=_env_bool("BYTEPS_INTEGRITY_LOOPBACK", True),
             integrity_max_retransmits=_env_int(

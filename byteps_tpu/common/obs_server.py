@@ -124,6 +124,15 @@ def debug_state() -> dict:
                       for c in _metrics.components("kv_store")],
         "serving_planes": [c.debug_state()
                            for c in _metrics.components("serving_plane")],
+        # the TCP transport (comm/transport.py): per-connection state
+        # machine snapshots (CONNECTING/READY/DRAINING/DEAD, in-flight
+        # bytes, reconnect counts) + per-server attachment/peer views
+        "transport": {
+            "servers": [c.debug_state()
+                        for c in _metrics.components("transport_server")],
+            "connections": [c.debug_state()
+                            for c in _metrics.components("transport_conn")],
+        },
         "flight_recorder": {
             "enabled": _flight.recorder.enabled,
             "events": len(_flight.recorder),

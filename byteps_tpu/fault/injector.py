@@ -41,6 +41,37 @@ Spec grammar (``BYTEPS_FAULT_SPEC``, ``;``- or ``,``-separated faults)::
                                    unlike ``straggler`` it has a
                                    bounded window, so recovery and
                                    probation readmission are testable
+    partition:rank=2               SOCKET fault (site=transport, the
+                                   default and only socket site): every
+                                   transport socket operation on rank 2
+                                   blackholes — connects refuse, sends
+                                   vanish, received frames are
+                                   discarded.  The per-send deadline
+                                   surfaces the silence as ``AckLost``
+                                   (never a hang); ``n=K`` bounds the
+                                   partition to K socket ops (a healing
+                                   partition), absent = partitioned
+                                   forever
+    conn_reset:p=0.05:n=3          SOCKET fault: the established
+                                   connection is torn down with a real
+                                   RST (SO_LINGER 0 close) mid
+                                   send/recv with probability p; the
+                                   supervisor reconnects and the sender
+                                   retransmits from its sealed source
+                                   copy (seq-token dedup absorbs a
+                                   retry whose original landed).
+                                   ``n=`` bounds total resets
+    partial_write:p=0.05           SOCKET fault: a send writes only
+                                   half its bytes, then RSTs — the
+                                   receiver's length-prefixed read
+                                   fails mid-frame and the connection
+                                   dies exactly as a real half-written
+                                   socket would
+    slow_socket:ms=20:p=1          SOCKET fault: every matched send
+                                   first sleeps ms — a sustained
+                                   bandwidth/latency throttle on the
+                                   wire, feeding the per-peer RTT
+                                   histogram and the slowness tracker
 
 Fields: ``rank`` (int, default: every rank), ``step`` (int, kill only),
 ``site`` (one of :data:`VALID_SITES`), ``p`` (probability in (0, 1],
@@ -121,15 +152,23 @@ def _reset_lifetime_for_tests() -> None:
 # monkeypatch point for tests (a real os._exit would take pytest with it)
 _exit = os._exit
 
-VALID_KINDS = ("bitflip", "delay", "drop", "kill", "slow", "straggler")
+VALID_KINDS = ("bitflip", "conn_reset", "delay", "drop", "kill",
+               "partial_write", "partition", "slow", "slow_socket",
+               "straggler")
 VALID_SITES = (
     # bpslint: ignore[chaos-site] reason=kill-only predicate matched in on_step (die while hosting the control plane), never a woven fire() site
     "coordinator",
     "dcn", "dispatch", "heartbeat", "kv_push",
-    "serve_pull", "server_pull", "server_push", "sync")
+    "serve_pull", "server_pull", "server_push", "sync", "transport")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
 CORRUPT_SITES = ("kv_push", "serve_pull", "server_push")
+# socket-level kinds (comm/transport.py chaos shim): they act on raw
+# socket operations via socket_fault(), not on fire()/corrupt() hooks,
+# so they are only meaningful at the socket site(s) below — validation
+# pins them there (and defaults them there)
+SOCKET_KINDS = ("conn_reset", "partial_write", "partition", "slow_socket")
+SOCKET_SITES = ("transport",)
 # fields each kind actually reads — anything else is rejected, not
 # silently ignored (kill:p=0.1 must fail loudly, not kill
 # deterministically while the operator believes it is probabilistic)
@@ -140,6 +179,10 @@ _KIND_FIELDS = {
     "slow": ("rank", "site", "ms", "n"),
     "drop": ("rank", "site", "p"),
     "bitflip": ("rank", "site", "p"),
+    "partition": ("rank", "site", "n"),
+    "conn_reset": ("rank", "site", "p", "n"),
+    "partial_write": ("rank", "site", "p", "n"),
+    "slow_socket": ("rank", "site", "p", "ms"),
 }
 # the master field set is DERIVED from the per-kind tables: a field a
 # kind reads but the master list forgot (or vice versa) is structurally
@@ -285,6 +328,22 @@ def parse_spec(spec: str) -> List[FaultRule]:
                 raise _fail(spec, clause,
                             "slow n=N (visit budget) must be > 0")
             site = site or "dispatch"
+        if kind in SOCKET_KINDS:
+            # socket kinds act through the transport's socket shim
+            # (comm/transport.py), not the fire()/corrupt() hooks — a
+            # non-socket site would silently never fire
+            site = site or "transport"
+            if site not in SOCKET_SITES:
+                raise _fail(spec, clause,
+                            f"{kind} is a socket-level fault; site must "
+                            f"be one of {', '.join(SOCKET_SITES)}")
+            if kind == "slow_socket" and ms <= 0:
+                raise _fail(spec, clause,
+                            "slow_socket needs ms=N > 0 (the per-send "
+                            "throttle)")
+            if n is not None and n <= 0:
+                raise _fail(spec, clause,
+                            f"{kind} n=N (fault budget) must be > 0")
         rules.append(FaultRule(kind, site, rank, step, p, ms, code, n))
     if not rules:
         raise ValueError(
@@ -309,11 +368,12 @@ class FaultInjector:
         for i, r in enumerate(self.rules):
             # string seeding: stable across processes (no hash salt)
             r.rng = random.Random(f"{seed}/{i}/{r.kind}/{r.site}")
-            if r.kind == "slow" and r.n is not None:
+            if r.n is not None and r.kind in ("slow",) + SOCKET_KINDS:
                 # resume the lifetime visit budget: a re-armed schedule
                 # (elastic suspend/resume) continues the SAME fault
                 # window instead of restarting it
-                r.skey = f"{seed}/{i}/{r.site}/{r.rank}/{r.ms}/{r.n}"
+                r.skey = f"{seed}/{i}/{r.kind}/{r.site}/{r.rank}/" \
+                         f"{r.ms}/{r.n}"
                 r.left = max(0, r.n - _slow_consumed.get(r.skey, 0))
         self._by_site: Dict[str, List[FaultRule]] = {}
         for r in self.rules:
@@ -406,6 +466,65 @@ class FaultInjector:
                         "%d visits (rank %d)", site, r.n, self.rank)
                 time.sleep(r.ms / 1000.0)
 
+    def _consume_budget(self, r: FaultRule) -> bool:
+        """Spend one unit of a rule's ``n=`` budget (lifetime-accounted,
+        like ``slow`` — an elastic re-arm resumes the window instead of
+        resurrecting an exhausted fault).  True = the fault fires."""
+        with self._lock:
+            if r.left is None:
+                return True
+            if r.left <= 0:
+                return False
+            r.left -= 1
+            if r.skey is not None:
+                _slow_consumed[r.skey] = _slow_consumed.get(r.skey, 0) + 1
+            return True
+
+    def socket_fault(self, site: str, op: str) -> Optional[str]:
+        """Socket-level chaos decision for ONE socket operation at
+        ``site`` (``op``: ``connect`` | ``send`` | ``recv``) — the hook
+        the transport's chaos shim (comm/transport.py) consults before
+        touching a real socket, so partitions/resets are injectable
+        without a cooperating peer.
+
+        Returns the failure the shim must simulate — ``"partition"``
+        (blackhole the operation), ``"conn_reset"`` (tear the
+        connection down with a real RST), ``"partial_write"`` (send a
+        truncated frame, then RST) — or ``None``.  ``slow_socket``
+        sleeps inline on sends and returns None (the operation
+        proceeds, late)."""
+        for r in self._by_site.get(site, ()):
+            if r.kind not in SOCKET_KINDS:
+                continue
+            if r.rank is not None and r.rank != self.rank:
+                continue
+            if r.kind == "slow_socket":
+                if op == "send" and (r.p >= 1.0 or r.rng.random() < r.p):
+                    counters.inc("fault.slow_socket")
+                    time.sleep(r.ms / 1000.0)
+                continue
+            if r.kind == "partition":
+                # unconditional while the budget lasts: a partition is
+                # a state, not a per-op coin flip
+                if self._consume_budget(r):
+                    counters.inc("fault.partition")
+                    return "partition"
+                continue
+            if op == "connect":
+                continue  # resets model an ESTABLISHED connection dying
+            if r.kind == "partial_write" and op != "send":
+                continue
+            if r.p < 1.0 and r.rng.random() >= r.p:
+                continue
+            if not self._consume_budget(r):
+                continue
+            if r.kind == "conn_reset":
+                counters.inc("fault.conn_reset")
+                return "conn_reset"
+            counters.inc("fault.partial_write")
+            return "partial_write"
+        return None
+
     def should_drop(self, site: str) -> bool:
         """True when a drop rule says to suppress this message."""
         for r in self._by_site.get(site, ()):
@@ -484,6 +603,12 @@ def fire(site: str) -> None:
 
 def should_drop(site: str) -> bool:
     return _active is not None and _active.should_drop(site)
+
+
+def socket_fault(site: str, op: str) -> Optional[str]:
+    """Socket-shim delegate (see :meth:`FaultInjector.socket_fault`);
+    None when chaos is disarmed."""
+    return None if _active is None else _active.socket_fault(site, op)
 
 
 def corrupt(site: str, arr):

@@ -537,6 +537,59 @@ class ServerEngine:
             "server engine: round for key %r quarantined — previous merge "
             "version %d republished", key, version)
 
+    # -- transport receive side (comm/transport.py) ------------------------
+    #
+    # The TCP transport verifies the sealed envelope AT THE SOCKET (a
+    # corrupt frame was already NACKed back to the sender), so these
+    # entry points must not run a second wire hop: they gate on the
+    # membership epoch and hand the verified payload straight to the
+    # post-wire half of push() — non-finite screen, shape validation,
+    # round accounting, enqueue — exactly what the loopback path runs
+    # after ITS envelope verification.
+
+    def receive_push(self, key: str, value: np.ndarray, worker_id: int,
+                     num_workers: int,
+                     mepoch: Optional[int] = None) -> bool:
+        """A transport-delivered (already-verified) dense push.  Returns
+        True when the contribution reached a merge queue (False =
+        stale-epoch or quarantine drop — fate final either way, so the
+        transport's dedup floor advances regardless)."""
+        if mepoch is not None and mepoch != self._membership_epoch:
+            counters.inc("membership.stale_pushes_dropped")
+            get_logger().warning(
+                "server engine: dropped transport push(%r) from "
+                "membership epoch %d (current %d)", key, mepoch,
+                self._membership_epoch)
+            return False
+        return self._push_checked(key, np.asarray(value), worker_id,
+                                  num_workers)
+
+    def receive_push_wire(self, key: str, data: bytes, worker_id: int,
+                          num_workers: int,
+                          mepoch: Optional[int] = None) -> bool:
+        """A transport-delivered (already-verified) compressed push:
+        the wire bytes are decoded with the key's registered server
+        codec and merged like any dense contribution.  A stale
+        ``mepoch`` is dropped before the decode runs."""
+        if mepoch is not None and mepoch != self._membership_epoch:
+            counters.inc("membership.stale_pushes_dropped")
+            get_logger().warning(
+                "server engine: dropped transport compressed push(%r) "
+                "from membership epoch %d (current %d)", key, mepoch,
+                self._membership_epoch)
+            return False
+        comp = self._codec(key).comp
+        value = np.asarray(comp.decompress(comp.wire_decode(bytes(data))))
+        return self._push_checked(key, value, worker_id, num_workers)
+
+    def pull_versioned(self, key: str,
+                       timeout: Optional[float] = None) -> tuple:
+        """Public form of the versioned pull — ``(merged array, merge
+        version)`` read atomically — for callers that cache or ship the
+        result keyed by the version that produced it (the transport's
+        ``server_pull`` reply stamps the envelope seq with it)."""
+        return self._pull_versioned(key, timeout)
+
     def pull(self, key: str, timeout: Optional[float] = None,
              retry: Optional[RetryPolicy] = None) -> np.ndarray:
         """Blocks until the current round's merge completes (parked-pull

@@ -331,6 +331,28 @@ class KVStore:
                 f"push for {key!r} applied as version {version} but the "
                 "ack was dropped; retry with the same seq token")
 
+    def _land_delta_locked(self, key: str, delta: np.ndarray,
+                           worker_id: int, seq: Optional[int],
+                           wire_len: Optional[int] = None
+                           ) -> Tuple[int, Optional[int]]:
+        """The landing tail EVERY delta path shares (caller holds
+        ``_lock``; ``delta`` already verified and screened): merge,
+        advance the dedup floor (fate final), account wire bytes on the
+        wire-denominated paths, maybe chaos-drop the ack — ONE copy of
+        the ordering the loopback and transport entry points must agree
+        on.  Returns ``(version, landed)``: ``landed`` is the new
+        version when the merge changed the key (the caller notifies
+        subscribers OUTSIDE the lock), None on a merged-screen skip
+        (wire bytes wasted)."""
+        before = self._versions.get(key, -1)
+        version = self._push_delta_locked(key, delta)
+        self._mark_seen(key, worker_id, seq)
+        landed = version if version != before else None
+        if wire_len is not None:
+            self._account_wire(wire_len, wasted=landed is None)
+        self._maybe_drop_ack(key, version, seq)
+        return version, landed
+
     def _wire_recv(self, key: str, frame: bytes, worker_id: int, seq: int,
                    opener, wasted_nbytes: int):
         """Envelope hop for a sealed frame (caller holds the lock): the
@@ -395,12 +417,8 @@ class KVStore:
                     # never silently no-op)
                     arr = np.asarray(_fault.corrupt("kv_push", arr))
                     _fault.fire("kv_push")
-                before = self._versions.get(key, -1)
-                version = self._push_delta_locked(key, arr)
-                self._mark_seen(key, worker_id, seq)
-                if version != before:
-                    landed = version
-                self._maybe_drop_ack(key, version, seq)
+                version, landed = self._land_delta_locked(
+                    key, arr, worker_id, seq)
                 return version
         finally:
             if tctx is not None:
@@ -500,21 +518,93 @@ class KVStore:
                         self._account_wire(len(data), wasted=True)
                         self._mark_seen(key, worker_id, seq)  # fate final
                         return self._versions.get(key, -1)
-                before = self._versions.get(key, -1)
-                version = self._push_delta_locked(key, delta)
-                self._mark_seen(key, worker_id, seq)
-                if version != before:
-                    self._account_wire(len(data))
-                    landed = version
-                else:  # merged-screen skip: the delta did not land
-                    self._account_wire(len(data), wasted=True)
-                self._maybe_drop_ack(key, version, seq)
+                version, landed = self._land_delta_locked(
+                    key, delta, worker_id, seq, wire_len=len(data))
                 return version
         finally:
             if tctx is not None:
                 _tracing.tracer().record_traced(
                     tctx.trace_id, "kv.push", f"kv/{key}", t_kv0,
                     time.monotonic(), worker=worker_id, compressed=True)
+            if landed is not None:
+                self._notify(key, landed)
+
+    # -- transport receive side (comm/transport.py) -------------------------
+    #
+    # The TCP transport verifies the sealed envelope AT THE SOCKET and
+    # NACKs corruption back to the sender, so these entry points skip
+    # the store's own envelope hop (re-sealing a verified payload would
+    # CRC bytes against themselves AND double-fire any armed chaos
+    # site) while keeping every other semantic: stale-epoch drop,
+    # seq-token dedup, non-finite screen, the chaos ack-drop, and the
+    # write-subscriber notification.
+
+    def apply_delta(self, key: str, delta, *,
+                    mepoch: Optional[int] = None, worker_id: int = 0,
+                    seq: Optional[int] = None) -> int:
+        """Sum a transport-delivered (already-verified) raw delta.
+        Raises :class:`integrity.AckLost` AFTER the sum applied when
+        chaos drops the ack (``drop:site=kv_push``) — the transport
+        server suppresses its reply and the sender's same-token retry
+        is dedup-absorbed."""
+        landed: Optional[int] = None
+        try:
+            with self._lock:
+                if self._stale(key, mepoch):
+                    return self._versions.get(key, -1)
+                if self._dup(key, worker_id, seq):
+                    version = self._versions.get(key, -1)
+                    self._maybe_drop_ack(key, version, seq)
+                    return version
+                arr = np.asarray(delta)
+                if _integrity.enabled():
+                    arr = _integrity.screen_nonfinite(
+                        arr, what="delta", key=key, worker=worker_id)
+                    if arr is None:  # skip policy: fate final
+                        self._mark_seen(key, worker_id, seq)
+                        return self._versions.get(key, -1)
+                version, landed = self._land_delta_locked(
+                    key, arr, worker_id, seq)
+                return version
+        finally:
+            if landed is not None:
+                self._notify(key, landed)
+
+    def apply_delta_wire(self, key: str, data: bytes, *,
+                         mepoch: Optional[int] = None, worker_id: int = 0,
+                         seq: Optional[int] = None) -> int:
+        """Sum a transport-delivered (already-verified) wire-encoded
+        delta; the key's registered codec decodes it.  Wire accounting
+        matches :meth:`push_delta_wire`: landed bytes in
+        :attr:`wire_bytes`, duplicates and screened-out deltas in
+        :attr:`wire_bytes_wasted`."""
+        landed: Optional[int] = None
+        try:
+            with self._lock:
+                if self._stale(key, mepoch):
+                    return self._versions.get(key, -1)
+                codec = self._codecs.get(key)
+                if codec is None:
+                    raise KeyError(
+                        f"key {key!r} has no registered compression")
+                if self._dup(key, worker_id, seq):
+                    self._account_wire(len(data), wasted=True)
+                    version = self._versions.get(key, -1)
+                    self._maybe_drop_ack(key, version, seq)
+                    return version
+                delta = np.asarray(codec[1].decompress(
+                    codec[1].wire_decode(bytes(data))))
+                if _integrity.enabled():
+                    delta = _integrity.screen_nonfinite(
+                        delta, what="delta", key=key, worker=worker_id)
+                    if delta is None:  # skip policy: dropped, wasted
+                        self._account_wire(len(data), wasted=True)
+                        self._mark_seen(key, worker_id, seq)
+                        return self._versions.get(key, -1)
+                version, landed = self._land_delta_locked(
+                    key, delta, worker_id, seq, wire_len=len(data))
+                return version
+        finally:
             if landed is not None:
                 self._notify(key, landed)
 

@@ -108,6 +108,13 @@ def _fresh_telemetry():
     from byteps_tpu.common.telemetry import attribution as _attribution
     from byteps_tpu.utils import slowness as _slowness
     _obs.stop_server()
+    # transport servers registered via comm.transport.serve() hold accept
+    # threads and sockets; close any a test left behind (imported lazily:
+    # most tests never touch the transport)
+    import sys as _sys
+    _transport = _sys.modules.get("byteps_tpu.comm.transport")
+    if _transport is not None:
+        _transport._reset_for_tests()
     _metrics.registry.reset()
     _metrics._reset_components_for_tests()
     _flight._reset_for_tests()

@@ -92,10 +92,17 @@ _BASE_CLAUSE = {
     "slow": "slow:ms=5",
     "drop": "drop:site=heartbeat",
     "bitflip": "bitflip:site=server_push",
+    "partition": "partition",
+    "conn_reset": "conn_reset",
+    "partial_write": "partial_write",
+    "slow_socket": "slow_socket:ms=5",
 }
 # a value valid for each field (site chosen per kind: kill only accepts
-# the coordinator predicate, bitflip only corrupt-woven sites)
-_SITE_FOR = {"kill": "coordinator", "bitflip": "server_push"}
+# the coordinator predicate, bitflip only corrupt-woven sites, socket
+# kinds only the socket shim's transport site)
+_SITE_FOR = {"kill": "coordinator", "bitflip": "server_push",
+             "partition": "transport", "conn_reset": "transport",
+             "partial_write": "transport", "slow_socket": "transport"}
 
 
 def _field_value(kind, field):

@@ -7,6 +7,15 @@ equivalent is two real JAX processes rendezvousing through
 ``jax.distributed.initialize`` (wired from the same DMLC_* env names) and
 reducing over a (dcn=2, ici=2) global mesh whose shards are mutually
 non-addressable — the configuration single-process tests cannot reach.
+
+CPU-backend capability: XLA's CPU backend does not implement
+cross-process collectives ("Multiprocess computations aren't implemented
+on the CPU backend").  The probe IS the attempt — when both workers die
+on exactly that error, the test SKIPS with the backend limitation named
+instead of standing red forever; any other failure still fails.  The
+transport-backed sibling below exercises the same 2-process world over
+REAL sockets (comm/transport.py), so the scenario is no longer untested
+on hosts without cross-process XLA.
 """
 
 from __future__ import annotations
@@ -15,11 +24,17 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 from .conftest import free_port as _free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the CPU backend's cross-process collective gap, verbatim (jax raises
+# it from the first multi-process psum); matching on it is the
+# capability probe
+_CPU_BACKEND_GAP = "Multiprocess computations aren't implemented"
 
 
 @pytest.mark.slow
@@ -64,6 +79,73 @@ def test_two_process_push_pull_matches_single_process():
         pytest.fail("2-process workers timed out (rendezvous or collective "
                     "deadlock); partial output: " +
                     "".join(o[-1500:] for o in outs))
+    if (all(p.returncode != 0 for p in procs)
+            and any(_CPU_BACKEND_GAP in o for o in outs)):
+        # capability-probed skip: the attempt itself established that
+        # THIS host's XLA CPU backend cannot run cross-process
+        # collectives — the loud reason names the limitation so the
+        # skip can never silently mask a real regression elsewhere
+        pytest.skip(
+            "XLA CPU backend capability gap: cross-process collectives "
+            f"are unimplemented on this host ({_CPU_BACKEND_GAP!r}); "
+            "the same 2-process world runs over real sockets in "
+            "test_two_process_world_over_tcp_transport — on a TPU/GPU "
+            "backend this test runs in full")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
         assert f"MP_OK {pid}" in out, f"worker {pid} output:\n{out[-4000:]}"
+
+
+def test_two_process_world_over_tcp_transport():
+    """The transport-backed sibling: the SAME 2-process world, its
+    cross-process reduction riding the supervised TCP transport's
+    sealed envelopes instead of XLA collectives — one server process
+    merging both workers' pushes, both pulling the identical merged
+    round — so the 2-process scenario is exercised on every host,
+    whatever its XLA backend implements (ISSUE satellite: zero standing
+    reds outside tier-1)."""
+    port = _free_port()
+    worker = os.path.join(REPO, "tests", "transport_worker.py")
+    steps, nworkers = 6, 2
+    procs = {}
+    for rank in range(nworkers + 1):   # rank 0 = the server process
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BYTEPS_TW_MODE"] = "bitflip"   # the worker body; no fault
+        env["BYTEPS_TW_RANK"] = str(rank)
+        env["BYTEPS_TW_PORT"] = str(port)
+        env["BYTEPS_TW_STEPS"] = str(steps)
+        env["BYTEPS_TW_NWORKERS"] = str(nworkers)
+        env["BYTEPS_LOG_LEVEL"] = "ERROR"
+        env.pop("BYTEPS_FAULT_SPEC", None)
+        procs[rank] = subprocess.Popen([sys.executable, worker], env=env,
+                                       cwd=REPO, stdout=subprocess.PIPE,
+                                       stderr=subprocess.STDOUT, text=True)
+    outs = {}
+    try:
+        for rank, p in procs.items():
+            outs[rank], _ = p.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        for p in procs.values():
+            p.kill()
+        pytest.fail("transport 2-process workers hung; partial output: "
+                    + "".join(o[-1500:] for o in outs.values()))
+    for rank, p in procs.items():
+        assert p.returncode == 0, f"rank {rank}:\n{outs[rank][-4000:]}"
+    digests = {}
+    for rank in (1, 2):
+        for line in outs[rank].splitlines():
+            if line.startswith("DIGEST "):
+                digests[rank] = line.split()[2]
+    assert len(set(digests.values())) == 1, digests
+    # bit-identical to the single-process replay of the same seeds
+    import hashlib
+
+    from tests.transport_worker import LR, N, _grad
+    params = np.zeros(N, np.float32)
+    for step in range(steps):
+        merged = np.sum([_grad(step, w) for w in range(nworkers)],
+                        axis=0, dtype=np.float32)
+        params -= LR * merged
+    assert digests[1] == hashlib.sha256(params.tobytes()).hexdigest()

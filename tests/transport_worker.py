@@ -1,0 +1,276 @@
+"""Worker body for the TCP-transport chaos tests (test_transport_chaos.py).
+
+Four real processes per run (one transport server + three pushers, or a
+full elastic world), all cross-process bytes on the NEW supervised TCP
+transport (comm/transport.py) — no in-process loopback anywhere on the
+data plane.  Modes, selected by ``BYTEPS_TW_MODE``:
+
+- **bitflip**: rank 0 hosts a ``ServerEngine`` behind a
+  ``TransportServer``; ranks 1..N push integer-valued gradients (exact
+  in float32 under ANY arrival order — TCP does not serialize workers
+  the way the loopback harness did) to a per-step key and pull the
+  merged round back over the same wire.  With
+  ``bitflip:site=server_push`` armed in the WORKERS, every corrupted
+  frame must be NACKed by the server and retransmitted from the sealed
+  source copy, so the final parameters are BIT-IDENTICAL to the
+  fault-free replay — the test's headline assertion.  Workers print
+  ``DIGEST``; the server prints ``REJECTS``/``RETRANS``.
+
+- **kvreset**: rank 0 hosts a ``KVStore``; ranks 1..3 push STEPS
+  seq-tokened unit deltas each, retrying on ``AckLost``.  Rank 2 runs
+  under ``conn_reset:site=transport`` chaos: its connection is RST mid
+  send/recv, the supervisor reconnects, and the retransmit carries the
+  SAME token — the server's dedup absorbs retries whose original
+  landed.  Every worker also sends one deliberate duplicate of its
+  first (provably landed) token, so the dedup counter is nonzero
+  deterministically.  The server polls the store to EXACTLY 3*STEPS
+  (one over would be a double-sum, one under a lost push) and prints
+  ``SUM``/``DUP``; rank 2 prints ``RESETS``/``RECONNECTS``.
+
+- **partition**: a 4-rank elastic world (membership bus + heartbeats,
+  fault/membership.py) whose data plane pushes seq-tokened deltas to
+  rank 0's store over the transport (rank 0 itself rides the
+  ``LoopbackEndpoint`` same-process fast path behind the same
+  ``Endpoint`` interface).  ``partition:rank=2:site=transport``
+  blackholes rank 2's sockets: its pushes surface as ``AckLost`` at
+  the send deadline (never a hang), and after a short streak the rank
+  converts the evidence into a detected data-path failure — prints
+  ``PARTITIONED <deadline trips>`` and exits with the restartable
+  failure code.  The survivors' heartbeat detector turns that into an
+  ordinary shrink-to-survivors; they finish every step at the shrunk
+  world and print ``FINAL`` states the test replays exactly.  The
+  store ends at EXACTLY 3*STEPS (survivor retries across the world
+  change are dedup-absorbed; the partitioned rank lands nothing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = 257
+LR = np.float32(0.05)
+
+
+def _grad(step: int, wid: int) -> np.ndarray:
+    # integer-valued floats: sums of a few of these are EXACT in f32,
+    # so the merged value is order-independent — bit-identical finals
+    # need no arrival-order choreography over a real wire
+    return np.random.RandomState(7919 * step + wid) \
+        .randint(-1024, 1025, N).astype(np.float32)
+
+
+def _elastic_grad(rank: int) -> np.ndarray:
+    return np.full(4, float((rank + 1) ** 2), np.float32)
+
+
+def main() -> int:
+    mode = os.environ["BYTEPS_TW_MODE"]
+    rank = int(os.environ["BYTEPS_TW_RANK"])
+    port = int(os.environ["BYTEPS_TW_PORT"])
+    steps = int(os.environ.get("BYTEPS_TW_STEPS", "20"))
+    nworkers = int(os.environ.get("BYTEPS_TW_NWORKERS", "3"))
+
+    from byteps_tpu.common import integrity
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.comm import transport as tp
+    from byteps_tpu.fault import injector as inj
+
+    spec = os.environ.get("BYTEPS_FAULT_SPEC", "")
+    if spec:
+        inj.arm(spec, seed=int(os.environ.get("BYTEPS_FAULT_SEED",
+                                              str(rank))), rank=rank)
+
+    if mode == "bitflip":
+        return _run_bitflip(tp, rank, port, steps, nworkers, counters)
+    if mode == "kvreset":
+        return _run_kvreset(tp, integrity, rank, port, steps, nworkers,
+                            counters)
+    if mode == "partition":
+        return _run_partition(tp, integrity, rank, port, steps, counters)
+    raise SystemExit(f"unknown BYTEPS_TW_MODE {mode!r}")
+
+
+def _run_bitflip(tp, rank, port, steps, nworkers, counters) -> int:
+    if rank == 0:
+        from byteps_tpu.server.engine import ServerEngine
+        eng = ServerEngine(num_threads=1)
+        srv = tp.TransportServer(host="127.0.0.1", port=port, rank=0,
+                                 engine=eng)
+        print("SRV-UP", flush=True)
+        try:
+            # the workers push a sentinel round AFTER their last pull:
+            # its completion is the "everyone is done" barrier
+            eng.pull("done", timeout=180)
+        finally:
+            print("REJECTS", counters.get("integrity.crc_reject"),
+                  flush=True)
+            print("RETRANS", counters.get("integrity.retransmit"),
+                  flush=True)
+            time.sleep(0.5)   # let the last ACKs/pulls drain
+            srv.close()
+            eng.shutdown()
+        return 0
+    wid = rank - 1
+    ep = tp.TcpEndpoint(("127.0.0.1", port), peer=0, rank=rank)
+    params = np.zeros(N, np.float32)
+    for step in range(steps):
+        # per-step key: the merge round for key g<step> completes
+        # exactly once, so every worker's parked pull answers with THAT
+        # round — no cross-step read races over the async wire
+        key = f"g{step}"
+        ep.push(key, _grad(step, wid), wid, nworkers)
+        merged = ep.pull(key, timeout=60)
+        params -= LR * merged
+    print("RETRANS", rank, counters.get("integrity.retransmit"),
+          flush=True)
+    print("DIGEST", rank, hashlib.sha256(params.tobytes()).hexdigest(),
+          flush=True)
+    ep.push("done", np.zeros(1, np.float32), wid, nworkers)
+    ep.close()
+    return 0
+
+
+def _run_kvreset(tp, integrity, rank, port, steps, nworkers,
+                 counters) -> int:
+    if rank == 0:
+        from byteps_tpu.server.kv_store import KVStore
+        kv = KVStore()
+        kv.init_key("acc", np.zeros(1, np.float32))
+        srv = tp.TransportServer(host="127.0.0.1", port=port, rank=0,
+                                 kv=kv)
+        print("SRV-UP", flush=True)
+        want = float(steps * nworkers)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if float(kv.pull("acc")[0]) >= want:
+                break
+            time.sleep(0.1)
+        time.sleep(1.0)  # a straggling duplicate would land here
+        print("SUM", repr(float(kv.pull("acc")[0])), flush=True)
+        print("DUP", counters.get("integrity.dup_dropped"), flush=True)
+        srv.close()
+        return 0
+    ep = tp.TcpEndpoint(("127.0.0.1", port), peer=0, rank=rank,
+                        send_deadline_s=5.0)
+
+    def push_tok(seq):
+        while True:
+            try:
+                ep.push_delta("acc", np.ones(1, np.float32),
+                              worker_id=rank, seq=seq)
+                return
+            except integrity.AckLost:
+                continue  # same token: the dedup absorbs the retry
+
+    for step in range(steps):
+        push_tok(step + 1)
+        if step == 0:
+            # one DELIBERATE duplicate of the just-landed token: the
+            # random resets may all fire before the server processed a
+            # frame (every retransmit is then a FIRST landing and the
+            # dup counter honestly stays 0) — this duplicate's original
+            # provably landed, so the dedup MUST absorb it: DUP >= 1 is
+            # deterministic, and a broken dedup still shows up as SUM
+            # overshooting 3*STEPS
+            push_tok(1)
+    print("RESETS", rank, counters.get("transport.conn_resets"),
+          flush=True)
+    print("RECONNECTS", rank, ep.connection.reconnects, flush=True)
+    ep.close()
+    return 0
+
+
+def _run_partition(tp, integrity, rank, port, steps, counters) -> int:
+    world = [int(r) for r in os.environ["BYTEPS_TW_WORLD"].split(",")]
+    bus = os.environ["BYTEPS_TW_BUS"]
+    hb_port = os.environ.get("BYTEPS_TW_HB_PORT", "")
+    fail_code = int(os.environ.get("BYTEPS_FAILURE_EXIT_CODE", "17"))
+
+    from byteps_tpu.fault.membership import (ElasticMembership,
+                                             MembershipTimeout,
+                                             WorldChanged)
+    from byteps_tpu.utils.failure_detector import install_failure_action
+
+    kv = None
+    if rank == 0:
+        from byteps_tpu.server.kv_store import KVStore
+        kv = KVStore()
+        kv.init_key("acc", np.zeros(1, np.float32))
+        tp.serve(rank=0, host="127.0.0.1", port=port, kv=kv)
+    # ONE Endpoint interface: rank 0 takes the same-process loopback
+    # fast path, everyone else the supervised TCP connection
+    if rank == 0:
+        ep = tp.LoopbackEndpoint(kv=kv)
+    else:
+        ep = tp.TcpEndpoint(("127.0.0.1", port), peer=0, rank=rank,
+                            send_deadline_s=1.5, keepalive_s=0.0)
+    m = ElasticMembership(rank, world, bus).start()
+    install_failure_action(m.on_failure)
+    if hb_port:
+        m.host_heartbeat(interval=0.08, timeout=0.7, grace=60.0,
+                         addr="127.0.0.1:" + hb_port,
+                         on_failure=m.on_failure)
+    print("START", rank, flush=True)
+
+    w = np.zeros(4, np.float32)
+    step = 1
+    acklost_streak = 0
+    retries = 0
+    while step <= steps:
+        if retries > 200:
+            print("RETRY-BUDGET-EXHAUSTED at", step, flush=True)
+            return 6
+        try:
+            ep.push_delta("acc", np.ones(1, np.float32), worker_id=rank,
+                          seq=step)
+            acklost_streak = 0
+        except integrity.AckLost:
+            # the partition evidence: per-send deadlines, never a hang.
+            # A short streak converts "my data path is dead" into a
+            # DETECTED failure — exit restartable; the survivors'
+            # heartbeat loss turns it into an ordinary shrink.
+            acklost_streak += 1
+            if acklost_streak >= 2:
+                print("PARTITIONED",
+                      counters.get("transport.send_deadline_trips"),
+                      flush=True)
+                m.stop()
+                return fail_code
+            continue
+        try:
+            _, payloads = m.step_sync(step, payload=_elastic_grad(rank))
+        except WorldChanged as e:
+            print("WORLD", e.view.epoch,
+                  ",".join(map(str, e.view.world)), "at", step, flush=True)
+            continue  # re-push is same-token: dedup absorbs it
+        except MembershipTimeout:
+            retries += 1
+            continue
+        retries = 0
+        grads = [np.asarray(p) for p in payloads.values()]
+        w = w - LR * (np.sum(grads, axis=0, dtype=np.float32)
+                      / np.float32(len(grads)))
+        step += 1
+        time.sleep(0.03)
+
+    view = m.view()
+    if rank == 0:
+        time.sleep(1.0)  # let the other survivors' last deltas land
+        print("SUM", repr(float(kv.pull("acc")[0])), flush=True)
+    print("FINAL", view.epoch, ",".join(map(str, view.world)),
+          repr(float(w[0])), flush=True)
+    install_failure_action(None)
+    m.stop()
+    ep.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

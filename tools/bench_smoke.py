@@ -283,6 +283,121 @@ def _trace_ok(trc: dict, floor: dict, tol: float) -> bool:
             and trc["events_buffered"] > 0)
 
 
+def _measure_transport(nbytes=256 * 1024, reps=30):
+    """Transport lane (comm/transport.py, docs/transport.md): the
+    loopback-vs-TCP throughput ratio for seq-tokened KV deltas
+    (interleaved per-rep pairs — the bench_smoke host-regime pairing
+    trick), and the p99 push latency to a LIVE shard while a second
+    shard's peer is partitioned (a dead endpoint in the shard set must
+    cost the live path nothing: its supervisor retries in the
+    background, it never blocks another connection's sends).
+
+    Gated (floor file): ``transport_tcp_ratio_floor`` bounds how much
+    the real wire may cost versus the in-process fast path for this
+    payload size, and ``transport_partitioned_p99_ms`` is an absolute
+    ceiling on the live-shard p99 under one partitioned peer — the
+    isolation contract, checkable on any host because the partition is
+    injected, not environmental."""
+    import math
+    import socket as _socket
+    import threading as _threading
+
+    import numpy as np
+
+    from byteps_tpu.common import integrity as _bint
+    from byteps_tpu.comm import transport as btp
+    from byteps_tpu.server.kv_store import KVStore
+
+    n = nbytes // 4
+    kv_lb, kv_tcp = KVStore(), KVStore()
+    for kv in (kv_lb, kv_tcp):
+        kv.init_key("bench", np.zeros(n, np.float32))
+    srv = btp.TransportServer(rank=0, kv=kv_tcp)
+    lb = btp.LoopbackEndpoint(kv=kv_lb)
+    ep = btp.TcpEndpoint(srv.addr, peer=0)
+    delta = np.random.RandomState(0).randn(n).astype(np.float32)
+    lb.push_delta("bench", delta, seq=1)
+    ep.push_delta("bench", delta, seq=1)          # warm (conn, buffers)
+    lb_t, tcp_t, ratios = [], [], []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        lb.push_delta("bench", delta, seq=i + 2)
+        tl = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ep.push_delta("bench", delta, seq=i + 2)
+        tt = time.perf_counter() - t0
+        lb_t.append(tl)
+        tcp_t.append(tt)
+        ratios.append(tl / tt)    # tcp/loopback throughput ratio
+
+    def med(xs):
+        m, _, _ = quantile_stats_raw(xs)
+        return m
+
+    # one partitioned peer in a 2-shard world: a dead endpoint whose
+    # supervisor dials a black hole forever, beside the live one
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    dead = btp.TcpEndpoint(("127.0.0.1", dead_port), peer=1,
+                           send_deadline_s=0.2, keepalive_s=0.0)
+    client = btp.ShardedClient([ep, dead])
+    live_key = next(k for k in (f"k{j}" for j in range(64))
+                    if client.assigner.write_target(k) == 0)
+    dead_key = next(k for k in (f"k{j}" for j in range(64))
+                    if client.assigner.write_target(k) == 1)
+    kv_tcp.init_key(live_key, np.zeros(256, np.float32))
+    stop = _threading.Event()
+
+    def hammer():
+        seq = 1
+        while not stop.is_set():
+            try:
+                client.push_delta(dead_key, np.zeros(256, np.float32),
+                                  seq=seq)
+            except (_bint.AckLost, btp.TransportError):
+                pass
+            seq += 1
+
+    t = _threading.Thread(target=hammer, daemon=True)
+    t.start()
+    lats = []
+    small = np.ones(256, np.float32)
+    for i in range(150):
+        t0 = time.perf_counter()
+        client.push_delta(live_key, small, seq=i + 1)
+        lats.append(time.perf_counter() - t0)
+    stop.set()
+    t.join(timeout=5)
+    lats.sort()
+    p99 = lats[min(len(lats) - 1, math.ceil(0.99 * len(lats)) - 1)]
+    dead.close(drain=False)
+    ep.close()
+    srv.close()
+    from byteps_tpu.common.telemetry import counters as _counters
+    return {"nbytes": nbytes,
+            "loopback_gbps": round(nbytes / med(lb_t) / 1e9, 3),
+            "tcp_gbps": round(nbytes / med(tcp_t) / 1e9, 3),
+            "tcp_vs_loopback_ratio": round(med(ratios), 3),
+            "partitioned_peer_p99_ms": round(p99 * 1e3, 3),
+            "deadline_trips": _counters.get("transport.send_deadline_trips"),
+            "reconnect_attempts": dead.connection.dial_attempts}
+
+
+def _transport_ok(trp: dict, floor: dict, tol: float) -> bool:
+    """The transport gate (pure; pinned by a unit test): the TCP/loopback
+    ratio is a host measurement and takes the lane tolerance; the
+    partitioned-peer p99 is an absolute isolation ceiling (the fault is
+    injected, so the bound holds on any host)."""
+    gate_ratio = floor.get("transport_tcp_ratio_floor", 0.0) * (1.0 - tol)
+    gate_p99 = floor.get("transport_partitioned_p99_ms", 50.0)
+    trp["gate_ratio"] = round(gate_ratio, 4)
+    trp["gate_p99_ms"] = gate_p99
+    return (trp["tcp_vs_loopback_ratio"] >= gate_ratio
+            and trp["partitioned_peer_p99_ms"] <= gate_p99)
+
+
 def _measure_serve():
     """Serving lane (ISSUE 9): pulls/sec + p99 pull latency under
     concurrent training pushes, recorded beside the push figures so the
@@ -370,6 +485,7 @@ def main() -> int:
     out["straggler"] = _measure_straggler()
     out["compressed"] = _measure_compressed()
     out["trace"] = _measure_trace()
+    out["transport"] = _measure_transport()
     if "--update-floor" in sys.argv:
         # compressed throughput floor: half the measured worst lane —
         # room for host noise, still catches a machinery collapse
@@ -383,6 +499,13 @@ def main() -> int:
                  "compressed_quality_ceiling": 0.55,
                  "compressed_throughput_floor": round(worst_tput / 2, 3),
                  "trace_sample_overhead_floor": 0.7,
+                 # transport: half the measured TCP/loopback ratio
+                 # (host-noise room, still catches a wire-machinery
+                 # collapse); the p99 ceiling is an absolute isolation
+                 # contract, not a measurement
+                 "transport_tcp_ratio_floor": round(
+                     out["transport"]["tcp_vs_loopback_ratio"] / 2, 3),
+                 "transport_partitioned_p99_ms": 50.0,
                  "note": "measured floor; the lane fails below "
                          "ratio * (1 - tolerance)"}
         with open(FLOOR_PATH, "w") as f:
@@ -414,7 +537,10 @@ def main() -> int:
     compressed_ok = _compressed_ok(out["compressed"], floor, tol)
     trace_ok = _trace_ok(out["trace"], floor, tol)
     out["trace"]["ok"] = trace_ok
-    out["ok"] = engine_ok and straggler_ok and compressed_ok and trace_ok
+    transport_ok = _transport_ok(out["transport"], floor, tol)
+    out["transport"]["ok"] = transport_ok
+    out["ok"] = (engine_ok and straggler_ok and compressed_ok and trace_ok
+                 and transport_ok)
     print(json.dumps(out))
     if not engine_ok:
         print(f"bench-smoke FAIL: engine_vs_fused_ratio "
@@ -448,6 +574,14 @@ def main() -> int:
               f"nothing: {trc['events_buffered']} events) — always-on "
               f"sampling is no longer cheap enough to leave armed",
               file=sys.stderr)
+    if not transport_ok:
+        trp = out["transport"]
+        print(f"bench-smoke FAIL: transport lane violates the floor — "
+              f"tcp_vs_loopback_ratio {trp['tcp_vs_loopback_ratio']} < "
+              f"gate {trp['gate_ratio']} OR partitioned-peer p99 "
+              f"{trp['partitioned_peer_p99_ms']}ms > ceiling "
+              f"{trp['gate_p99_ms']}ms (a dead shard peer must never "
+              f"tax the live path)", file=sys.stderr)
     return 0 if out["ok"] else 1
 
 

@@ -31,8 +31,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "ATTRIB",
-            "RETX", "PULLS", "CODEC", "SLOW", "STATE", "EPOCH", "STEP",
-            "AGE")
+            "RETX", "PULLS", "CONN", "CODEC", "SLOW", "STATE", "EPOCH",
+            "STEP", "AGE")
+
+
+def _conn_cell(gauges: dict) -> str:
+    """The rank's transport-connection health as ``ready/total`` from
+    the ``transport.connections*`` gauges (comm/transport.py).  '-' =
+    the rank runs no TCP transport (loopback-only world); a ready count
+    below the total is the operator's cue that a peer is partitioned or
+    mid-reconnect."""
+    total = gauges.get("transport.connections")
+    if not total:
+        return "-"
+    ready = int(gauges.get("transport.connections_ready") or 0)
+    return f"{ready}/{int(total)}"
 
 
 def _attrib_cell(step: dict) -> str:
@@ -110,6 +123,8 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
         # serving plane (server/serving.py): cumulative pulls served by
         # this rank — 0 everywhere means the rank runs no read plane
         fmt(counters.get("serve.pulls", 0)),
+        # transport (comm/transport.py): ready/total peer connections
+        _conn_cell(gauges),
         # compression (ISSUE 11): which codec(s) this rank's pushes ride
         _codec_cell(gauges),
         # gray-failure columns: the coordinator's phi suspicion of this

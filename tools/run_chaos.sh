@@ -13,7 +13,15 @@
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
-#               | straggler | compressed | trace | lint | all
+#               | straggler | compressed | trace | transport | lint | all
+#         transport: socket-fault chaos on the TCP data plane
+#              (comm/transport.py, docs/transport.md) — 4-process
+#              bitflip-over-real-sockets convergence, conn_reset
+#              absorbed by reconnect + seq-token dedup (zero double
+#              sums), a partitioned rank escalating to
+#              shrink-and-continue, the 32-endpoint supervisor soak,
+#              and the in-process socket-fault pins
+#              (tests/test_transport.py, tests/test_transport_chaos.py)
 #         lint: the project-invariant analyzer (tools/bpslint,
 #              docs/dev_invariants.md) over the tree — env-knob /
 #              metric-name / chaos-site / lock-discipline drift, exit
@@ -85,6 +93,7 @@ case "${1:-}" in
                KEXPR="straggler or demote or hedge or stall"
                shift ;;
     compressed) MARK="chaos or integrity"; KEXPR="compress"; shift ;;
+    transport) MARK="chaos or integrity"; KEXPR="transport"; shift ;;
     trace)     MARK="chaos"; KEXPR="trace or attrib"; shift ;;
     all)       MARK="chaos or integrity"; shift ;;
     lint)
