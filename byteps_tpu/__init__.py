@@ -41,10 +41,12 @@ from byteps_tpu.core.api import (  # noqa: F401
     metrics_snapshot,
     cluster_metrics,
     start_serving,
+    start_serving_tier,
 )
 from byteps_tpu.server import (  # noqa: F401
     KVStore,
     PullClient,
     ServingPlane,
+    ServingTier,
     SnapshotStore,
 )

@@ -656,11 +656,15 @@ class TransportServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  rank: int = 0, engine=None, kv=None, serving=None,
+                 tier=None,
                  state_provider: Optional[Callable[[], bytes]] = None):
         self.rank = int(rank)
         self.engine = engine
         self.kv = kv
         self.serving = serving
+        # serving-tier receiver (server/serving_tier.py ServingHostCore):
+        # the serve_cut / serve_commit / serve_ctl hops land here
+        self.tier = tier
         self.state_provider = state_provider
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -684,7 +688,7 @@ class TransportServer:
     def addr(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
-    def attach(self, *, engine=None, kv=None, serving=None,
+    def attach(self, *, engine=None, kv=None, serving=None, tier=None,
                state_provider=None) -> "TransportServer":
         """Attach/replace local receivers (idempotent; None leaves the
         existing attachment)."""
@@ -694,6 +698,8 @@ class TransportServer:
             self.kv = kv
         if serving is not None:
             self.serving = serving
+        if tier is not None:
+            self.tier = tier
         if state_provider is not None:
             self.state_provider = state_provider
         return self
@@ -710,6 +716,7 @@ class TransportServer:
                         "engine": self.engine is not None,
                         "kv": self.kv is not None,
                         "serving": self.serving is not None,
+                        "tier": self.tier is not None,
                         "state": self.state_provider is not None}}
 
     # -- accept / dispatch --------------------------------------------------
@@ -897,8 +904,14 @@ class TransportServer:
         if op == OP_SERVE_PULL:
             if self.serving is None:
                 raise TransportRemoteError("no serving endpoint attached")
-            reply = self.serving.pull(since_id=meta.get("since_id"),
-                                      keys=meta.get("keys"))
+            kw = {"since_id": meta.get("since_id"),
+                  "keys": meta.get("keys")}
+            if getattr(self.serving, "supports_shed", False):
+                # admission-controlled endpoints (serving_tier.py) also
+                # receive the client's staleness bound — shedding is
+                # legal only while it keeps the client inside that bound
+                kw["max_stale_s"] = meta.get("max_stale_s")
+            reply = self.serving.pull(**kw)
             return _pack_frame(OP_REPLY, req_id, *_seal_serve_reply(reply))
         if op == OP_STATE:
             if self.state_provider is None:
@@ -912,7 +925,8 @@ class TransportServer:
                        payload: bytes) -> bytes:
         hop = meta.get("hop", "server_push")
         try:
-            if hop in ("server_push", "kv"):
+            if hop in ("server_push", "kv") or (
+                    hop == "serve_cut" and meta.get("codec") is None):
                 arr, env = _integrity.open_array(payload)
             else:
                 data, env = _integrity.open_bytes(payload)
@@ -970,6 +984,24 @@ class TransportServer:
                                                worker_id=env.worker,
                                                seq=env.seq)
             return _pack_frame(OP_ACK, req_id, {"version": version})
+        # serving-tier publication hops (server/serving_tier.py): the
+        # CRC above already verified the frame; staging is idempotent
+        # (same key+version re-stages identical bytes) and commit dedups
+        # by snapshot id, so transport retransmits need no claim floors
+        if hop == "serve_cut":
+            if self.tier is None:
+                raise TransportRemoteError("no serving tier attached")
+            self.tier.receive_key(
+                env.key, arr if meta.get("codec") is None else data, meta)
+            return _pack_frame(OP_ACK, req_id, {})
+        if hop == "serve_commit":
+            if self.tier is None:
+                raise TransportRemoteError("no serving tier attached")
+            return _pack_frame(OP_ACK, req_id, self.tier.commit(meta))
+        if hop == "serve_ctl":
+            if self.tier is None:
+                raise TransportRemoteError("no serving tier attached")
+            return _pack_frame(OP_ACK, req_id, self.tier.control(meta))
         raise TransportRemoteError(f"unknown push hop {hop!r}")
 
     def close(self) -> None:
@@ -1021,7 +1053,8 @@ def _seal_serve_reply(reply) -> Tuple[dict, bytes]:
             kind = "a"
         items[k] = (kind, frame, it.version, it.wire_nbytes, it.codec)
     meta = {"snapshot_id": reply.snapshot_id, "full": reply.full,
-            "server_id": reply.server_id, "wire_bytes": reply.wire_bytes}
+            "server_id": reply.server_id, "wire_bytes": reply.wire_bytes,
+            "shed": getattr(reply, "shed", False)}
     return meta, pickle.dumps(items, protocol=pickle.HIGHEST_PROTOCOL)
 
 
@@ -1040,7 +1073,8 @@ def _open_serve_reply(meta: dict, payload: bytes):
         items[k] = ServeItem(value, version, wire_nbytes, codec)
     return ServeReply(snapshot_id=meta["snapshot_id"], full=meta["full"],
                       items=items, wire_bytes=meta["wire_bytes"],
-                      server_id=meta["server_id"])
+                      server_id=meta["server_id"],
+                      shed=bool(meta.get("shed", False)))
 
 
 # -- endpoints --------------------------------------------------------------
@@ -1081,7 +1115,23 @@ class Endpoint:
         raise NotImplementedError
 
     def serve_pull(self, since_id: Optional[int] = None,
-                   keys: Optional[List[str]] = None):
+                   keys: Optional[List[str]] = None,
+                   max_stale_s: Optional[float] = None,
+                   deadline_s: Optional[float] = None):
+        raise NotImplementedError
+
+    def serve_cut(self, key: str, payload, *, snapshot_id: int,
+                  version: int, codec=None,
+                  deadline_s: Optional[float] = None) -> None:
+        """Ship one key of a snapshot cut to a serving host
+        (serving-tier publication, server/serving_tier.py)."""
+        raise NotImplementedError
+
+    def serve_commit(self, *, snapshot_id: int, gen: int, versions: dict,
+                     deadline_s: Optional[float] = None) -> dict:
+        raise NotImplementedError
+
+    def serve_ctl(self, **meta) -> dict:
         raise NotImplementedError
 
     def pull_state(self) -> Any:
@@ -1130,7 +1180,12 @@ class LoopbackEndpoint(Endpoint):
     def kv_pull(self, key):
         return self.kv.pull_versioned(key)
 
-    def serve_pull(self, since_id=None, keys=None):
+    def serve_pull(self, since_id=None, keys=None, max_stale_s=None,
+                   deadline_s=None):
+        del deadline_s   # no wire, no deadline
+        if getattr(self.serving, "supports_shed", False):
+            return self.serving.pull(since_id=since_id, keys=keys,
+                                     max_stale_s=max_stale_s)
         return self.serving.pull(since_id=since_id, keys=keys)
 
     def pull_state(self):
@@ -1315,6 +1370,51 @@ class TcpEndpoint(Endpoint):
                                   frame, "kv_push", key, worker_id, token)
         return rmeta.get("version", -1)
 
+    # -- serving-tier publication hops (server/serving_tier.py) -------------
+
+    def serve_cut(self, key, payload, *, snapshot_id, version, codec=None,
+                  deadline_s=None):
+        """One shipped key of a cut: the sealed envelope + NACK/
+        retransmit machine of the push hops, chaos-instrumented at the
+        serving wire's site (``bitflip:site=serve_pull`` corrupts cut
+        ships exactly as it corrupts pull replies)."""
+        seq = next(self._seq)
+        if codec is None:
+            frame = _integrity.seal_array(np.asarray(payload), key=key,
+                                          seq=seq, worker=self._conn.rank)
+        else:
+            frame = _integrity.seal_bytes(bytes(payload), key=key, seq=seq,
+                                          worker=self._conn.rank)
+        self._transmit({"hop": "serve_cut", "snapshot_id": snapshot_id,
+                        "version": version, "codec": codec}, frame,
+                       "serve_pull", key, self._conn.rank, seq,
+                       deadline_s=deadline_s)
+
+    def serve_commit(self, *, snapshot_id, gen, versions, deadline_s=None):
+        """Publish the shipped cut on the host (atomic ring swap there);
+        idempotent by snapshot id, so a reconnect retransmit is a dup
+        ACK, never a double publish."""
+        seq = next(self._seq)
+        frame = _integrity.seal_bytes(b"", key="__serve_commit__", seq=seq,
+                                      worker=self._conn.rank)
+        rmeta, _ = self._transmit(
+            {"hop": "serve_commit", "snapshot_id": snapshot_id,
+             "gen": gen, "versions": dict(versions)}, frame,
+            "serve_pull", "__serve_commit__", self._conn.rank, seq,
+            deadline_s=deadline_s)
+        return rmeta
+
+    def serve_ctl(self, **meta):
+        """Management/chaos channel to a serving host (ring-aware chaos:
+        arm a fault spec in ONE host mid-storm)."""
+        seq = next(self._seq)
+        frame = _integrity.seal_bytes(b"", key="__serve_ctl__", seq=seq,
+                                      worker=self._conn.rank)
+        rmeta, _ = self._transmit(dict(meta, hop="serve_ctl"), frame,
+                                  "serve_pull", "__serve_ctl__",
+                                  self._conn.rank, seq)
+        return rmeta
+
     def pull(self, key, timeout=None):
         return self.pull_versioned(key, timeout)[0]
 
@@ -1337,10 +1437,13 @@ class TcpEndpoint(Endpoint):
         rmeta, value = self._request_verified(OP_KV_PULL, {"key": key})
         return np.array(value, copy=True), rmeta.get("version", -1)
 
-    def serve_pull(self, since_id=None, keys=None):
+    def serve_pull(self, since_id=None, keys=None, max_stale_s=None,
+                   deadline_s=None):
         try:
             _meta, reply = self._request_verified(
-                OP_SERVE_PULL, {"since_id": since_id, "keys": keys})
+                OP_SERVE_PULL, {"since_id": since_id, "keys": keys,
+                                "max_stale_s": max_stale_s},
+                deadline_s=deadline_s)
         except (TransportError, _integrity.AckLost) as e:
             # a dead/partitioned/wedged serving peer degrades through
             # the plane's ordinary routing signal, not a client crash —
@@ -1559,3 +1662,15 @@ def _reset_for_tests() -> None:
         _servers.clear()
     for srv in servers:
         srv.close()
+    # directly-constructed Connections (serving-tier routers/publishers
+    # dial hosts outside the endpoint_to cache) are kept alive by their
+    # own supervisor threads even after their owner is dropped — the
+    # weak registry still sees them, so a test cannot leak reconnect
+    # loops into its neighbors' thread/gauge baselines
+    for conn in list(_connections):
+        if conn.state != DEAD:
+            try:
+                conn.close(drain=False, timeout=0.5)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+    _publish_conn_gauges()

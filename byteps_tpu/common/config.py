@@ -343,6 +343,45 @@ class Config:
     #                                  subscribes to its KVStore (0 = cut
     #                                  on every consistent write point)
 
+    # --- distributed serving tier (server/serving_tier.py) ---
+    serve_tier_vnodes: int = 64      # BYTEPS_SERVE_TIER_VNODES: virtual
+    #                                  nodes per serving host on the
+    #                                  consistent-hash ring — more vnodes
+    #                                  = smoother arc shares, slightly
+    #                                  slower membership churn
+    serve_tier_replicas: int = 2     # BYTEPS_SERVE_TIER_REPLICAS: hosts
+    #                                  each key is shipped to (the owner
+    #                                  + N-1 ring successors); reads fail
+    #                                  over along the same arc
+    serve_tier_rate: float = 0.0     # BYTEPS_SERVE_TIER_RATE: per-host
+    #                                  admission token-bucket refill,
+    #                                  pulls/s (0 = unlimited — only the
+    #                                  queue watermark sheds)
+    serve_tier_burst: float = 0.0    # BYTEPS_SERVE_TIER_BURST: token
+    #                                  bucket capacity (0 = one second
+    #                                  of refill)
+    serve_tier_queue_high: int = 64  # BYTEPS_SERVE_TIER_QUEUE_HIGH:
+    #                                  in-flight pulls per host above
+    #                                  which new pulls shed to bounded
+    #                                  staleness instead of queueing
+    serve_tier_ttl_s: float = 10.0   # BYTEPS_SERVE_TIER_TTL: serving-
+    #                                  host directory registration TTL;
+    #                                  a host that stops re-registering
+    #                                  ages out of the ring within it
+    serve_tier_min_hosts: int = 1    # BYTEPS_SERVE_TIER_MIN_HOSTS:
+    #                                  autoscaler floor
+    serve_tier_max_hosts: int = 8    # BYTEPS_SERVE_TIER_MAX_HOSTS:
+    #                                  autoscaler ceiling
+    serve_tier_cooldown_s: float = 5.0
+    #                                  BYTEPS_SERVE_TIER_COOLDOWN:
+    #                                  minimum seconds between autoscaler
+    #                                  decisions (flap damping)
+    serve_tier_bus: str = ""         # BYTEPS_SERVE_TIER_BUS:
+    #                                  "host:port" of the membership bus
+    #                                  carrying the serving-host
+    #                                  directory (serve_host.py reads it
+    #                                  to register; empty = standalone)
+
     # --- TCP transport (comm/transport.py, docs/transport.md) ---
     transport_hosts: str = ""        # BYTEPS_TRANSPORT_HOSTS: per-rank
     #                                  "host[:port]" list (comma-separated,
@@ -640,6 +679,30 @@ class Config:
             raise ValueError("serve_max_staleness_s must be >= 0")
         if self.serve_cut_interval_s < 0:
             raise ValueError("serve_cut_interval_s must be >= 0")
+        if self.serve_tier_vnodes < 1:
+            raise ValueError("serve_tier_vnodes must be >= 1")
+        if self.serve_tier_replicas < 1:
+            raise ValueError("serve_tier_replicas must be >= 1 (the "
+                             "owning host)")
+        if self.serve_tier_rate < 0:
+            raise ValueError("serve_tier_rate must be >= 0 (0 = no token "
+                             "bucket, queue watermark only)")
+        if self.serve_tier_burst < 0:
+            raise ValueError("serve_tier_burst must be >= 0 (0 = one "
+                             "second of refill)")
+        if self.serve_tier_queue_high < 1:
+            raise ValueError("serve_tier_queue_high must be >= 1")
+        if self.serve_tier_ttl_s <= 0:
+            raise ValueError("serve_tier_ttl_s must be positive — a "
+                             "non-expiring directory entry would pin a "
+                             "dead host in every client's ring forever")
+        if self.serve_tier_min_hosts < 1:
+            raise ValueError("serve_tier_min_hosts must be >= 1")
+        if self.serve_tier_max_hosts < self.serve_tier_min_hosts:
+            raise ValueError("serve_tier_max_hosts must be >= "
+                             "serve_tier_min_hosts")
+        if self.serve_tier_cooldown_s < 0:
+            raise ValueError("serve_tier_cooldown_s must be >= 0")
         if self.obs_port is not None and not 0 <= self.obs_port < 65536:
             raise ValueError("obs_port must be in 0..65535 (0 = ephemeral)")
         if self.flight_capacity <= 0:
@@ -725,6 +788,18 @@ class Config:
                                              0.5),
             serve_cut_interval_s=_env_float("BYTEPS_SERVE_CUT_INTERVAL",
                                             0.05),
+            serve_tier_vnodes=_env_int("BYTEPS_SERVE_TIER_VNODES", 64),
+            serve_tier_replicas=_env_int("BYTEPS_SERVE_TIER_REPLICAS", 2),
+            serve_tier_rate=_env_float("BYTEPS_SERVE_TIER_RATE", 0.0),
+            serve_tier_burst=_env_float("BYTEPS_SERVE_TIER_BURST", 0.0),
+            serve_tier_queue_high=_env_int(
+                "BYTEPS_SERVE_TIER_QUEUE_HIGH", 64),
+            serve_tier_ttl_s=_env_float("BYTEPS_SERVE_TIER_TTL", 10.0),
+            serve_tier_min_hosts=_env_int("BYTEPS_SERVE_TIER_MIN_HOSTS", 1),
+            serve_tier_max_hosts=_env_int("BYTEPS_SERVE_TIER_MAX_HOSTS", 8),
+            serve_tier_cooldown_s=_env_float(
+                "BYTEPS_SERVE_TIER_COOLDOWN", 5.0),
+            serve_tier_bus=_env_str("BYTEPS_SERVE_TIER_BUS", ""),
             transport_hosts=_env_str("BYTEPS_TRANSPORT_HOSTS", ""),
             transport_port_base=_env_int("BYTEPS_TRANSPORT_PORT_BASE", 0),
             transport_connect_timeout_s=_env_float(

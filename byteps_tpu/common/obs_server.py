@@ -124,6 +124,11 @@ def debug_state() -> dict:
                       for c in _metrics.components("kv_store")],
         "serving_planes": [c.debug_state()
                            for c in _metrics.components("serving_plane")],
+        # the distributed serving tier (server/serving_tier.py): the
+        # publisher's ring/ship state on a trainer, the host core's
+        # staged/committed/shed state on a serving host
+        "serving_tier": [c.debug_state()
+                         for c in _metrics.components("serving_tier")],
         # the TCP transport (comm/transport.py): per-connection state
         # machine snapshots (CONNECTING/READY/DRAINING/DEAD, in-flight
         # bytes, reconnect counts) + per-server attachment/peer views

@@ -327,6 +327,25 @@ def start_serving(store, **kwargs):
     return ServingPlane(store, **kwargs)
 
 
+def start_serving_tier(store, **kwargs):
+    """Stand up the DISTRIBUTED serving tier over ``store``
+    (``server/serving_tier.py``): out-of-process serving hosts behind
+    the TCP transport, snapshot deltas shipped per the consistent-hash
+    ring, admission-controlled pulls.  Keyword arguments forward to
+    :class:`~byteps_tpu.server.serving_tier.ServingTier` (``bus``,
+    ``static_hosts``, ``replicas``, ``retention``, ``cut_interval_s``,
+    ...); like :func:`start_serving`, ``cut_interval_s`` defaults from
+    ``BYTEPS_SERVE_CUT_INTERVAL`` so the tier is write-driven out of the
+    box (pass ``cut_interval_s=None`` explicitly for manual ``cut()``
+    publication).  Hosts come from the membership bus's serving-host
+    directory (start them with ``python -m
+    byteps_tpu.server.serve_host``); build consumers with
+    ``tier.client()``.  Works with or without a running engine."""
+    from ..server.serving_tier import ServingTier
+    kwargs.setdefault("cut_interval_s", get_config().serve_cut_interval_s)
+    return ServingTier(store, **kwargs)
+
+
 def cluster_metrics(bus: Optional[str] = None,
                     timeout: float = 10.0) -> Dict[str, Any]:
     """Every live rank's metrics snapshot in ONE round-trip to the
@@ -377,8 +396,18 @@ def cluster_metrics(bus: Optional[str] = None,
         return out
     if not reply.get("ok"):
         raise RuntimeError(f"cluster_metrics failed: {reply!r}")
+    # serving hosts publish at SERVE_RANK_BASE + host_id (one metrics
+    # cache, two id spaces): split them into their own section so
+    # bps_top renders trainer ranks and tier rows as what they are
+    base = _membership.SERVE_RANK_BASE
+    all_ranks = {int(r): v for r, v in reply["ranks"].items()}
     out = {"epoch": reply["epoch"], "world": reply["world"],
-           "ranks": {int(r): v for r, v in reply["ranks"].items()}}
+           "ranks": {r: v for r, v in all_ranks.items() if r < base},
+           "serve_ranks": {r - base: v for r, v in all_ranks.items()
+                           if r >= base},
+           "serve_hosts": {int(h): v for h, v in
+                           (reply.get("serve_hosts") or {}).items()},
+           "serve_gen": reply.get("serve_gen", 0)}
     for k in ("coordinator", "standby", "bus_rank"):
         if reply.get(k) is not None:
             out[k] = reply[k]

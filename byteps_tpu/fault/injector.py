@@ -159,6 +159,7 @@ VALID_SITES = (
     # bpslint: ignore[chaos-site] reason=kill-only predicate matched in on_step (die while hosting the control plane), never a woven fire() site
     "coordinator",
     "dcn", "dispatch", "heartbeat", "kv_push",
+    "serve_host",
     "serve_pull", "server_pull", "server_push", "sync", "transport")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
@@ -297,11 +298,16 @@ def parse_spec(spec: str) -> List[FaultRule]:
         # init() with an actionable message instead of never firing
         if kind == "kill" and step is None:
             raise _fail(spec, clause, "kill needs step=N (the push_pull "
-                                      "count at which the process dies)")
-        if kind == "kill" and site is not None and site != "coordinator":
+                                      "count at which the process dies — "
+                                      "the ANSWERED-PULL count for "
+                                      "site=serve_host)")
+        if kind == "kill" and site not in (None, "coordinator",
+                                           "serve_host"):
             raise _fail(spec, clause,
                         "kill supports only site=coordinator (die only "
-                        "while hosting the membership control plane)")
+                        "while hosting the membership control plane) or "
+                        "site=serve_host (die at the Nth answered serving "
+                        "pull — the ring-aware mid-storm host kill)")
         if kind != "kill" and site == "coordinator":
             raise _fail(spec, clause,
                         "site=coordinator is a kill-only predicate, not a "
@@ -381,6 +387,7 @@ class FaultInjector:
                 self._by_site.setdefault(r.site, []).append(r)
         self._kills = [r for r in self.rules if r.kind == "kill"]
         self._step = 0
+        self._serves = 0   # answered serving pulls (site=serve_host kills)
         self._lock = threading.Lock()
 
     # -- site hooks --------------------------------------------------------
@@ -397,6 +404,8 @@ class FaultInjector:
         for r in self._kills:
             if r.rank is not None and r.rank != self.rank:
                 continue
+            if r.site == "serve_host":
+                continue  # matched against the serve counter (on_serve)
             # coordinator kills count process-lifetime pushes (see the
             # module docstring: the per-incarnation counter restarts on
             # an elastic re-arm and would cascade-kill the successor)
@@ -419,6 +428,31 @@ class FaultInjector:
             from ..common import flight_recorder as _flight
             _flight.record("fault.kill", step=matched, rank=self.rank,
                            code=r.code)
+            _flight.dump("chaos_kill")
+            _exit(r.code)
+
+    def on_serve(self) -> None:
+        """Advance the serving-pull counter and honor ``site=serve_host``
+        kill rules — the ring-aware chaos hook: a serving host dies
+        deterministically at its Nth ANSWERED pull, i.e. mid-storm,
+        without the test choreographing a wall-clock race."""
+        with self._lock:
+            self._serves += 1
+            n = self._serves
+        for r in self._kills:
+            if r.site != "serve_host":
+                continue
+            if r.rank is not None and r.rank != self.rank:
+                continue
+            if n != r.step:
+                continue
+            counters.inc("fault.kill")
+            get_logger().error(
+                "fault injector: serve_host kill at pull %d (host %d) — "
+                "exiting %d", n, self.rank, r.code)
+            from ..common import flight_recorder as _flight
+            _flight.record("fault.kill", step=n, rank=self.rank,
+                           code=r.code, site="serve_host")
             _flight.dump("chaos_kill")
             _exit(r.code)
 
@@ -594,6 +628,12 @@ def active() -> Optional[FaultInjector]:
 def on_step() -> None:
     if _active is not None:
         _active.on_step()
+
+
+def on_serve() -> None:
+    """Serving-host twin of :func:`on_step` (``kill:site=serve_host``)."""
+    if _active is not None:
+        _active.on_serve()
 
 
 def fire(site: str) -> None:

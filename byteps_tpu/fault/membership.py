@@ -120,7 +120,15 @@ __all__ = [
     "MembershipView", "ElasticMembership", "WorldChanged", "Evicted",
     "Demoted", "MembershipTimeout", "current_epoch", "advance_epoch",
     "set_epoch", "resolve_bus_addr", "bus_request", "active_membership",
+    "SERVE_RANK_BASE",
 ]
+
+# Serving hosts (server/serving_tier.py) publish metrics snapshots into
+# the same bus-side cache as trainer ranks, keyed at host_id + this base
+# so the two id spaces can never collide (a tier of 3 hosts beside a
+# 4-rank trainer world must not have host 2 shadow rank 2's row in
+# bps_top).  Anything at or above the base is a serving host.
+SERVE_RANK_BASE = 1 << 20
 
 
 # The process's started ElasticMembership (weak: stop()/GC must not be
@@ -507,6 +515,29 @@ class _BusServer:
         # ranks awaiting recovery; cleared by rejoin admission
         self._probation: Dict[int, dict] = {}
         self._demote_pending: Optional[Tuple[int, int]] = None  # (epoch, rank)
+        # -- serving-host directory (server/serving_tier.py) ---------------
+        # host_id -> {"addr": (host, port), "ts": wall-clock refresh,
+        # "ttl": seconds, "meta": {...}}.  A generation counter bumps on
+        # every membership-visible change (join, leave, TTL expiry, addr
+        # move) so ring consumers re-derive routing exactly when it
+        # changed and never otherwise.  Wall-clock stamps deliberately:
+        # the directory must survive a coordinator failover onto a
+        # process with a different monotonic base.
+        self._serve_hosts: Dict[int, dict] = {}
+        self._serve_gen = 0
+        self._serve_target: Optional[int] = None  # autoscaler proposal
+        # gray-failing serving hosts the autoscaler excluded from
+        # placement (SERVING-HOST ids — a different namespace from the
+        # trainer-rank ``_probation`` above; the two must never leak
+        # into each other).  Changing it bumps the generation so every
+        # ring consumer re-routes the demoted arcs.
+        self._serve_probation: set = set()
+        # host_id -> wall time until which re-registration is refused: a
+        # retired host whose CONTROL plane still heartbeats (the gray
+        # failure: bus reachable, data plane dead) must not flap back
+        # into every client's ring one beat after the publisher evicted
+        # it
+        self._serve_banned: Dict[int, float] = {}
         if seed and seed.get("epoch", -1) >= view.epoch:
             self.epoch = int(seed["epoch"])
             self.world = set(int(r) for r in (seed.get("world")
@@ -529,6 +560,18 @@ class _BusServer:
             # forgotten) through the successor bus
             self._probation = {int(r): dict(v) for r, v in
                                (seed.get("probation") or {}).items()}
+            # the serving-host directory survives the failover too — a
+            # successor that forgot the tier would empty every client's
+            # ring until each host's next re-registration
+            srv = seed.get("serve") or {}
+            self._serve_hosts = {int(h): dict(v) for h, v in
+                                 (srv.get("hosts") or {}).items()}
+            self._serve_gen = int(srv.get("gen", 0))
+            self._serve_target = srv.get("target")
+            self._serve_probation = {int(h) for h in
+                                     (srv.get("probation") or ())}
+            self._serve_banned = {int(h): float(t) for h, t in
+                                  (srv.get("banned") or {}).items()}
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -579,6 +622,16 @@ class _BusServer:
                                 if v is None),
             "metrics": dict(self._metrics),
             "probation": {r: dict(v) for r, v in self._probation.items()},
+            "serve": {"hosts": {h: dict(v)
+                                for h, v in self._serve_hosts.items()},
+                      "gen": self._serve_gen,
+                      "target": self._serve_target,
+                      "probation": sorted(self._serve_probation),
+                      # wall-clock expiry stamps, valid on any host —
+                      # without them a failover forgets the ban and a
+                      # retired-but-heartbeating host flaps back into
+                      # the ring through the successor bus
+                      "banned": dict(self._serve_banned)},
         }
 
     # -- serving -----------------------------------------------------------
@@ -614,6 +667,14 @@ class _BusServer:
                 reply = self._do_replicate()
             elif op == "ping":
                 reply = self._do_ping()
+            elif op == "serve_register":
+                reply = self._do_serve_register(msg)
+            elif op == "serve_unregister":
+                reply = self._do_serve_unregister(msg)
+            elif op == "serve_dir":
+                reply = self._do_serve_dir()
+            elif op == "serve_scale":
+                reply = self._do_serve_scale(msg)
             else:
                 reply = {"ok": False, "error": f"unknown op {op!r}"}
             # replication piggyback: every reply to the STANDBY carries a
@@ -1033,9 +1094,22 @@ class _BusServer:
         actually serving this bus) so ``bps_top`` can show it."""
         now = time.time()
         with self._cv:
-            self._metrics = {r: v for r, v in self._metrics.items()
-                             if r in self.world}
+            self._prune_serve_locked()
+            # serving-host snapshots (rank >= SERVE_RANK_BASE) are kept
+            # while their directory registration lives — they are not
+            # members of the trainer world and must not be pruned as
+            # shrink residue
+            self._metrics = {
+                r: v for r, v in self._metrics.items()
+                if r in self.world
+                or (r >= SERVE_RANK_BASE
+                    and (r - SERVE_RANK_BASE) in self._serve_hosts)}
             return {"ok": True, "epoch": self.epoch,
+                    "serve_gen": self._serve_gen,
+                    "serve_hosts": {
+                        h: {"addr": list(v["addr"]),
+                            "age_s": round(now - v["ts"], 3)}
+                        for h, v in self._serve_hosts.items()},
                     "world": sorted(self.world),
                     "coordinator": min(self.world) if self.world else None,
                     "standby": self._standby_rank(),
@@ -1074,6 +1148,106 @@ class _BusServer:
                     # estimator (ISSUE 12): stamped as late as possible
                     "t_wall": time.time(),
                     "probation": sorted(self._probation)}
+
+    # -- verbs: serving-host directory (server/serving_tier.py) ------------
+
+    def _prune_serve_locked(self) -> None:
+        """Drop TTL-expired serving hosts (caller holds the condition).
+        Expiry is a membership change: the generation bumps so every
+        ring consumer re-routes the dead host's arc."""
+        now = time.time()
+        dead = [h for h, v in self._serve_hosts.items()
+                if now - v["ts"] > v["ttl"]]
+        for h in dead:
+            del self._serve_hosts[h]
+        if dead:
+            self._serve_gen += 1
+
+    def _do_serve_register(self, msg: dict) -> dict:
+        """A serving host joins (or refreshes) the tier directory.
+        ``host_id=None`` allocates the next free id; a re-registration
+        at the same address refreshes the TTL without bumping the
+        generation (steady-state heartbeats must not churn every
+        client's ring)."""
+        addr = tuple(msg["addr"])
+        ttl = float(msg.get("ttl_s") or 10.0)
+        now = time.time()
+        with self._cv:
+            self._prune_serve_locked()
+            hid0 = msg.get("host_id")
+            if hid0 is not None:
+                until = self._serve_banned.get(int(hid0), 0.0)
+                if until > now:
+                    return {"ok": False, "banned": True,
+                            "retry_after_s": round(until - now, 1),
+                            "gen": self._serve_gen}
+                self._serve_banned.pop(int(hid0), None)
+            hid = msg.get("host_id")
+            if hid is None:
+                hid = (max(self._serve_hosts) + 1 if self._serve_hosts
+                       else 0)
+            hid = int(hid)
+            prev = self._serve_hosts.get(hid)
+            self._serve_hosts[hid] = {"addr": addr, "ts": time.time(),
+                                      "ttl": ttl,
+                                      "meta": dict(msg.get("meta") or {})}
+            if prev is None or tuple(prev["addr"]) != addr:
+                self._serve_gen += 1
+            return {"ok": True, "host_id": hid, "gen": self._serve_gen,
+                    "epoch": self.epoch}
+
+    def _do_serve_unregister(self, msg: dict) -> dict:
+        """A host leaves (clean shutdown, or the publisher/autoscaler
+        retiring it after a failure streak) — its arc remaps NOW instead
+        of at TTL expiry.  ``ban_s`` refuses re-registration for that
+        window: an evicted-but-heartbeating host (data plane dead, bus
+        reachable) must not flap straight back into the ring."""
+        with self._cv:
+            hid = int(msg["host_id"])
+            if self._serve_hosts.pop(hid, None) is not None:
+                self._serve_gen += 1
+            ban = float(msg.get("ban_s") or 0.0)
+            if ban > 0:
+                self._serve_banned[hid] = time.time() + ban
+            return {"ok": True, "gen": self._serve_gen}
+
+    def _do_serve_dir(self) -> dict:
+        """The tier directory in one round trip: generation, live hosts,
+        the autoscaler's current target proposal, and the SERVING-HOST
+        probation set (placement and routing exclude these — host ids,
+        not trainer ranks)."""
+        now = time.time()
+        with self._cv:
+            self._prune_serve_locked()
+            return {"ok": True, "gen": self._serve_gen,
+                    "epoch": self.epoch,
+                    "target": self._serve_target,
+                    "probation": sorted(self._serve_probation),
+                    "hosts": {h: {"addr": list(v["addr"]),
+                                  "age_s": round(now - v["ts"], 3),
+                                  "meta": dict(v.get("meta") or {})}
+                              for h, v in self._serve_hosts.items()}}
+
+    def _do_serve_scale(self, msg: dict) -> dict:
+        """Record the autoscaler's proposals: target tier size and/or
+        the serving-host probation set.  The bus only CARRIES them —
+        whoever launches host processes (an operator, serve_bench
+        ``--hosts``, a k8s controller) reads the target from
+        ``serve_dir`` and acts; routers and the publisher exclude the
+        probationed hosts from their rings (the change bumps the
+        generation so they all re-sync)."""
+        with self._cv:
+            if "target" in msg:
+                t = msg["target"]
+                self._serve_target = None if t is None else int(t)
+            if "probation" in msg:
+                new = {int(h) for h in (msg["probation"] or ())}
+                if new != self._serve_probation:
+                    self._serve_probation = new
+                    self._serve_gen += 1
+            return {"ok": True, "target": self._serve_target,
+                    "probation": sorted(self._serve_probation),
+                    "gen": self._serve_gen}
 
 
 # -- the per-process membership object --------------------------------------

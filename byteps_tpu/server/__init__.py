@@ -10,3 +10,4 @@ weights with no barrier.  kv_store.py provides that as a host-side store.
 from .kv_store import KVStore  # noqa: F401
 from .serve_client import PullClient  # noqa: F401
 from .serving import ServingPlane, SnapshotStore  # noqa: F401
+from .serving_tier import ServingTier  # noqa: F401

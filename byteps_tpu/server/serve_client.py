@@ -45,7 +45,8 @@ class PullClient:
     def __init__(self, plane, keys: Optional[List[str]] = None,
                  max_staleness_s: Optional[float] = None,
                  prefetch: bool = False,
-                 hedge: Optional[bool] = None):
+                 hedge: Optional[bool] = None,
+                 stale_on_error: bool = False):
         from ..common.config import get_config
         self._plane = plane
         self._keys = list(keys) if keys is not None else None
@@ -57,6 +58,14 @@ class PullClient:
         # tail-sensitive consumer opts in even when the plane default
         # is sequential, and vice versa (docs/gray_failures.md)
         self.hedge = hedge
+        # distributed-tier degradation (server/serving_tier.py): when a
+        # refresh fails even after the router re-resolved the ring, a
+        # client with a hydrated cache serves it stale
+        # (serve.stale_on_error) instead of failing the read — staleness
+        # bounded by the tier's heal time (TTL/retire), correctness
+        # never at stake.  Off by default: the in-process plane's
+        # callers expect errors.
+        self.stale_on_error = stale_on_error
         self._cache: Dict[str, np.ndarray] = {}
         self._versions: Dict[str, int] = {}
         self._codecs: Dict[str, object] = {}
@@ -111,7 +120,16 @@ class PullClient:
             counters.inc("serve.stale_served")
             self._refresh_async()
             return self._slice(wanted)
-        self.refresh()
+        try:
+            self.refresh()
+        except Exception:  # noqa: BLE001 — opt-in stale degradation:
+            # with a hydrated cache the read succeeds stale rather than
+            # failing; an unhydrated client has nothing to degrade to
+            if not (self.stale_on_error and self._snapshot_id is not None):
+                raise
+            counters.inc("serve.stale_on_error")
+            get_logger().warning("serve: refresh failed, serving stale "
+                                 "cache", exc_info=True)
         return self._slice(wanted)
 
     def _slice(self, keys: Optional[List[str]]) -> Dict[str, np.ndarray]:
@@ -124,13 +142,42 @@ class PullClient:
 
     # -- refresh machinery ---------------------------------------------------
 
+    def _routed_pull(self):
+        """One plane pull, with the distributed-tier re-resolution fix:
+        on ``ServeUnavailable`` a router exposing ``reroute()`` gets ONE
+        forced ring/directory re-resolution and the pull retries against
+        the healed routing — the background single-flight refresh used
+        to park on the dead host until the next cut republished the
+        mirror sets.  Tier routers also receive the client's staleness
+        bound (``accepts_max_stale``): the host may shed the pull only
+        while that bound holds."""
+        kw = {"since_id": self._snapshot_id, "keys": self._keys,
+              "hedge": self.hedge}
+        if getattr(self._plane, "accepts_max_stale", False):
+            kw["max_stale_s"] = self.max_staleness_s
+        try:
+            return self._plane.pull(**kw)
+        except Exception as e:
+            from .serving import ServeUnavailable
+            reroute = getattr(self._plane, "reroute", None)
+            if not isinstance(e, ServeUnavailable) or reroute is None:
+                raise
+            reroute()
+            return self._plane.pull(**kw)
+
     def refresh(self) -> None:
         """Bring the cache forward to the plane's latest snapshot with
         one delta pull (full on first contact or after the cached id
         aged out of retention server-side)."""
         with self._refresh_lock:
-            reply = self._plane.pull(since_id=self._snapshot_id,
-                                     keys=self._keys, hedge=self.hedge)
+            reply = self._routed_pull()
+            if getattr(reply, "shed", False):
+                # admission control answered "keep your cache": the data
+                # did not move, so neither does the freshness clock —
+                # the next stale pull retries (cheaply) until the host
+                # has budget again
+                counters.inc("serve.shed_served")
+                return
             # build the updated view ASIDE and publish it with one
             # reference swap: a concurrent non-blocking pull slicing
             # the cache mid-refresh must see snapshot N or N+1 whole,
@@ -151,7 +198,15 @@ class PullClient:
             self._cache = cache
             self._versions = versions
             self._snapshot_id = reply.snapshot_id
-            self._fetched_at = time.monotonic()
+            if getattr(reply, "shed_partial", False):
+                # SOME hosts shed this merged pull: their keys are only
+                # inside the bound as of NOW — advancing the clock would
+                # let the whole cache (shed slices included) ride as
+                # "fresh" for another full bound.  Apply the fresh
+                # slices, keep the clock, retry (cheaply) next pull.
+                counters.inc("serve.shed_served")
+            else:
+                self._fetched_at = time.monotonic()
             self.bytes_received += reply.wire_bytes
             self.refreshes += 1
             counters.inc("serve.cache_misses")
@@ -177,6 +232,16 @@ class PullClient:
 
         threading.Thread(target=run, daemon=True,
                          name="bps-serve-prefetch").start()
+
+    def close(self) -> None:
+        """Release the routing plane's resources when the client OWNS
+        it — a per-client tier router (``client_owned = True``) holds
+        supervised TCP connections, and dropping the client without
+        closing would leak their supervisor threads.  A SHARED plane
+        (``ServingPlane``) is never closed from here: clients do not
+        own it."""
+        if getattr(self._plane, "client_owned", False):
+            self._plane.close()
 
     def _decode(self, key: str, item) -> np.ndarray:
         """Materialize one reply item into cache memory the client owns

@@ -1,0 +1,134 @@
+"""Standalone serving-host process: ``python -m byteps_tpu.server.serve_host``.
+
+The first runtime role beside trainer and coordinator: a process whose
+whole job is answering serving pulls.  It binds a
+:class:`~byteps_tpu.comm.transport.TransportServer`, attaches a
+:class:`~byteps_tpu.server.serving_tier.ServingHostCore` (stage/commit
+publication, shed-aware pulls), registers with the membership bus's
+serving-host directory, and keeps re-registering inside the TTL — the
+directory heartbeat doubling as the tier's liveness signal.  Each
+re-registration carries the host's cumulative pull/shed counts and hot
+keys, the signals the autoscaler reads; a metrics snapshot is also
+pushed to the bus (``metrics_put`` at ``SERVE_RANK_BASE + host_id``) so
+``bps_top`` renders the host as a first-class row.
+
+Environment:
+
+- ``BYTEPS_SERVE_TIER_BUS``    — membership-bus ``host:port`` (optional;
+  without it the host only prints its address for a static directory)
+- ``BYTEPS_SERVE_HOST_ID``     — fixed host id (default: bus-allocated)
+- ``BYTEPS_SERVE_HOST_BIND``   — ``host:port`` to listen on
+  (default ``127.0.0.1:0`` = ephemeral)
+- ``BYTEPS_FAULT_SPEC``        — chaos schedule, validated at start
+  (``kill:site=serve_host:step=N`` dies at the Nth answered pull)
+
+Prints ``HOST-UP <host_id> <host> <port>`` once serving, then runs until
+SIGTERM/SIGINT (clean: unregister, close) or the chaos injector kills
+it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    del argv
+    from ..common.config import get_config
+    from ..common.logging import get_logger
+    from ..comm import transport as tp
+    from ..core.api import metrics_snapshot
+    from ..fault import injector as inj
+    from ..fault.membership import SERVE_RANK_BASE, bus_request
+    from .serving_tier import ServingHostCore, TierDirectory
+
+    cfg = get_config()
+    # bpslint: ignore[env-knob] reason=per-process launch identity (like DMLC_WORKER_ID) consumed once at entrypoint start, before any Config is constructed or shared; documented in env.md
+    bind = os.environ.get("BYTEPS_SERVE_HOST_BIND", "127.0.0.1:0")
+    bind_host, bind_port = bind.rsplit(":", 1)
+    # bpslint: ignore[env-knob] reason=per-process launch identity (like DMLC_WORKER_ID) consumed once at entrypoint start, before any Config is constructed or shared; documented in env.md
+    want_id = os.environ.get("BYTEPS_SERVE_HOST_ID")
+    want_id = int(want_id) if want_id not in (None, "") else None
+
+    spec = cfg.fault_spec
+    if spec:
+        inj.arm(spec, seed=cfg.fault_seed,
+                rank=want_id if want_id is not None else 0)
+
+    core = ServingHostCore(host_id=want_id if want_id is not None else 0)
+    srv = tp.TransportServer(host=bind_host, port=int(bind_port),
+                             rank=SERVE_RANK_BASE + core.host_id,
+                             serving=core, tier=core)
+    directory = TierDirectory()
+    hid = core.host_id
+    if directory.bus is not None:
+        hid = directory.register(srv.addr, host_id=want_id,
+                                 meta={"pulls": 0, "sheds": 0, "hot": []})
+        if hid != core.host_id:
+            # bus-allocated id: adopt it everywhere the identity matters
+            core.host_id = hid
+            core.server.server_id = hid
+            if spec:
+                inj.arm(spec, seed=cfg.fault_seed, rank=hid)
+    print(f"HOST-UP {hid} {srv.host} {srv.port}", flush=True)
+
+    stop = threading.Event()
+
+    def _sig(_s, _f):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
+    def heartbeat():
+        """Directory TTL refresh + autoscaler signals + bps_top row."""
+        while not stop.wait(max(directory.ttl_s / 3.0, 0.5)):
+            if directory.bus is None:
+                continue
+            try:
+                directory.register(
+                    srv.addr, host_id=hid,
+                    meta={"pulls": core.pulls, "sheds": core.sheds,
+                          "hot": core.hot_keys(8), "role": "serve"})
+                snap = metrics_snapshot(light=True)
+                snap["role"] = "serve"
+                snap["host_id"] = hid
+                bus_request(directory.bus,
+                            {"op": "metrics_put",
+                             "rank": SERVE_RANK_BASE + hid,
+                             "metrics": snap}, timeout=5.0)
+            except (ConnectionError, TimeoutError):
+                # bus unreachable OR stalled (bus_request raises
+                # MembershipTimeout, a TimeoutError, when an
+                # established connection hangs — e.g. mid-coordinator-
+                # failover): the TTL gives us a grace window; keep
+                # serving and retry on the next beat.  The heartbeat
+                # thread must never die — a healthy serving host
+                # silently TTL-ing out of every client's ring is a
+                # capacity loss nothing would ever report.
+                get_logger().warning(
+                    "serve host %d: bus unreachable or stalled", hid)
+
+    threading.Thread(target=heartbeat, daemon=True,
+                     name=f"bps-serve-host-hb-{hid}").start()
+    try:
+        while not stop.wait(0.25):
+            pass
+    finally:
+        if directory.bus is not None:
+            try:
+                directory.unregister(hid)
+            except Exception:  # noqa: BLE001 — TTL finishes the job
+                pass
+        srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

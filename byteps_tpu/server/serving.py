@@ -240,6 +240,16 @@ class ServeReply:
     items: Dict[str, ServeItem]
     wire_bytes: int
     server_id: int
+    # admission control (server/serving_tier.py): True = the host shed
+    # this pull — "keep serving your cache, it is still inside your
+    # staleness bound" — a deliberate near-zero-cost answer, not data.
+    # The plane's in-process endpoints never shed.
+    shed: bool = False
+    # router-local (never on the wire): SOME of the merged per-host
+    # replies were shed.  The client applies the fresh slices but must
+    # NOT advance its freshness clock — the shed hosts' keys are only
+    # guaranteed inside the bound as of NOW, not for another full bound
+    shed_partial: bool = False
 
 
 class SnapshotServer:

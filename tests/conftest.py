@@ -115,6 +115,9 @@ def _fresh_telemetry():
     _transport = _sys.modules.get("byteps_tpu.comm.transport")
     if _transport is not None:
         _transport._reset_for_tests()
+    _tier = _sys.modules.get("byteps_tpu.server.serving_tier")
+    if _tier is not None:
+        _tier._reset_for_tests()
     _metrics.registry.reset()
     _metrics._reset_components_for_tests()
     _flight._reset_for_tests()

@@ -778,6 +778,39 @@ def test_bench_smoke_floor_and_gate_arithmetic(tmp_path, monkeypatch):
     assert bs.main() == 1
 
 
+def test_bench_smoke_serve_dist_floor_and_gate_arithmetic():
+    """ISSUE 15: the serve_dist lane gates on zero failed reads
+    (absolute), every spawned host actually serving, and aggregate
+    pulls/s over the floor with the lane tolerance.  Pin the floor
+    file's entry and the pure gate function."""
+    from tools import bench_smoke as bs
+    with open(bs.FLOOR_PATH) as f:
+        floor = json.load(f)
+    assert floor["serve_dist_pulls_per_s_floor"] > 0
+
+    def sd():
+        return {"failed_reads": 0, "pulls_per_s": 1e9,
+                "per_host": {0: {"pulls": 5}, 1: {"pulls": 7},
+                             2: {"pulls": 3}}}
+
+    good = sd()
+    assert bs._serve_dist_ok(good, floor, 0.3)
+    assert good["gate_pulls_per_s"] == round(
+        floor["serve_dist_pulls_per_s_floor"] * 0.7, 1)
+    # one failed read fails the lane outright — no tolerance
+    bad = sd()
+    bad["failed_reads"] = 1
+    assert not bs._serve_dist_ok(bad, floor, 0.3)
+    # a host that never served is a silent death, not a pass
+    dead = sd()
+    dead["per_host"][2]["pulls"] = 0
+    assert not bs._serve_dist_ok(dead, floor, 0.3)
+    # a tier-machinery collapse fails the throughput floor
+    slow = sd()
+    slow["pulls_per_s"] = 0.1
+    assert not bs._serve_dist_ok(slow, floor, 0.3)
+
+
 def test_bench_smoke_compressed_floor_and_gate_arithmetic():
     """ISSUE 11: the compressed lanes gate on wire ratio (onebit — the
     quantized-reduce-leg contract, <= 0.35x at >= 1 MiB), the

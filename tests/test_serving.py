@@ -406,6 +406,76 @@ def test_reshard_rebuilds_replica_sets_and_revives():
     assert counters.get("serve.reshards") == 2
 
 
+def test_replica_set_and_ring_stay_routable_during_inflight_reshard():
+    """ISSUE 15 satellite: ``ServerAssigner.replica_set`` (and the
+    plane routing built on it) was only ever tested AT REST around a
+    reshard.  Here pulls stay in flight while the world reshapes
+    repeatedly: every concurrently-derived replica set must stay
+    routable (distinct shards, inside the live clamp, head ==
+    write_target) and every plane pull must succeed — a torn
+    cache/replica-set view mid-``reshard()`` would surface as an
+    out-of-range shard or a failed read."""
+    from byteps_tpu.server.sharding import ServerAssigner
+    s, plane = _warm_plane(["r.a", "r.b", "r.c"], replicas=3)
+    assigner = ServerAssigner(num_servers=3, fn="djb2", mixed_mode=False,
+                              bound=101, replicas=3, hot_keys=8)
+    for k in ("r.a", "r.b", "r.c"):
+        for _ in range(4):
+            assigner.record_pull(k)
+    assigner.rebuild_replicas()
+    stop = threading.Event()
+    failures: list = []
+    pulls = [0]
+
+    def spin_replica_set():
+        # structural invariants only while racing (a reshard landing
+        # between two reads legitimately changes the answer): distinct
+        # shards, never outside the LARGEST world the loop uses
+        while not stop.is_set():
+            for k in ("r.a", "r.b", "r.c"):
+                rs = assigner.replica_set(k)
+                if (not rs or len(set(rs)) != len(rs)
+                        or any(not 0 <= sid < 3 for sid in rs)):
+                    failures.append((k, rs))
+
+    def spin_plane_pulls():
+        client = PullClient(plane, max_staleness_s=0.0)
+        while not stop.is_set():
+            try:
+                vals = client.pull()
+            except Exception as e:  # noqa: BLE001 — the one promise
+                failures.append(("pull", repr(e)))
+                continue
+            if vals["r.a"][0] != 1.0:
+                failures.append(("value", vals["r.a"][0]))
+            pulls[0] += 1
+
+    threads = [threading.Thread(target=spin_replica_set, daemon=True),
+               threading.Thread(target=spin_plane_pulls, daemon=True),
+               threading.Thread(target=spin_plane_pulls, daemon=True)]
+    for t in threads:
+        t.start()
+    for i in range(30):
+        n = (i % 3) + 1
+        assigner.reshard(n)
+        plane.reshard(n)
+        time.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert failures == []
+    assert pulls[0] > 20
+    # at rest, the full contract again: deterministic sets, distinct
+    # shards inside the final world, head == write_target
+    n = assigner.num_servers
+    for k in ("r.a", "r.b", "r.c"):
+        rs = assigner.replica_set(k)
+        assert rs == assigner.replica_set(k)
+        assert len(set(rs)) == len(rs)
+        assert all(0 <= sid < n for sid in rs)
+        assert rs[0] == assigner.write_target(k)
+
+
 def test_membership_world_change_reshards_active_planes():
     from byteps_tpu.server import serving as serving_mod
     s, plane = _warm_plane(["m.a"], replicas=3)

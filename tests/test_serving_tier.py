@@ -1,0 +1,900 @@
+"""Distributed serving tier tests (server/serving_tier.py + serve_ring.py
++ serve_autoscaler.py, ISSUE 15).
+
+What is pinned here:
+
+- the consistent-hash ring: deterministic across instances/processes,
+  adding/removing a host remaps ONLY its arc (~1/N of the key space),
+  arc shares sum to 1;
+- admission control: token bucket + queue watermark shed verdicts, shed
+  only while the client stays inside its staleness bound
+  (``serve.shed`` / ``serve.shed_bypass``), the shed reply keeps the
+  client's freshness clock honest;
+- the host core's two-phase publication: stage (idempotent) → commit
+  (atomic ring swap, dedup by snapshot id, carry-forward of unchanged
+  keys, loud drop of unshippable ones);
+- the publisher: ships only owned+changed keys per host (delta bytes
+  scale with churn), retires a host after consecutive ship failures
+  (directory ban — no flap-back), follows directory membership;
+- the router + PullClient: owner-routed groups, failover along the
+  replica arc, the ISSUE satellite fix (a refresh hitting
+  ``ServeUnavailable`` re-resolves the ring via ``reroute()`` instead
+  of retrying the dead host), opt-in stale-on-error degradation;
+- codec keys travel wire-encoded with the TRAINING codec end to end;
+- the bus directory verbs: register/TTL/unregister/ban/generation,
+  autoscaler target, replica-snapshot survival;
+- the autoscaler's pure ``decide`` (up on shed, down when idle with
+  probation-first victims, placement excluding probationed hosts);
+- the acceptance storm: ≥3 REAL serving-host processes behind the TCP
+  transport under a concurrent pull storm with one host chaos-killed
+  (``kill:site=serve_host``) and one partitioned mid-storm
+  (``serve_ctl`` → ``chaos_arm``): ZERO failed reads, the ring heals
+  through the bus, staleness re-bounds after heal, finals exact.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.telemetry import counters
+from byteps_tpu.fault import injector as inj
+from byteps_tpu.fault.membership import (SERVE_RANK_BASE, MembershipView,
+                                         _BusServer, bus_request)
+from byteps_tpu.server.kv_store import KVStore
+from byteps_tpu.server.serve_autoscaler import TierAutoscaler
+from byteps_tpu.server.serve_ring import ServeRing
+from byteps_tpu.server.serving import ServeReply, ServeUnavailable
+from byteps_tpu.server.serving_tier import (AdmissionControl,
+                                            ServingHostCore, ServingTier,
+                                            TierDirectory, TierRouter,
+                                            inproc_host)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    yield
+    inj.disarm()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _store(keys, numel=8):
+    s = KVStore()
+    for i, k in enumerate(keys):
+        s.init_key(k, np.full(numel, float(i), np.float32))
+    return s
+
+
+def _inproc_tier(n_hosts=3, keys=(), replicas=2, **kw):
+    d = TierDirectory(static_hosts={i: ("127.0.0.1", i + 1)
+                                    for i in range(n_hosts)})
+    cores = [inproc_host(ServingHostCore(host_id=i))
+             for i in range(n_hosts)]
+    store = _store(keys)
+    tier = ServingTier(store, directory=d, replicas=replicas,
+                       cut_interval_s=None, **kw)
+    return store, tier, cores
+
+
+KEYS = [f"t{i}" for i in range(12)]
+
+
+# -- the ring ---------------------------------------------------------------
+
+def test_ring_deterministic_and_distinct_replicas():
+    a = ServeRing([3, 1, 2], vnodes=32)
+    b = ServeRing([1, 2, 3], vnodes=32)
+    for k in KEYS:
+        assert a.owner(k) == b.owner(k)
+        rs = a.replica_hosts(k, 2)
+        assert rs == b.replica_hosts(k, 2)
+        assert len(set(rs)) == 2 and rs[0] == a.owner(k)
+    # n clamps to the host count
+    assert len(a.replica_hosts("x", 99)) == 3
+
+
+def test_ring_change_remaps_only_the_moved_arc():
+    keys = [f"k{i}" for i in range(400)]
+    r3 = ServeRing([0, 1, 2], vnodes=64)
+    r4 = ServeRing([0, 1, 2, 3], vnodes=64)
+    moved = r4.moved_keys(keys, r3, 1)
+    # adding 1 host to 3 should move ~1/4 of the space, never half
+    assert 0 < len(moved) / len(keys) < 0.45
+    # every moved key moved TO the new host; unmoved keys kept owners
+    for k in keys:
+        if k in moved:
+            assert r4.owner(k) == 3
+        else:
+            assert r4.owner(k) == r3.owner(k)
+    # removing it again restores the exact old routing
+    r4.remove(3)
+    assert not r4.moved_keys(keys, r3, 1)
+
+
+def test_ring_arc_share_and_empty_ring():
+    r = ServeRing([0, 1, 2, 3], vnodes=64)
+    shares = r.arc_share()
+    assert abs(sum(shares.values()) - 1.0) < 1e-9
+    assert set(shares) == {0, 1, 2, 3}
+    assert all(s > 0.05 for s in shares.values())   # vnodes smooth it
+    with pytest.raises(LookupError):
+        ServeRing([], vnodes=8).owner("x")
+
+
+# -- admission control -------------------------------------------------------
+
+def test_admission_token_bucket_and_queue_watermark():
+    ac = AdmissionControl(rate=10.0, burst=2.0, queue_high=3)
+    assert ac.admit() and ac.admit()        # burst spent
+    assert not ac.admit()                   # bucket dry
+    time.sleep(0.25)                        # ~2.5 tokens refill
+    assert ac.admit()
+    # queue watermark sheds regardless of tokens
+    ac2 = AdmissionControl(rate=0.0, queue_high=2)
+    assert ac2.admit()
+    for _ in range(3):
+        ac2.enter()
+    assert not ac2.admit()
+    ac2.exit()
+    assert ac2.admit()
+
+
+# -- host core: stage/commit/shed -------------------------------------------
+
+def _stage(core, key, value, version):
+    core.receive_key(key, np.asarray(value, np.float32),
+                     {"version": version, "codec": None})
+
+
+def test_host_stage_commit_publish_and_carry_forward():
+    core = ServingHostCore(host_id=5)
+    _stage(core, "a", [1.0], 1)
+    _stage(core, "b", [2.0], 1)
+    out = core.commit({"snapshot_id": 1, "gen": 0,
+                       "versions": {"a": 1, "b": 1}})
+    assert out["keys"] == 2 and out["missing"] == 0
+    r = core.pull()
+    assert r.full and set(r.items) == {"a", "b"}
+    # next cut changes only "a": "b" carries forward, unchanged travels 0
+    _stage(core, "a", [9.0], 2)
+    core.commit({"snapshot_id": 2, "gen": 0,
+                 "versions": {"a": 2, "b": 1}})
+    r2 = core.pull(since_id=r.snapshot_id)
+    assert not r2.full and set(r2.items) == {"a"}
+    assert float(np.asarray(r2.items["a"].payload)[0]) == 9.0
+    # commit is idempotent by snapshot id (transport retransmit)
+    dup = core.commit({"snapshot_id": 2, "gen": 0,
+                       "versions": {"a": 2, "b": 1}})
+    assert dup.get("dup") is True
+
+
+def test_host_commit_missing_key_drops_loudly():
+    core = ServingHostCore(host_id=1)
+    _stage(core, "a", [1.0], 1)
+    c0 = counters.get("serve.tier_missing_keys")
+    out = core.commit({"snapshot_id": 1, "gen": 0,
+                       "versions": {"a": 1, "ghost": 3}})
+    assert out["missing"] == 1 and out["keys"] == 1
+    assert counters.get("serve.tier_missing_keys") == c0 + 1
+    # the published cut serves what it has; ghost is simply absent
+    assert set(core.pull().items) == {"a"}
+
+
+def test_host_sheds_only_inside_the_clients_bound():
+    core = ServingHostCore(host_id=0)
+    _stage(core, "a", [1.0], 1)
+    core.commit({"snapshot_id": 1, "gen": 0, "versions": {"a": 1}})
+    base = core.pull().snapshot_id
+    _stage(core, "a", [2.0], 2)
+    core.commit({"snapshot_id": 2, "gen": 0, "versions": {"a": 2}})
+    # drain the bucket so every admit() says shed
+    core.admission = AdmissionControl(rate=1e-9, burst=1e-9,
+                                      queue_high=1000)
+    c_shed = counters.get("serve.shed")
+    c_byp = counters.get("serve.shed_bypass")
+    # inside the bound: shed (empty reply pinned at the client's base)
+    r = core.pull(since_id=base, max_stale_s=60.0)
+    assert r.shed and not r.items and r.snapshot_id == base
+    assert counters.get("serve.shed") == c_shed + 1
+    # outside the bound (base older than 0s): served anyway
+    r2 = core.pull(since_id=base, max_stale_s=0.0)
+    assert not r2.shed and set(r2.items) == {"a"}
+    assert counters.get("serve.shed_bypass") == c_byp + 1
+    # no base at all: never shed — there is no cache to serve from
+    r3 = core.pull(max_stale_s=60.0)
+    assert not r3.shed and r3.full
+
+
+def test_shed_reply_keeps_client_freshness_clock():
+    store, tier, cores = _inproc_tier(1, KEYS[:3], replicas=1)
+    tier.cut()
+    client = tier.client(max_staleness_s=0.05)
+    client.pull()
+    fetched = client._fetched_at
+    cores[0].admission = AdmissionControl(rate=1e-9, burst=1e-9,
+                                          queue_high=1000)
+    time.sleep(0.08)            # stale now
+    c0 = counters.get("serve.shed_served")
+    vals = client.pull()        # refresh -> shed -> stale cache served
+    assert set(vals) == set(KEYS[:3])
+    assert counters.get("serve.shed_served") == c0 + 1
+    # the freshness clock did NOT advance: the next pull retries
+    assert client._fetched_at == fetched
+    tier.close()
+
+
+# -- publisher ---------------------------------------------------------------
+
+def test_tier_ships_owned_changed_only_and_delta_bytes():
+    store, tier, cores = _inproc_tier(3, KEYS, replicas=2)
+    tier.cut()
+    # every key landed on exactly its replica set
+    for k in KEYS:
+        owners = set(tier.ring.replica_hosts(k, 2))
+        holders = {c.host_id for c in cores
+                   if k in (c.ring.latest().versions
+                            if c.ring.latest() else {})}
+        assert holders == owners
+    recv0 = counters.get("serve.tier_recv_keys")
+    store.push_delta(KEYS[0], np.ones(8, np.float32))
+    tier.cut()
+    # one changed key -> shipped once per replica holder, nothing else
+    assert counters.get("serve.tier_recv_keys") - recv0 == 2
+
+
+def test_tier_codec_key_ships_wire_encoded():
+    store = _store(["c0"], numel=256)
+    store.register_compression("c0", {"compressor": "onebit"}, 256,
+                               np.float32)
+    d = TierDirectory(static_hosts={0: ("127.0.0.1", 1)})
+    inproc_host(ServingHostCore(host_id=0))
+    tier = ServingTier(store, directory=d, replicas=1,
+                       cut_interval_s=None)
+    store.push_delta("c0", np.ones(256, np.float32))
+    b0 = counters.get("serve.tier_recv_bytes")
+    tier.cut()
+    wire = counters.get("serve.tier_recv_bytes") - b0
+    assert 0 < wire < 256 * 4       # onebit beats raw f32
+    client = tier.client(max_staleness_s=0.0)
+    vals = client.pull(["c0"])
+    # onebit is lossy-but-signed: the decoded value is the codec's
+    # round-trip of the stored value, exactly what the in-process
+    # plane's clients decode
+    assert vals["c0"].shape == (256,)
+    assert client.bytes_received == wire / 1  # same encoded bytes
+    tier.close()
+
+
+class _FailingEndpoint:
+    def __init__(self):
+        self.calls = 0
+
+    def serve_cut(self, *a, **kw):
+        self.calls += 1
+        raise ServeUnavailable("dead host")
+
+    def serve_commit(self, *a, **kw):
+        raise ServeUnavailable("dead host")
+
+    def close(self, drain=True):
+        pass
+
+
+def test_tier_retires_host_after_ship_failures():
+    store, tier, cores = _inproc_tier(3, KEYS[:6], replicas=2,
+                                      fail_streak=2)
+    tier.cut()
+    with tier._lock:
+        tier._eps[1] = _FailingEndpoint()
+    c0 = counters.get("serve.tier_ship_failures")
+    store.push_delta(KEYS[0], np.ones(8, np.float32))
+    tier.cut()      # failure 1
+    assert 1 in tier.ring.hosts()
+    store.push_delta(KEYS[1], np.ones(8, np.float32))
+    tier.cut()      # failure 2 -> retired
+    assert 1 not in tier.ring.hosts()
+    assert counters.get("serve.tier_ship_failures") >= c0 + 2
+    assert counters.get("serve.tier_retired") >= 1
+    # reads still work: the arc remapped to survivors and was re-shipped
+    vals = tier.client(max_staleness_s=0.0).pull()
+    assert set(vals) == set(KEYS[:6])
+    tier.close()
+
+
+def test_restarted_host_gets_its_holes_reshipped_next_cut():
+    """Review regression: the publisher must ack only what a commit
+    actually PUBLISHED.  A host that restarts within its TTL (same id,
+    empty state) drops every unchanged key at its first commit (nothing
+    staged, nothing to carry forward); acking the full owned map would
+    leave those holes un-shipped until the keys next changed — here the
+    NEXT cut must re-ship them even though no version advanced."""
+    store, tier, cores = _inproc_tier(2, KEYS[:6], replicas=1)
+    tier.cut()
+    # "restart" host 0: same id, all state gone
+    fresh = inproc_host(ServingHostCore(host_id=0))
+    with tier._lock:
+        tier._eps.pop(0, None)      # re-resolve to the fresh core
+    owned0 = [k for k in KEYS[:6] if tier.ring.owner(k) == 0]
+    assert owned0, "hash landed every key on host 1; pick more keys"
+    # one key changes; the restarted host's first commit drops the rest
+    store.push_delta(KEYS[0], np.ones(8, np.float32))
+    m0 = counters.get("serve.tier_missing_keys")
+    tier.cut()
+    assert counters.get("serve.tier_missing_keys") > m0
+    # NO further writes: the next cut must still re-ship the holes
+    tier.cut()
+    held = fresh.ring.latest().versions
+    assert set(owned0).issubset(set(held))
+    # and a client read of host 0's arc succeeds with exact values
+    vals = tier.client(max_staleness_s=0.0).pull(owned0)
+    assert set(vals) == set(owned0)
+    tier.close()
+
+
+def test_probation_excludes_host_from_router_and_publisher_rings():
+    """Review regression: probation must reach CLIENT rings too — the
+    one-sided version (publisher stops shipping, router keeps reading)
+    pins clients to a host whose snapshot never advances again, serving
+    unboundedly stale data as fresh."""
+    store, tier, cores = _inproc_tier(3, KEYS[:6], replicas=1)
+    tier.cut()
+    client = tier.client(max_staleness_s=0.0)
+    client.pull()
+    router = client._plane
+    tier.set_probation({1})
+    tier.cut()                       # arcs re-ship to the healthy hosts
+    assert 1 not in tier.ring.hosts()
+    time.sleep(0.3)                  # past the router's sync interval
+    vals = client.pull()             # router re-syncs (gen bumped)
+    assert set(vals) == set(KEYS[:6])
+    assert 1 not in router.ring.hosts()
+    assert router.host_pulls.get(1, 0) <= 2   # nothing new routed there
+    # probation lifts: the host returns to BOTH rings without
+    # re-registering
+    tier.set_probation(set())
+    tier.cut()
+    assert 1 in tier.ring.hosts()
+    time.sleep(0.3)
+    client.pull()
+    assert 1 in router.ring.hosts()
+    tier.close()
+
+
+def test_tier_follows_directory_membership():
+    store, tier, cores = _inproc_tier(2, KEYS[:6], replicas=1)
+    tier.cut()
+    assert sorted(tier.ring.hosts()) == [0, 1]
+    inproc_host(ServingHostCore(host_id=2))
+    tier.directory.register(("127.0.0.1", 3), host_id=2)
+    tier.cut()
+    assert sorted(tier.ring.hosts()) == [0, 1, 2]
+    # the new host holds exactly its arc
+    snap2 = cores[0].ring.latest()
+    assert snap2 is not None
+    moved = [k for k in KEYS[:6] if tier.ring.owner(k) == 2]
+    c2 = inproc_host(host_id=2)
+    if moved:
+        held = c2.ring.latest().versions
+        assert set(moved).issubset(set(held))
+    tier.close()
+
+
+# -- router + client ---------------------------------------------------------
+
+def test_router_fails_over_along_the_replica_arc():
+    store, tier, cores = _inproc_tier(3, KEYS[:8], replicas=2)
+    tier.cut()
+    client = tier.client(max_staleness_s=0.0)
+    assert set(client.pull()) == set(KEYS[:8])
+    # kill one host's serving endpoint (data plane only)
+    cores[1].server.kill()
+    f0 = counters.get("serve.tier_failover")
+    vals = client.pull()
+    assert set(vals) == set(KEYS[:8])
+    assert counters.get("serve.tier_failover") > f0
+    tier.close()
+
+
+class _FlakyPlane:
+    """Raises ServeUnavailable until reroute() is called — the dead-host
+    shape the satellite fix exists for."""
+
+    accepts_max_stale = True
+
+    def __init__(self):
+        self.rerouted = 0
+        self.pulls = 0
+
+    def reroute(self):
+        self.rerouted += 1
+
+    def pull(self, since_id=None, keys=None, record=True, hedge=None,
+             max_stale_s=None):
+        self.pulls += 1
+        if not self.rerouted:
+            raise ServeUnavailable("dead host")
+        return ServeReply(snapshot_id=1, full=True,
+                          items={}, wire_bytes=0, server_id=0)
+
+
+def test_pull_client_refresh_reroutes_on_serve_unavailable():
+    from byteps_tpu.server.serve_client import PullClient
+    plane = _FlakyPlane()
+    client = PullClient(plane, max_staleness_s=0.0)
+    client.pull()     # would raise forever without the reroute fix
+    assert plane.rerouted == 1
+    assert plane.pulls == 2   # failed attempt + post-reroute retry
+
+
+def test_client_stale_on_error_degradation():
+    store, tier, cores = _inproc_tier(2, KEYS[:4], replicas=1)
+    tier.cut()
+    client = tier.client(max_staleness_s=0.0)
+    vals = client.pull()
+    for c in cores:
+        c.server.kill()
+    c0 = counters.get("serve.stale_on_error")
+    stale = client.pull()     # every candidate dead -> stale cache
+    assert stale.keys() == vals.keys()
+    assert counters.get("serve.stale_on_error") == c0 + 1
+    # without the opt-in, the same failure raises
+    strict = tier.client(max_staleness_s=0.0, stale_on_error=False)
+    with pytest.raises(ServeUnavailable):
+        strict.pull()
+    tier.close()
+
+
+def test_router_routes_known_keys_to_owner_only():
+    store, tier, cores = _inproc_tier(3, KEYS, replicas=2)
+    tier.cut()
+    client = tier.client(max_staleness_s=0.0)
+    client.pull()             # hydration learns the key universe
+    b0 = client.bytes_received
+    store.push_delta(KEYS[3], np.ones(8, np.float32))
+    tier.cut()
+    client.pull()
+    delta = client.bytes_received - b0
+    # owner-routed: the changed key travels once (or twice when the
+    # rotating discovery slice also mirrors it) — never once per host
+    assert delta in (32, 64)
+    tier.close()
+
+
+# -- bus directory -----------------------------------------------------------
+
+@pytest.mark.chaos
+def test_bus_serve_directory_register_ttl_ban_and_failover_seed():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(0, (0,)),
+                     5.0, 5.0)
+    try:
+        d = TierDirectory(bus=f"127.0.0.1:{port}", ttl_s=1.2,
+                          poll_interval_s=0.0)
+        hid = d.register(("127.0.0.1", 1000))
+        assert hid == 0
+        assert d.register(("127.0.0.1", 1001), host_id=7) == 7
+        gen, hosts = d.hosts(force=True)
+        assert hosts == {0: ("127.0.0.1", 1000), 7: ("127.0.0.1", 1001)}
+        # re-registration refreshes without a gen bump
+        d.register(("127.0.0.1", 1000), host_id=0)
+        gen2, _ = d.hosts(force=True)
+        assert gen2 == gen
+        # unregister with ban: immediate removal, re-register refused
+        d.unregister(7, ban_s=30.0)
+        gen3, hosts3 = d.hosts(force=True)
+        assert gen3 > gen2 and 7 not in hosts3
+        with pytest.raises(ConnectionError, match="banned"):
+            d.register(("127.0.0.1", 1001), host_id=7)
+        # the directory survives a coordinator failover via the replica
+        d.register(("127.0.0.1", 1000), host_id=0)   # fresh TTL stamp
+        rep = bus_request(("127.0.0.1", port), {"op": "replicate"})
+        seed = rep["replica"]
+        port2 = _free_port()
+        bus2 = _BusServer(("127.0.0.1", port2), MembershipView(0, (0,)),
+                          5.0, 5.0, seed=seed)
+        try:
+            d2 = TierDirectory(bus=f"127.0.0.1:{port2}", ttl_s=1.2,
+                               poll_interval_s=0.0)
+            _, hosts_f = d2.hosts(force=True)
+            assert 0 in hosts_f
+        finally:
+            bus2.close()
+        # TTL expiry prunes host 0 (no heartbeat past 1.2s)
+        time.sleep(1.5)
+        _, hosts4 = d.hosts(force=True)
+        assert hosts4 == {}
+        # the autoscaler target rides the same channel
+        d.set_target(5)
+        assert d.target() == 5
+    finally:
+        bus.close()
+
+
+# -- autoscaler --------------------------------------------------------------
+
+def _sig(hosts, *, shed=0.0, pulls=0.0, slow=(), share=None):
+    return {"hosts": list(hosts), "gen": 1,
+            "rates": {h: {"pulls_per_s": pulls / max(len(hosts), 1),
+                          "shed_per_s": 0.0} for h in hosts},
+            "pulls_per_s": pulls, "shed_per_s": shed,
+            "slow": {h: (9.0 if h in slow else 0.0) for h in hosts},
+            "phi_threshold": 8.0,
+            "arc_share": share or {h: 1.0 / max(len(hosts), 1)
+                                   for h in hosts},
+            "hot_keys": ["hk0", "hk1"]}
+
+
+def test_autoscaler_decide_up_down_hold_and_placement():
+    store, tier, _ = _inproc_tier(3, KEYS[:4], replicas=2)
+    asc = TierAutoscaler(tier, min_hosts=1, max_hosts=4, cooldown_s=0.0,
+                         low_pulls_per_s=50.0)
+    # shedding -> scale up
+    d = asc.decide(_sig([0, 1, 2], shed=3.0, pulls=500.0))
+    assert d.action == "up" and d.target == 4
+    # idle -> scale down, smallest arc is the victim
+    d2 = asc.decide(_sig([0, 1, 2], pulls=30.0,
+                         share={0: 0.5, 1: 0.2, 2: 0.3}))
+    assert d2.action == "down" and d2.victims == [1]
+    # probationed host is the preferred victim AND leaves placement
+    d3 = asc.decide(_sig([0, 1, 2], pulls=30.0, slow=(2,)))
+    assert d3.action == "down" and d3.victims == [2]
+    assert d3.probation == [2]
+    for hosts in d3.placement.values():
+        assert 2 not in hosts
+    # busy but not shedding, inside bounds -> hold
+    d4 = asc.decide(_sig([0, 1, 2], pulls=1000.0))
+    assert d4.action == "hold"
+    # ceiling respected
+    d5 = asc.decide(_sig([0, 1, 2, 3], shed=5.0, pulls=500.0))
+    assert d5.action == "hold"
+    tier.close()
+
+
+def test_autoscaler_step_retires_victim_and_posts_target():
+    store, tier, cores = _inproc_tier(3, KEYS[:6], replicas=2)
+    tier.cut()
+    asc = TierAutoscaler(tier, min_hosts=1, max_hosts=4, cooldown_s=0.0,
+                         low_pulls_per_s=50.0)
+    c0 = counters.get("serve.tier_scale_down")
+    # review regression: the FIRST step sees structural zero rates (no
+    # deltas yet) and must HOLD — retiring a host on no data would ban
+    # a healthy one mid-traffic
+    first = asc.step(force=True)
+    assert first is not None and first.action == "hold"
+    assert "warming" in first.reason
+    assert len(tier.ring.hosts()) == 3
+    decision = asc.step(force=True)   # warmed: genuinely idle -> down
+    assert decision is not None and decision.action == "down"
+    assert counters.get("serve.tier_scale_down") == c0 + 1
+    assert len(tier.ring.hosts()) == 2
+    assert tier.directory.target() == 2
+    # reads survive the retirement (arc remapped + re-shipped)
+    tier.cut()
+    vals = tier.client(max_staleness_s=0.0).pull()
+    assert set(vals) == set(KEYS[:6])
+    tier.close()
+
+
+# -- debug/obs surfaces ------------------------------------------------------
+
+def test_debug_state_serving_tier_section_and_bps_top_rows():
+    store, tier, cores = _inproc_tier(2, KEYS[:4], replicas=1)
+    tier.cut()
+    tier.client(max_staleness_s=0.0).pull()
+    from byteps_tpu.common import obs_server
+    doc = obs_server.debug_state()
+    kinds = {d["kind"] for d in doc["serving_tier"]}
+    assert {"serving_tier", "serving_host"} <= kinds
+    # bps_top: serve hosts render as first-class rows
+    from tools import bps_top
+    cluster = {"epoch": 0, "world": [0], "coordinator": 0,
+               "ranks": {0: {"age_s": 0.1, "metrics": {}}},
+               "serve_gen": 3,
+               "serve_hosts": {0: {"addr": ["127.0.0.1", 1]},
+                               1: {"addr": ["127.0.0.1", 2]}},
+               "serve_ranks": {0: {"age_s": 0.2, "metrics": {
+                   "counters": {"serve.pulls": 90, "serve.shed": 10}}}}}
+    text = bps_top.render(cluster)
+    assert "ROLE" in text and "SHED%" in text and "ARC" in text
+    assert "s0" in text and "s1" in text and "serve" in text
+    assert "10%" in text          # 10 shed / 100 answered
+    assert "coordinator" in text
+    assert "serve tier: 2 host(s), gen 3" in text
+    tier.close()
+
+
+# -- ring-aware chaos: site=serve_host ---------------------------------------
+
+@pytest.mark.chaos
+def test_kill_site_serve_host_validation_and_counter():
+    with pytest.raises(ValueError, match="serve_host"):
+        inj.parse_spec("kill:step=3:site=sync")
+    rules = inj.parse_spec("kill:step=3:site=serve_host")
+    assert rules[0].site == "serve_host"
+    # the serve counter, not the push counter, matches the rule
+    killed = []
+    inj.arm("kill:step=2:site=serve_host", rank=0)
+    orig = inj._exit
+    inj._exit = lambda code: killed.append(code)
+    try:
+        inj.on_step()      # pushes do NOT consume serve_host kills
+        inj.on_step()
+        inj.on_step()
+        assert not killed
+        inj.on_serve()
+        assert not killed
+        inj.on_serve()     # the 2nd answered pull
+        assert killed
+    finally:
+        inj._exit = orig
+        inj.disarm()
+
+
+# -- the acceptance storm ----------------------------------------------------
+
+
+def _spawn_host(i, bus_port, ttl=3.0, spec=""):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BYTEPS_SERVE_TIER_BUS=f"127.0.0.1:{bus_port}",
+               BYTEPS_SERVE_HOST_ID=str(i),
+               BYTEPS_SERVE_TIER_TTL=str(ttl),
+               BYTEPS_LOG_LEVEL="ERROR",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    if spec:
+        env["BYTEPS_FAULT_SPEC"] = spec
+    else:
+        env.pop("BYTEPS_FAULT_SPEC", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.server.serve_host"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def _drain(proc):
+    """Read HOST-UP, then keep the pipe drained: a chaos-noisy host
+    must not block mid-log on a full 64 KiB pipe and wedge the storm."""
+    line = proc.stdout.readline()
+    threading.Thread(target=lambda f=proc.stdout: f.read(),
+                     daemon=True, name="serve-host-drain").start()
+    return line
+
+
+@pytest.mark.chaos
+def test_serve_dist_storm_kill_and_partition_4hosts():
+    """THE acceptance pin (ISSUE 15): 4 real serving-host processes
+    behind the TCP transport serve a concurrent pull storm while
+
+    - host 1 dies at its 300th answered pull (``kill:site=serve_host``
+      — deterministic, mid-storm), and
+    - host 2 is partitioned mid-storm via the ring-aware chaos channel
+      (``serve_ctl`` → ``chaos_arm partition:site=transport``),
+
+    and the tier keeps its promises: ZERO failed reads (failover +
+    reroute + stale-on-error), the ring heals through the bus (both
+    corpses retired by the publisher's ship-failure streak), staleness
+    re-bounds after the heal, and every client's final blocking pull
+    equals the store exactly."""
+    nkeys = 8
+    keys = [f"d{i}" for i in range(nkeys)]
+    bound = 0.25
+    bus_port = _free_port()
+    bus = _BusServer(("127.0.0.1", bus_port), MembershipView(0, (0,)),
+                     5.0, 5.0)
+    procs = {}
+    tier = None
+    stop = threading.Event()
+    try:
+        for i in range(4):
+            procs[i] = _spawn_host(
+                i, bus_port,
+                spec=("kill:step=60:site=serve_host" if i == 1 else ""))
+        for i, p in procs.items():
+            line = _drain(p)
+            assert "HOST-UP" in line, line
+
+        store = KVStore()
+        rng = np.random.RandomState(0)
+        for k in keys:
+            store.init_key(k, rng.randn(64).astype(np.float32))
+        tier = ServingTier(store, bus=f"127.0.0.1:{bus_port}",
+                           replicas=2, cut_interval_s=None,
+                           ship_deadline_s=0.75, fail_streak=2,
+                           conn_kw={"send_deadline_s": 0.75,
+                                    "keepalive_s": 1.0})
+        tier.cut()
+
+        # the publisher's version->publish-time history for the
+        # staleness audit (stamped when the cut RETURNS = shipped)
+        pub_lock = threading.Lock()
+        pub_times = {}          # version of keys[0] -> monotonic
+
+        def pusher():
+            step = 0
+            while not stop.is_set():
+                step += 1
+                store.push_delta(keys[0],
+                                 np.ones(64, np.float32))
+                for k in keys[1:]:
+                    store.push_delta(k, np.ones(64, np.float32) * 1e-3)
+                snap = tier.cut()
+                if snap is not None:
+                    with pub_lock:
+                        pub_times[snap.versions[keys[0]]] = \
+                            time.monotonic()
+                time.sleep(0.12)
+
+        samples = []            # (t, seen version of keys[0])
+        errors = []
+
+        def puller(idx):
+            client = tier.client(max_staleness_s=bound,
+                                 pull_deadline_s=0.75)
+            try:
+                while not stop.is_set():
+                    try:
+                        client.pull()
+                    except Exception as e:  # noqa: BLE001 — THE assertion
+                        errors.append((idx, repr(e)))
+                        continue
+                    with pub_lock:
+                        samples.append((time.monotonic(),
+                                        client.version(keys[0])))
+                    time.sleep(0.01)
+            finally:
+                client.close()
+
+        push_t = threading.Thread(target=pusher, daemon=True)
+        pull_ts = [threading.Thread(target=puller, args=(i,),
+                                    daemon=True) for i in range(4)]
+        push_t.start()
+        for t in pull_ts:
+            t.start()
+
+        time.sleep(1.5)                     # healthy storm
+        # mid-storm: partition host 2's data plane (ring-aware chaos);
+        # the ack is blackholed by the partition itself — expected
+        from byteps_tpu.common import integrity as _integrity
+        from byteps_tpu.comm.transport import (TcpEndpoint,
+                                               TransportError)
+        _, addrs = tier.directory.hosts(force=True)
+        t_chaos = time.monotonic()
+        if 2 in addrs:
+            ctl = TcpEndpoint(addrs[2], peer=SERVE_RANK_BASE + 2,
+                              send_deadline_s=1.0, keepalive_s=0.0)
+            try:
+                ctl.serve_ctl(cmd="chaos_arm",
+                              spec="partition:site=transport")
+            except (_integrity.AckLost, TransportError):
+                pass
+            ctl.close(drain=False)
+        # host 1's chaos kill fires on its own pull counter around now
+        time.sleep(6.0)                     # chaos + heal + steady
+        t_heal = time.monotonic()
+        time.sleep(3.0)                     # post-heal steady state
+        stop.set()
+        push_t.join(timeout=20)
+        for t in pull_ts:
+            t.join(timeout=20)
+
+        # 1) ZERO failed reads through kill + partition
+        assert not errors, errors[:5]
+        # 2) the kill fired: host 1 is dead with the injector's exit
+        assert procs[1].poll() is not None, "host 1 was never killed"
+        # 3) the ring healed THROUGH the bus: both corpses are out —
+        # the partitioned host only ever leaves via the publisher's
+        # retire+ban (its control plane keeps heartbeating), the killed
+        # one via retire or TTL expiry, whichever won the race
+        live = set(tier.ring.hosts())
+        assert live and not ({1, 2} & live), live
+        assert counters.get("serve.tier_retired") >= 1
+        # failovers actually exercised
+        assert counters.get("serve.tier_failover") > 0
+        # 4) bounded staleness after heal: every post-heal sample saw
+        # at least the newest version published (bound + slack) before
+        slack = 0.8
+        with pub_lock:
+            history = sorted(pub_times.items())
+        checked = 0
+        for t_s, seen in samples:
+            if t_s < t_heal:
+                continue
+            floor_v = 0
+            for v, t_pub in history:
+                if t_pub <= t_s - bound - slack:
+                    floor_v = max(floor_v, v)
+            assert seen >= floor_v, (t_s, seen, floor_v)
+            checked += 1
+        assert checked > 10, "no post-heal staleness samples"
+        # 5) finals exact: a fresh blocking pull equals the store
+        tier.cut()
+        fc = tier.client(max_staleness_s=0.0, pull_deadline_s=2.0)
+        final = fc.pull()
+        fc.close()
+        for k in keys:
+            np.testing.assert_array_equal(final[k], store.pull(k))
+    finally:
+        stop.set()
+        if tier is not None:
+            tier.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        bus.close()
+
+
+@pytest.mark.chaos
+def test_serve_dist_slow_socket_host_storm_zero_failed_reads():
+    """One host under ``slow_socket`` chaos (every send throttled 20ms):
+    the storm completes with zero failed reads and the throttled host's
+    own counters prove the fault actually fired (queried over the bus —
+    the host publishes metrics like any rank)."""
+    bus_port = _free_port()
+    bus = _BusServer(("127.0.0.1", bus_port), MembershipView(0, (0,)),
+                     5.0, 5.0)
+    procs = {}
+    tier = None
+    try:
+        for i in range(3):
+            procs[i] = _spawn_host(
+                i, bus_port, ttl=2.0,
+                spec=("slow_socket:site=transport:ms=20:p=0.5"
+                      if i == 0 else ""))
+        for p in procs.values():
+            assert "HOST-UP" in _drain(p)
+        store = _store([f"s{i}" for i in range(6)], numel=64)
+        tier = ServingTier(store, bus=f"127.0.0.1:{bus_port}",
+                           replicas=2, cut_interval_s=None,
+                           ship_deadline_s=3.0)
+        tier.cut()
+        client = tier.client(max_staleness_s=0.0, pull_deadline_s=3.0)
+        for _ in range(30):
+            vals = client.pull()
+            assert len(vals) == 6
+        client.close()
+        # the fault fired in host 0 (its bus-published counters say so)
+        deadline = time.monotonic() + 8.0
+        fired = 0
+        while time.monotonic() < deadline:
+            reply = bus_request(("127.0.0.1", bus_port), {"op": "metrics"})
+            row = (reply.get("ranks") or {}).get(SERVE_RANK_BASE + 0)
+            if row:
+                fired = ((row["metrics"].get("counters") or {})
+                         .get("fault.slow_socket", 0))
+                if fired:
+                    break
+            time.sleep(0.5)
+        assert fired > 0
+    finally:
+        if tier is not None:
+            tier.close()
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        bus.close()

@@ -477,6 +477,37 @@ def _straggler_ok(st, floor) -> bool:
     return st["p99_hedged_ms"] <= gate
 
 
+def _measure_serve_dist():
+    """Distributed serving tier (ISSUE 15): pulls/s + p99 against 3
+    REAL serving-host processes behind the TCP transport — snapshot
+    deltas shipped per the consistent-hash ring, membership-bus
+    directory, admission control armed.  The headline read-scale figure
+    of the benched trajectory."""
+    from tools import serve_bench
+    out = serve_bench.measure_distributed(
+        hosts=3, seconds=1.5, clients=3, keys=6, numel=16384,
+        replicas=2, staleness=0.05)
+    keep = ("hosts", "pulls_per_s", "p50_ms", "p99_ms", "pushes_per_s",
+            "failed_reads", "per_host", "ships", "ship_failures",
+            "failovers", "shed")
+    return {k: out[k] for k in keep}
+
+
+def _serve_dist_ok(sd: dict, floor: dict, tol: float) -> bool:
+    """The serve_dist gate (pure; pinned by a unit test): zero failed
+    reads is ABSOLUTE (the tier's whole promise), every spawned host
+    must actually have answered pulls (a silently dead host that never
+    failed a read would otherwise pass), and aggregate pulls/s must
+    clear the floor with the lane tolerance."""
+    gate = floor.get("serve_dist_pulls_per_s_floor", 0.0) * (1.0 - tol)
+    sd["gate_pulls_per_s"] = round(gate, 1)
+    every_host_served = all(v.get("pulls", 0) > 0
+                            for v in sd.get("per_host", {}).values())
+    return (sd["failed_reads"] == 0
+            and every_host_served
+            and sd["pulls_per_s"] >= gate)
+
+
 def main() -> int:
     setup_cpu8_mesh()
     tol = float(os.environ.get("BENCH_SMOKE_TOLERANCE", "0.30"))
@@ -486,6 +517,7 @@ def main() -> int:
     out["compressed"] = _measure_compressed()
     out["trace"] = _measure_trace()
     out["transport"] = _measure_transport()
+    out["serve_dist"] = _measure_serve_dist()
     if "--update-floor" in sys.argv:
         # compressed throughput floor: half the measured worst lane —
         # room for host noise, still catches a machinery collapse
@@ -506,6 +538,12 @@ def main() -> int:
                  "transport_tcp_ratio_floor": round(
                      out["transport"]["tcp_vs_loopback_ratio"] / 2, 3),
                  "transport_partitioned_p99_ms": 50.0,
+                 # serve_dist: a tenth of the measured distributed
+                 # pulls/s — generous host-noise room (the figure spans
+                 # three processes and the scheduler), still catches a
+                 # tier-machinery collapse
+                 "serve_dist_pulls_per_s_floor": round(
+                     out["serve_dist"]["pulls_per_s"] / 10, 1),
                  "note": "measured floor; the lane fails below "
                          "ratio * (1 - tolerance)"}
         with open(FLOOR_PATH, "w") as f:
@@ -539,8 +577,10 @@ def main() -> int:
     out["trace"]["ok"] = trace_ok
     transport_ok = _transport_ok(out["transport"], floor, tol)
     out["transport"]["ok"] = transport_ok
+    serve_dist_ok = _serve_dist_ok(out["serve_dist"], floor, tol)
+    out["serve_dist"]["ok"] = serve_dist_ok
     out["ok"] = (engine_ok and straggler_ok and compressed_ok and trace_ok
-                 and transport_ok)
+                 and transport_ok and serve_dist_ok)
     print(json.dumps(out))
     if not engine_ok:
         print(f"bench-smoke FAIL: engine_vs_fused_ratio "
@@ -574,6 +614,14 @@ def main() -> int:
               f"nothing: {trc['events_buffered']} events) — always-on "
               f"sampling is no longer cheap enough to leave armed",
               file=sys.stderr)
+    if not serve_dist_ok:
+        sd = out["serve_dist"]
+        print(f"bench-smoke FAIL: serve_dist lane violates the floor — "
+              f"failed_reads {sd['failed_reads']} (must be 0), per-host "
+              f"pulls {sd['per_host']} (every host must serve), or "
+              f"pulls_per_s {sd['pulls_per_s']} < gate "
+              f"{sd['gate_pulls_per_s']} — the distributed tier "
+              f"machinery regressed", file=sys.stderr)
     if not transport_ok:
         trp = out["transport"]
         print(f"bench-smoke FAIL: transport lane violates the floor — "

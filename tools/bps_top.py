@@ -30,9 +30,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-_COLUMNS = ("RANK", "GB/s", "QDEPTH", "INFLIGHT", "STALL%", "ATTRIB",
-            "RETX", "PULLS", "CONN", "CODEC", "SLOW", "STATE", "EPOCH",
-            "STEP", "AGE")
+_COLUMNS = ("RANK", "ROLE", "GB/s", "QDEPTH", "INFLIGHT", "STALL%",
+            "ATTRIB", "RETX", "PULLS", "SHED%", "ARC", "CONN", "CODEC",
+            "SLOW", "STATE", "EPOCH", "STEP", "AGE")
 
 
 def _conn_cell(gauges: dict) -> str:
@@ -86,13 +86,28 @@ def _codec_cell(gauges: dict) -> str:
     return ",".join(sorted(codecs)) if codecs else "-"
 
 
-def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
+def _shed_cell(counters: dict) -> str:
+    """Shed share of this endpoint's pull traffic (``serve.shed`` /
+    total answered), the admission-control health figure: 0% = nothing
+    degraded, climbing = the host is trading freshness for survival
+    under a storm (docs/serving.md)."""
+    shed = counters.get("serve.shed", 0)
+    pulls = counters.get("serve.pulls", 0) + shed
+    if not pulls:
+        return "-"
+    return f"{100.0 * shed / pulls:.0f}%"
+
+
+def _rank_row(rank: int, entry: dict, slow=None, probation=(),
+              role: str = "trainer", arc: float = None,
+              label: str = None) -> tuple:
     """One table row from a rank's cached snapshot (missing fields render
     as '-': a rank mid-transition posts partial snapshots).  ``slow`` is
     the bus's per-rank step-barrier phi score, ``probation`` the demoted
     set — together they make a demotion watchable live: the score climbs,
     STATE flips to PROBATION, and the rank leaves the world until it
-    recovers and rejoins (docs/gray_failures.md)."""
+    recovers and rejoins (docs/gray_failures.md).  ``role`` / ``arc``
+    render the serving tier's rows (ROLE=serve, ring-arc share)."""
     m = entry.get("metrics") or {}
     gauges = m.get("gauges") or {}
     counters = m.get("counters") or {}
@@ -107,7 +122,8 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
         stall = 100.0 * min(1.0, (step.get("sync_stall_ms") or 0.0)
                             / step["wall_ms"])
     return (
-        str(rank),
+        label if label is not None else str(rank),
+        role,
         # decimal GB/s, the same unit the bench tools' *_gbps report —
         # an operator comparing a row against the bench floor must not
         # eat a silent 7.4% MiB/GiB discrepancy
@@ -123,6 +139,10 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=()) -> tuple:
         # serving plane (server/serving.py): cumulative pulls served by
         # this rank — 0 everywhere means the rank runs no read plane
         fmt(counters.get("serve.pulls", 0)),
+        # serving tier (server/serving_tier.py): shed share of answered
+        # pulls, and this host's consistent-hash ring arc
+        _shed_cell(counters),
+        fmt(None if arc is None else 100.0 * arc, "{:.0f}%"),
         # transport (comm/transport.py): ready/total peer connections
         _conn_cell(gauges),
         # compression (ISSUE 11): which codec(s) this rank's pushes ride
@@ -143,12 +163,32 @@ def render(cluster: dict) -> str:
     probation = set(cluster.get("probation") or ())
     rows = [_COLUMNS]
     ranks = cluster.get("ranks", {})
+    coordinator = cluster.get("coordinator")
     # demoted ranks leave the world (and the metrics cache) but stay
     # VISIBLE: a probation row with '-' metrics is the operator's cue
     # that the rank is parked, not vanished
     for rank in sorted(set(ranks) | probation):
-        rows.append(_rank_row(rank, ranks.get(rank, {}),
-                              slow=slow.get(rank), probation=probation))
+        rows.append(_rank_row(
+            rank, ranks.get(rank, {}), slow=slow.get(rank),
+            probation=probation,
+            role="coordinator" if rank == coordinator else "trainer"))
+    # serving-tier rows (server/serving_tier.py): every host in the
+    # bus's serving directory is a first-class row — id prefixed 's',
+    # ROLE=serve, ring-arc share from the same ring math every client
+    # routes by, shed rate from the host's published counters
+    serve_hosts = cluster.get("serve_hosts") or {}
+    serve_ranks = cluster.get("serve_ranks") or {}
+    if serve_hosts:
+        try:
+            from byteps_tpu.server.serve_ring import ServeRing
+            shares = ServeRing(serve_hosts).arc_share()
+        except Exception:  # noqa: BLE001 — render must not die on a
+            # directory/ring mismatch mid-transition
+            shares = {}
+        for hid in sorted(serve_hosts):
+            rows.append(_rank_row(
+                hid, serve_ranks.get(hid, {}), role="serve",
+                arc=shares.get(hid), label=f"s{hid}"))
     widths = [max(len(r[i]) for r in rows) for i in range(len(_COLUMNS))]
     head = "byteps_tpu cluster — epoch %s, world %s" % (
         cluster.get("epoch"), cluster.get("world"))
@@ -156,6 +196,9 @@ def render(cluster: dict) -> str:
         # who hosts the control plane, and who takes over if it dies
         head += " — coordinator=%s standby=%s" % (
             cluster.get("coordinator"), cluster.get("standby"))
+    if serve_hosts:
+        head += " — serve tier: %d host(s), gen %s" % (
+            len(serve_hosts), cluster.get("serve_gen"))
     if probation:
         head += " — probation=%s" % sorted(probation)
     if cluster.get("failover_in_progress"):

@@ -13,7 +13,18 @@
 #
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
-#               | straggler | compressed | trace | transport | lint | all
+#               | serve_dist | straggler | compressed | trace
+#               | transport | lint | all
+#         serve_dist: the distributed-serving-tier chaos slice
+#              (server/serving_tier.py, docs/serving.md) — ≥3 real
+#              serving-host processes behind the TCP transport serve a
+#              concurrent pull storm while one host is chaos-killed
+#              (kill:site=serve_host) and another is partitioned
+#              mid-storm (serve_ctl chaos_arm): zero failed reads,
+#              ring heals through the bus directory, staleness stays
+#              bounded; plus slow_socket on a host, admission-control
+#              shed pins, and the reshard-while-pulls-in-flight tests
+#              (tests/test_serving_tier.py)
 #         transport: socket-fault chaos on the TCP data plane
 #              (comm/transport.py, docs/transport.md) — 4-process
 #              bitflip-over-real-sockets convergence, conn_reset
@@ -88,7 +99,12 @@ case "${1:-}" in
     coordinator) MARK="chaos"
                  KEXPR="coordinator or sync_deadline or reconcile"
                  shift ;;
-    serve)     MARK="chaos or integrity"; KEXPR="serve"; shift ;;
+    serve)     MARK="chaos or integrity"
+               KEXPR="serve and not serve_dist and not serving_tier"
+               shift ;;
+    serve_dist) MARK="chaos or integrity"
+                KEXPR="serve_dist or serving_tier"
+                shift ;;
     straggler) MARK="chaos"
                KEXPR="straggler or demote or hedge or stall"
                shift ;;

@@ -17,6 +17,15 @@ time, and drives N concurrent pull clients — reporting:
 
 Usage:  python tools/serve_bench.py [--seconds S] [--clients N]
             [--keys K] [--numel E] [--replicas R] [--staleness SEC]
+            [--hosts N]
+
+``--hosts N`` switches to DISTRIBUTED mode (server/serving_tier.py):
+N real serving-host processes are spawned behind the TCP transport, a
+membership bus carries the host directory, a ``ServingTier`` ships
+snapshot deltas per the consistent-hash ring while the pusher keeps
+training writes landing, and the pull clients route by the ring —
+reporting aggregate pulls/s, p50/p99, AND per-host pulls + latency
+quantiles (the figures the serve_dist bench-smoke section gates on).
 """
 
 from __future__ import annotations
@@ -163,6 +172,203 @@ def measure(*, seconds: float = 2.0, clients: int = 4, keys: int = 8,
     }
 
 
+def _await_host_up(p, timeout_s: float = 90.0) -> str:
+    """First stdout line with a deadline: a host wedged before HOST-UP
+    (import deadlock, bad env) must FAIL the bench, not hang it."""
+    out: list = []
+    t = threading.Thread(target=lambda: out.append(p.stdout.readline()),
+                         daemon=True, name="serve-host-up")
+    t.start()
+    t.join(timeout_s)
+    if not out:
+        raise RuntimeError(
+            f"serve host (pid {p.pid}) printed nothing within "
+            f"{timeout_s}s — wedged before HOST-UP")
+    return out[0]
+
+
+def kill_serve_hosts(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — escalate, never leak
+            p.kill()
+
+
+def spawn_serve_hosts(n: int, bus_port: int, *, ttl_s: float = 5.0,
+                      extra_env=None):
+    """Spawn ``n`` real serving-host processes registered against the
+    bus at ``bus_port``; returns the Popen list once every host printed
+    HOST-UP (shared by the distributed bench and the chaos tests).  On
+    any startup failure every already-spawned host is killed — no
+    orphan processes left registered against a bus nobody will close."""
+    import subprocess
+    procs = []
+    try:
+        for i in range(n):
+            env = dict(os.environ,
+                       JAX_PLATFORMS="cpu",
+                       BYTEPS_SERVE_TIER_BUS=f"127.0.0.1:{bus_port}",
+                       BYTEPS_SERVE_HOST_ID=str(i),
+                       BYTEPS_SERVE_TIER_TTL=str(ttl_s),
+                       BYTEPS_LOG_LEVEL="ERROR",
+                       PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                           "PYTHONPATH", ""))
+            env.update(extra_env(i) if callable(extra_env)
+                       else (extra_env or {}))
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server.serve_host"],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            line = _await_host_up(p)
+            if "HOST-UP" not in line:
+                raise RuntimeError(f"serve host failed to start: {line!r}")
+            # keep draining after HOST-UP: a host that logs under chaos
+            # (fault-injector warnings, transport errors) would
+            # otherwise fill the 64 KiB pipe and BLOCK mid-log
+            threading.Thread(target=lambda f=p.stdout: f.read(),
+                             daemon=True, name="serve-host-drain").start()
+    except BaseException:
+        kill_serve_hosts(procs)
+        raise
+    return procs
+
+
+def measure_distributed(*, hosts: int = 3, seconds: float = 3.0,
+                        clients: int = 4, keys: int = 8,
+                        numel: int = 16384, replicas: int = 2,
+                        staleness: float = 0.0) -> dict:
+    """The distributed measurement: real host processes, a live bus, a
+    shipping publisher, ring-routed clients."""
+    import socket as _socket
+
+    import numpy as np
+
+    from byteps_tpu.common.telemetry import counters
+    from byteps_tpu.fault.membership import MembershipView, _BusServer
+    from byteps_tpu.server.kv_store import KVStore
+    from byteps_tpu.server.serving_tier import ServingTier
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    bus_port = s.getsockname()[1]
+    s.close()
+    bus = _BusServer(("127.0.0.1", bus_port), MembershipView(0, (0,)),
+                     5.0, 5.0)
+    procs = []
+    tier = None
+    try:
+        procs = spawn_serve_hosts(hosts, bus_port)
+        store = KVStore()
+        names = [f"serve.dist.{i}" for i in range(keys)]
+        rng = np.random.RandomState(0)
+        for n in names:
+            store.init_key(n, rng.randn(numel).astype(np.float32))
+        tier = ServingTier(store, bus=f"127.0.0.1:{bus_port}",
+                           replicas=replicas, cut_interval_s=None,
+                           ship_deadline_s=3.0)
+        tier.cut()
+
+        stop = threading.Event()
+        pushes = [0]
+
+        def pusher():
+            delta = np.ones(numel, np.float32) * 1e-3
+            i = 0
+            while not stop.is_set():
+                store.push_delta(names[i % keys], delta)
+                pushes[0] += 1
+                i += 1
+                if i % keys == 0:
+                    tier.cut()
+
+        lat_lock = threading.Lock()
+        latencies: list = []
+        per_host: dict = {}
+        pull_counts = [0] * clients
+        errors = [0]
+
+        def puller(idx: int):
+            client = tier.client(max_staleness_s=staleness)
+            router = client._plane
+            mine = []
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    client.pull()
+                except Exception:  # noqa: BLE001 — the tier's one promise
+                    errors[0] += 1
+                    continue
+                mine.append((time.perf_counter() - t0) * 1e3)
+                pull_counts[idx] += 1
+            with lat_lock:
+                latencies.extend(mine)
+                for h, c in router.host_pulls.items():
+                    per_host[h] = per_host.get(h, 0) + c
+
+        push_thread = threading.Thread(target=pusher, daemon=True)
+        threads = [threading.Thread(target=puller, args=(i,), daemon=True)
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        push_thread.start()
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        push_thread.join(timeout=15)
+        wall = time.perf_counter() - t0
+
+        import numpy as _np
+        total = sum(pull_counts)
+        lat = _np.asarray(latencies) if latencies else _np.asarray([0.0])
+        # per-host latency quantiles from the slowness tracker's windows
+        from byteps_tpu.utils import slowness as _slowness
+        snap = _slowness.tracker().snapshot().get("serve_pull", {})
+        host_stats = {
+            int(h): {"pulls": per_host.get(h, 0),
+                     "p50_ms": (snap.get(h) or {}).get("median_ms", 0.0)}
+            for h in sorted(tier.ring.hosts() | set(per_host))}
+        # shed happens IN the host processes: their cumulative figures
+        # ride the directory heartbeats (reading this process's
+        # serve.shed counter would always print 0)
+        dir_meta = tier.directory.info()["meta"]
+        shed_total = sum(int(m.get("sheds", 0))
+                         for m in dir_meta.values())
+        return {
+            "mode": "distributed",
+            "hosts": hosts,
+            "seconds": round(wall, 3),
+            "clients": clients,
+            "keys": keys,
+            "numel": numel,
+            "replicas": replicas,
+            "staleness_s": staleness,
+            "pulls": total,
+            "pulls_per_s": round(total / wall, 1),
+            "p50_ms": round(float(_np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(_np.percentile(lat, 99)), 3),
+            "pushes_per_s": round(pushes[0] / wall, 1),
+            "failed_reads": errors[0],
+            "per_host": host_stats,
+            "ring_gen": tier.debug_state()["gen"],
+            "ships": counters.get("serve.tier_ships"),
+            "ship_failures": counters.get("serve.tier_ship_failures"),
+            "failovers": counters.get("serve.tier_failover"),
+            "shed": shed_total,
+        }
+    finally:
+        if tier is not None:
+            tier.close()
+        kill_serve_hosts(procs)
+        bus.close()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seconds", type=float, default=3.0)
@@ -171,7 +377,18 @@ def main(argv=None) -> int:
     p.add_argument("--numel", type=int, default=65536)
     p.add_argument("--replicas", type=int, default=3)
     p.add_argument("--staleness", type=float, default=0.0)
+    p.add_argument("--hosts", type=int, default=0,
+                   help="N > 0: distributed mode with N real "
+                        "serving-host processes")
     args = p.parse_args(argv)
+    if args.hosts > 0:
+        out = measure_distributed(
+            hosts=args.hosts, seconds=args.seconds, clients=args.clients,
+            keys=args.keys, numel=args.numel,
+            replicas=min(args.replicas, args.hosts),
+            staleness=args.staleness)
+        print(json.dumps(out))
+        return 0 if out["failed_reads"] == 0 else 1
     out = measure(seconds=args.seconds, clients=args.clients,
                   keys=args.keys, numel=args.numel,
                   replicas=args.replicas, staleness=args.staleness)
