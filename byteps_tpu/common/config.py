@@ -574,6 +574,40 @@ class Config:
     #                                  BYTEPS_FLIGHT_DUMP_ON_EXIT: also
     #                                  dump on engine shutdown / normal
     #                                  interpreter exit (once)
+    ts_on: bool = True               # BYTEPS_TS_ON: background sampler
+    #                                  feeding the per-rank time-series
+    #                                  ring (common/timeseries.py); like
+    #                                  the obs server it survives
+    #                                  suspend/resume — one sampler per
+    #                                  process lifetime
+    ts_interval_s: float = 2.0       # BYTEPS_TS_INTERVAL_S: sampling
+    #                                  cadence (seconds per window)
+    ts_window: int = 256             # BYTEPS_TS_WINDOW: ring capacity in
+    #                                  samples — the fixed memory bound
+    #                                  and the history depth /timeseries
+    #                                  and bps_doctor can see
+    health_on: bool = True           # BYTEPS_HEALTH_ON: SLO rule engine
+    #                                  (common/health.py) evaluated each
+    #                                  sampling tick; firing rules flip
+    #                                  /healthz to 503
+    health_windows: int = 3          # BYTEPS_HEALTH_WINDOWS: hysteresis K
+    #                                  — consecutive breaching windows to
+    #                                  fire, consecutive clean windows to
+    #                                  clear
+    health_overlap_floor: float = 0.2
+    #                                  BYTEPS_HEALTH_OVERLAP_FLOOR:
+    #                                  overlap_fraction below this while
+    #                                  steps complete breaches the
+    #                                  overlap_floor rule
+    health_burn_rate: float = 1.0    # BYTEPS_HEALTH_BURN_RATE: events/s
+    #                                  threshold shared by the
+    #                                  retransmit/shed/conn_reset burn
+    #                                  rules (per-window delta over the
+    #                                  sampling interval)
+    health_skew_ratio: float = 4.0   # BYTEPS_HEALTH_SKEW_RATIO: a rank
+    #                                  whose attrib-component window mean
+    #                                  exceeds this multiple of the
+    #                                  cluster median breaches attrib_skew
 
     # Pin markers for the auto-tuned planner (resolved in __post_init__
     # when left None): a knob explicitly set — env var present, or a
@@ -713,6 +747,22 @@ class Config:
             raise ValueError("trace_capacity must be >= 256")
         if self.clock_sync_samples < 0:
             raise ValueError("clock_sync_samples must be >= 0 (0 = off)")
+        if self.ts_interval_s <= 0:
+            raise ValueError("ts_interval_s must be positive")
+        if self.ts_window < 8:
+            raise ValueError("ts_window must be >= 8 — the health rules "
+                             "need at least a few windows of history to "
+                             "judge a trend")
+        if self.health_windows < 1:
+            raise ValueError("health_windows must be >= 1")
+        if not 0 <= self.health_overlap_floor <= 1:
+            raise ValueError("health_overlap_floor must be in [0, 1] — "
+                             "it is a fraction of the step wall")
+        if self.health_burn_rate <= 0:
+            raise ValueError("health_burn_rate must be positive")
+        if self.health_skew_ratio <= 1:
+            raise ValueError("health_skew_ratio must be > 1 — a ratio at "
+                             "or below the median can never mean skew")
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -844,6 +894,15 @@ class Config:
             flight_dir=_env_str("BYTEPS_FLIGHT_DIR", "."),
             flight_dump_on_exit=_env_bool("BYTEPS_FLIGHT_DUMP_ON_EXIT",
                                           False),
+            ts_on=_env_bool("BYTEPS_TS_ON", True),
+            ts_interval_s=_env_float("BYTEPS_TS_INTERVAL_S", 2.0),
+            ts_window=_env_int("BYTEPS_TS_WINDOW", 256),
+            health_on=_env_bool("BYTEPS_HEALTH_ON", True),
+            health_windows=_env_int("BYTEPS_HEALTH_WINDOWS", 3),
+            health_overlap_floor=_env_float(
+                "BYTEPS_HEALTH_OVERLAP_FLOOR", 0.2),
+            health_burn_rate=_env_float("BYTEPS_HEALTH_BURN_RATE", 1.0),
+            health_skew_ratio=_env_float("BYTEPS_HEALTH_SKEW_RATIO", 4.0),
         )
 
 

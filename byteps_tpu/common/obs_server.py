@@ -10,10 +10,16 @@
   refreshed at scrape time so the figures are current even between
   dispatches.
 - ``/healthz`` — JSON liveness: membership epoch, engine run state,
-  last-heartbeat age, push_pull speed, current step.
+  last-heartbeat age, push_pull speed, current step.  Answers HTTP 503
+  with ``degraded: true`` and the firing rule names while any
+  ``common/health.py`` alert is active, so an external probe sees a
+  sick rank without parsing the body.
 - ``/debug/state`` — JSON internals for postmortems: scheduler queue
   depth + bytes in flight, planner lock state, per-key quarantined
   rounds (ServerEngine), dedup floors (KVStore), flight-recorder fill.
+- ``/timeseries`` — the raw time-series ring
+  (``common/timeseries.py``): the sampled window ``bps_doctor`` and
+  postmortem capture read.
 
 Lifecycle: started once per process by ``bps.init()`` and deliberately
 NOT stopped by ``bps.shutdown()`` — an elastic suspend/resume keeps the
@@ -66,9 +72,12 @@ def _refresh_live_gauges() -> None:
 
 
 def healthz() -> dict:
-    """The /healthz document (also unit-testable without HTTP)."""
+    """The /healthz document (also unit-testable without HTTP).  The
+    ``ok``/``degraded`` pair mirrors the HTTP status the handler sends:
+    503 while any health rule fires, 200 otherwise."""
     import time
 
+    from . import health as _health
     from ..core import api
     from ..fault import membership as _membership
     eng = api._engine
@@ -78,8 +87,12 @@ def healthz() -> dict:
         # the membership-managed monitor (re-hosted per world change)
         # supersedes the static auto-armed one
         hb = m.heartbeat
+    alerts = _health.active_alerts()
     doc = {
-        "ok": True,
+        "ok": not alerts,
+        "degraded": bool(alerts),
+        "alerts": sorted(alerts),
+        "alert_details": alerts,
         "ts": time.time(),
         "membership_epoch": _membership.current_epoch(),
         "engine_running": bool(eng is not None and eng._running),
@@ -154,6 +167,15 @@ def debug_state() -> dict:
     # /metrics scrape that follows this sees the same figures
     from ..utils import slowness as _slowness
     doc["slowness"] = _slowness.tracker().publish_gauges()
+    # retention + judgment (ISSUE 16): window fill and the firing rules
+    from . import health as _health
+    from . import timeseries as _ts
+    store = _ts.get_store()
+    doc["timeseries"] = (None if store is None else
+                         {"len": len(store.points()),
+                          "window": store.window,
+                          "interval_s": store.interval_s})
+    doc["health"] = {"active_alerts": _health.active_alerts()}
     m = _membership.active_membership()
     if m is not None:
         v = m.view()
@@ -189,30 +211,40 @@ def debug_state() -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server API
+        status = 200
         try:
             if self.path == "/metrics":
                 _refresh_live_gauges()
                 body = _metrics.registry.render_prometheus().encode()
                 ctype = PROMETHEUS_CONTENT_TYPE
             elif self.path == "/healthz":
-                body = json.dumps(healthz(), default=str).encode()
+                doc = healthz()
+                # a degraded rank answers 503 so external probes (load
+                # balancers, supervisors) see sickness without parsing
+                status = 200 if doc["ok"] else 503
+                body = json.dumps(doc, default=str).encode()
                 ctype = "application/json"
             elif self.path == "/debug/state":
                 body = json.dumps(debug_state(), default=str).encode()
                 ctype = "application/json"
+            elif self.path == "/timeseries":
+                from . import timeseries as _ts
+                store = _ts.get_store()
+                doc = store.dump() if store is not None else {
+                    "len": 0, "points": [],
+                    "disabled": "BYTEPS_TS_ON=0 or init() not called"}
+                body = json.dumps(doc, default=str).encode()
+                ctype = "application/json"
             else:
                 self.send_error(404, "unknown route (try /metrics, "
-                                     "/healthz, /debug/state)")
+                                     "/healthz, /debug/state, "
+                                     "/timeseries)")
                 return
         except Exception as e:  # noqa: BLE001 — a scrape must not 500 silently
             body = json.dumps({"error": str(e)}).encode()
-            self.send_response(500)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
-        self.send_response(200)
+            status = 500
+            ctype = "application/json"
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -235,7 +267,7 @@ class ObsServer:
             daemon=True, name="bps-obs-http")
         self._thread.start()
         get_logger().info("observability endpoint: http://%s:%d "
-                          "(/metrics /healthz /debug/state)",
+                          "(/metrics /healthz /debug/state /timeseries)",
                           host, self.port)
 
     def stop(self) -> None:

@@ -1265,11 +1265,17 @@ class PushPullEngine:
                         self._sync_block = (time.monotonic(),
                                             [t.name for t in tasks])
                 try:
+                    t_blk = time.perf_counter()
                     if _fault.ENABLED:
-                        # chaos site "sync": delay completion -> callback
+                        # chaos site "sync": delay completion -> callback.
+                        # Deliberately inside the timed window: the delay
+                        # is the test double for a wedged collective, so
+                        # it must surface exactly like one — as sync
+                        # stall (overlap collapse, the self-reported
+                        # slowness feed) — not vanish into untimed
+                        # bookkeeping around the block.
                         _fault.fire("sync")
                     if err is None:
-                        t_blk = time.perf_counter()
                         try:
                             # For buffer runs ``out`` is the completion
                             # token, not the buffer: the buffer itself may

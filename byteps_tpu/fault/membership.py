@@ -488,6 +488,12 @@ class _BusServer:
         # every sync (and may metrics_put explicitly); the metrics verb
         # answers from here in one round-trip (core/api.cluster_metrics)
         self._metrics: Dict[int, Tuple[float, Any]] = {}
+        # rank -> (wall time, windowed time-series summary): the history
+        # cache (ISSUE 16) — compact window summaries piggybacked the
+        # same way, so cluster_metrics grows a `history` view in the
+        # SAME round-trip and the health engine's skew rule can compare
+        # ranks without new verbs
+        self._history: Dict[int, Tuple[float, Any]] = {}
         # -- gray-failure state (ISSUE 10, docs/gray_failures.md) ----------
         # The bus scores each rank's STEP-BARRIER ARRIVAL LAG: a
         # slow-but-alive rank completes every quorum, just last — the
@@ -555,6 +561,11 @@ class _BusServer:
                                for r in (seed.get("join_wait") or ())}
             self._metrics = {int(r): tuple(v)
                              for r, v in (seed.get("metrics") or {}).items()}
+            # the history cache survives a coordinator failover with the
+            # metrics cache — a postmortem that spans the failover must
+            # still see the window leading into it
+            self._history = {int(r): tuple(v)
+                             for r, v in (seed.get("history") or {}).items()}
             # probation survives a coordinator failover: a demoted rank
             # must still be readmittable (and visible as demoted, not
             # forgotten) through the successor bus
@@ -581,10 +592,24 @@ class _BusServer:
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="bps-membership-bus")
         self._thread.start()
+        # cross-rank judgment (common/health.py): the rank hosting this
+        # bus holds every member's piggybacked window in-process, so the
+        # skew rule runs here (and only here) with no extra round-trips
+        from ..common import health as _health
+        _health.set_cluster_history_provider(self._history_view)
+
+    def _history_view(self) -> Dict[int, dict]:
+        """``{rank: window summary}`` of the live world — the health
+        engine's cluster-skew input (and the doctor's, over the bus)."""
+        with self._cv:
+            return {r: h for r, (_, h) in self._history.items()
+                    if r in self.world and h}
 
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
+        from ..common import health as _health
+        _health.clear_cluster_history_provider(self._history_view)
         self._stop.set()
         with self._cv:
             self._cv.notify_all()
@@ -621,6 +646,7 @@ class _BusServer:
             "join_wait": sorted(r for r, v in self._join_wait.items()
                                 if v is None),
             "metrics": dict(self._metrics),
+            "history": dict(self._history),
             "probation": {r: dict(v) for r, v in self._probation.items()},
             "serve": {"hosts": {h: dict(v)
                                 for h, v in self._serve_hosts.items()},
@@ -738,6 +764,9 @@ class _BusServer:
                 # sync — a rank mid-transition is exactly one an operator
                 # wants to see
                 self._metrics[rank] = (time.time(), msg["metrics"])
+            if msg.get("history") is not None:
+                # the windowed time-series summary rides the same frame
+                self._history[rank] = (time.time(), msg["history"])
             if epoch != self.epoch:
                 return self._stale_reply()
             if (self._demote_pending is not None
@@ -1083,6 +1112,8 @@ class _BusServer:
         piggyback — background publishers and one-shot tools)."""
         with self._cv:
             self._metrics[msg["rank"]] = (time.time(), msg.get("metrics"))
+            if msg.get("history") is not None:
+                self._history[msg["rank"]] = (time.time(), msg["history"])
             return {"ok": True, "epoch": self.epoch,
                     "world": sorted(self.world)}
 
@@ -1121,7 +1152,13 @@ class _BusServer:
                              self._slow.scores(site="step_sync").items()},
                     "probation": sorted(self._probation),
                     "ranks": {r: {"age_s": round(now - t, 3), "metrics": m}
-                              for r, (t, m) in self._metrics.items()}}
+                              for r, (t, m) in self._metrics.items()},
+                    # the retention plane (ISSUE 16): each live rank's
+                    # piggybacked window summary, same freshness rules
+                    "history": {r: {"age_s": round(now - t, 3),
+                                    "summary": h}
+                                for r, (t, h) in self._history.items()
+                                if r in self.world}}
 
     # -- verbs: replicate / ping (coordinator-failover support) ------------
 
@@ -1573,6 +1610,20 @@ class ElasticMembership:
         except Exception:  # noqa: BLE001
             return None
 
+    def _local_history(self) -> Optional[dict]:
+        """The compact time-series window summary riding the same sync
+        frame (ISSUE 16); None when the sampler is off or empty —
+        history must never fail a step barrier either."""
+        try:
+            from ..common import timeseries as _ts
+            store = _ts.get_store()
+            if store is None:
+                return None
+            summ = store.summary()
+            return summ if summ.get("n") else None
+        except Exception:  # noqa: BLE001
+            return None
+
     def publish_metrics(self) -> bool:
         """Best-effort explicit snapshot push (``metrics_put``) for
         processes between step barriers; returns False instead of
@@ -1581,7 +1632,8 @@ class ElasticMembership:
             from ..core import api
             bus_request(self.bus_addr,
                         {"op": "metrics_put", "rank": self.rank,
-                         "metrics": api.metrics_snapshot(light=True)},
+                         "metrics": api.metrics_snapshot(light=True),
+                         "history": self._local_history()},
                         timeout=5.0)
             return True
         except Exception:  # noqa: BLE001
@@ -1719,7 +1771,8 @@ class ElasticMembership:
         msg: Dict[str, Any] = {"op": "sync", "rank": self.rank,
                                "epoch": view.epoch, "step": step,
                                "payload": payload,
-                               "metrics": self._local_metrics()}
+                               "metrics": self._local_metrics(),
+                               "history": self._local_history()}
         if _tctx is not None:
             msg["trace"] = _tctx.trace_id
         if state is not None and self._join_hint:

@@ -339,6 +339,80 @@ def test_lock_discipline_pragma_suppresses(tmp_path):
     assert fs == []
 
 
+# -- health-rule ------------------------------------------------------------
+
+_HEALTH_SRC = """\
+RULE_IDS = (
+    "overlap_floor",
+    "ef_growth",
+)
+"""
+
+_OBS_DOC_HEALTH = _OBS_DOC + """
+| Rule | Breaches when | Knob |
+|---|---|---|
+| `overlap_floor` | overlap low while steps complete | `X` |
+| `ef_growth` | error-feedback norm grows | — |
+"""
+
+
+def _health_cfg(tmp_path, health_src=_HEALTH_SRC, obs_doc=_OBS_DOC_HEALTH):
+    cfg = make_tree(tmp_path, obs_doc=obs_doc,
+                    extra={"health.py": health_src})
+    cfg.health_module = "mypkg/health.py"
+    return cfg
+
+
+def test_health_rule_clean_when_in_sync(tmp_path):
+    assert run(tmp_path, _health_cfg(tmp_path)) == []
+
+
+def test_health_rule_fires_on_undocumented_rule(tmp_path):
+    src = ('RULE_IDS = (\n    "overlap_floor",\n    "ef_growth",\n'
+           '    "ghost_rule",\n)\n')
+    fs = run(tmp_path, _health_cfg(tmp_path, health_src=src))
+    assert len(fs) == 1 and fs[0].rule == "health-rule"
+    assert fs[0].path == "mypkg/health.py" and fs[0].line == 4
+    assert "ghost_rule" in fs[0].message
+
+
+def test_health_rule_fires_on_dead_doc_row(tmp_path):
+    doc = _OBS_DOC_HEALTH + "| `retired_rule` | fires on nothing | — |\n"
+    fs = run(tmp_path, _health_cfg(tmp_path, obs_doc=doc))
+    assert len(fs) == 1 and fs[0].path == "docs/obs.md"
+    assert "retired_rule" in fs[0].message
+    assert "dead doc row" in fs[0].message
+
+
+def test_health_rule_missing_table_is_one_finding(tmp_path):
+    # rules declared, no `| Rule |` table anywhere: one doc finding,
+    # not one per declared id
+    fs = run(tmp_path, _health_cfg(tmp_path, obs_doc=_OBS_DOC))
+    assert len(fs) == 1 and fs[0].path == "docs/obs.md"
+    assert "health-rule table" in fs[0].message
+
+
+def test_health_rule_missing_rule_ids_is_a_finding(tmp_path):
+    fs = run(tmp_path, _health_cfg(tmp_path, health_src="X = 1\n"))
+    assert len(fs) == 1 and fs[0].path == "mypkg/health.py"
+    assert "RULE_IDS" in fs[0].message
+
+
+def test_health_rule_inert_without_health_module(tmp_path):
+    # a Rule table with no health module configured under the tree is
+    # documentation, not drift (and the metric-name parser must not eat
+    # its backtick spans as metric rows)
+    cfg = make_tree(tmp_path, obs_doc=_OBS_DOC_HEALTH)
+    assert run(tmp_path, cfg) == []
+
+
+def test_health_rule_pragma_suppresses(tmp_path):
+    src = ('RULE_IDS = (\n    "overlap_floor",\n    "ef_growth",\n'
+           '    # bpslint: ignore[health-rule] reason=staged rollout, the doc row lands with the engine change\n'
+           '    "ghost_rule",\n)\n')
+    assert run(tmp_path, _health_cfg(tmp_path, health_src=src)) == []
+
+
 # -- configuration ----------------------------------------------------------
 
 def test_config_unknown_key_rejected(tmp_path):

@@ -577,8 +577,14 @@ def test_obs_cluster_3proc_chaos_kill_flight_recorder(tmp_path):
                                           timeout=5)
             except (ConnectionError, TimeoutError):
                 break             # survivors finished; bus gone
+            # the bus caches each rank's LAST sync frame, so right after
+            # the shrink a survivor's cached snapshot can still be the
+            # epoch-0 one — poll until the snapshots themselves have
+            # caught up, not just the bus epoch
             if (not out.get("local_only") and out["epoch"] == 1
-                    and {0, 2} <= set(out["ranks"])):
+                    and {0, 2} <= set(out["ranks"])
+                    and all(out["ranks"][r]["metrics"].get("epoch") == 1
+                            for r in (0, 2))):
                 cluster = out
                 break
             time.sleep(0.3)

@@ -283,6 +283,70 @@ def _trace_ok(trc: dict, floor: dict, tol: float) -> bool:
             and trc["events_buffered"] > 0)
 
 
+def _measure_ts_sampler(nbytes=4 * MB, reps=9):
+    """Time-series sampler overhead lane (ISSUE 16 acceptance: the
+    history plane's registry sampler is cheap enough to leave armed in
+    production — it runs inside every trained process).
+
+    Interleaved per-rep pairs on ONE engine: each rep times a push with
+    the sampler idle, then a push followed by a forced ``sample_once()``
+    (one full registry snapshot + delta-encode + ring append per PUSH —
+    hundreds of times denser than the production 2 s cadence, so the
+    gate bounds a gross worst case).  The ratio (off wall / on wall)
+    cancels host regime exactly like the engine-vs-fused pairing; gated
+    against ``ts_sampler_overhead_floor`` with the lane tolerance."""
+    import jax
+    import numpy as np
+
+    from byteps_tpu.comm.mesh import CommContext, _build_mesh
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.common.timeseries import TimeSeriesStore
+    from byteps_tpu.core.engine import PushPullEngine
+
+    devices = jax.devices()
+    comm = CommContext(mesh=_build_mesh(devices, 1), n_dcn=1,
+                       n_ici=len(devices))
+    store = TimeSeriesStore(interval_s=2.0, window=64)
+    cfg = Config(telemetry_on=True, trace_on=False)
+    eng = PushPullEngine(comm, cfg)
+    try:
+        x = np.random.RandomState(2).randn(nbytes // 4).astype(np.float32)
+        eng.declare_tensor("ts.pp", x.shape, np.float32)
+        for _ in range(24):
+            eng.push_pull_local(x, "ts.pp")
+            if eng.planner.locked(nbytes):
+                break
+        store.sample_once()          # warm the sampler's branches
+        ratios = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            eng.push_pull_local(x, "ts.pp")
+            t_off = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            eng.push_pull_local(x, "ts.pp")
+            store.sample_once()
+            t_on = time.perf_counter() - t0
+            ratios.append(t_off / t_on)   # sampled/unsampled throughput
+
+        def med(xs):
+            m, _, _ = quantile_stats_raw(xs)
+            return m
+        return {"samples": len(store.points()),
+                "overhead_ratio": round(med(ratios), 3),
+                "ratio_per_rep": [round(r, 3) for r in sorted(ratios)]}
+    finally:
+        eng.shutdown(wait=False)
+
+
+def _ts_ok(ts: dict, floor: dict, tol: float) -> bool:
+    """The sampler must not cost more than the floor allows AND must
+    actually have filled the ring (a 1.0 ratio with an empty ring would
+    mean the lane silently stopped sampling)."""
+    gate = floor.get("ts_sampler_overhead_floor", 0.95) * (1.0 - tol)
+    ts["gate_ratio"] = round(gate, 3)
+    return ts["overhead_ratio"] >= gate and ts["samples"] > 0
+
+
 def _measure_transport(nbytes=256 * 1024, reps=30):
     """Transport lane (comm/transport.py, docs/transport.md): the
     loopback-vs-TCP throughput ratio for seq-tokened KV deltas
@@ -516,6 +580,7 @@ def main() -> int:
     out["straggler"] = _measure_straggler()
     out["compressed"] = _measure_compressed()
     out["trace"] = _measure_trace()
+    out["ts_sampler"] = _measure_ts_sampler()
     out["transport"] = _measure_transport()
     out["serve_dist"] = _measure_serve_dist()
     if "--update-floor" in sys.argv:
@@ -531,6 +596,10 @@ def main() -> int:
                  "compressed_quality_ceiling": 0.55,
                  "compressed_throughput_floor": round(worst_tput / 2, 3),
                  "trace_sample_overhead_floor": 0.7,
+                 # ts sampler: one registry snapshot per push costs
+                 # near-nothing next to a 4 MB collective — 0.95 is the
+                 # always-on contract, not a host measurement
+                 "ts_sampler_overhead_floor": 0.95,
                  # transport: half the measured TCP/loopback ratio
                  # (host-noise room, still catches a wire-machinery
                  # collapse); the p99 ceiling is an absolute isolation
@@ -575,12 +644,14 @@ def main() -> int:
     compressed_ok = _compressed_ok(out["compressed"], floor, tol)
     trace_ok = _trace_ok(out["trace"], floor, tol)
     out["trace"]["ok"] = trace_ok
+    ts_ok = _ts_ok(out["ts_sampler"], floor, tol)
+    out["ts_sampler"]["ok"] = ts_ok
     transport_ok = _transport_ok(out["transport"], floor, tol)
     out["transport"]["ok"] = transport_ok
     serve_dist_ok = _serve_dist_ok(out["serve_dist"], floor, tol)
     out["serve_dist"]["ok"] = serve_dist_ok
     out["ok"] = (engine_ok and straggler_ok and compressed_ok and trace_ok
-                 and transport_ok and serve_dist_ok)
+                 and ts_ok and transport_ok and serve_dist_ok)
     print(json.dumps(out))
     if not engine_ok:
         print(f"bench-smoke FAIL: engine_vs_fused_ratio "
@@ -613,6 +684,14 @@ def main() -> int:
               f"{trc['gate_ratio']} (or the sampled stream recorded "
               f"nothing: {trc['events_buffered']} events) — always-on "
               f"sampling is no longer cheap enough to leave armed",
+              file=sys.stderr)
+    if not ts_ok:
+        tss = out["ts_sampler"]
+        print(f"bench-smoke FAIL: the time-series sampler costs too "
+              f"much: throughput ratio {tss['overhead_ratio']} < gate "
+              f"{tss['gate_ratio']} (or the ring recorded nothing: "
+              f"{tss['samples']} samples) — the always-on history "
+              f"plane is no longer cheap enough to leave armed",
               file=sys.stderr)
     if not serve_dist_ok:
         sd = out["serve_dist"]

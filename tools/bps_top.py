@@ -32,7 +32,7 @@ sys.path.insert(0, REPO)
 
 _COLUMNS = ("RANK", "ROLE", "GB/s", "QDEPTH", "INFLIGHT", "STALL%",
             "ATTRIB", "RETX", "PULLS", "SHED%", "ARC", "CONN", "CODEC",
-            "SLOW", "STATE", "EPOCH", "STEP", "AGE")
+            "TREND", "SLOW", "STATE", "EPOCH", "STEP", "AGE")
 
 
 def _conn_cell(gauges: dict) -> str:
@@ -98,9 +98,39 @@ def _shed_cell(counters: dict) -> str:
     return f"{100.0 * shed / pulls:.0f}%"
 
 
+def _trend_cell(hist: dict) -> str:
+    """The rank's throughput trend as a sparkline over its piggybacked
+    time-series window (``common/timeseries.py`` summary ``spark``
+    tail): mbps preferred, overlap fraction as the fallback on a rank
+    that moves no wire bytes.  '-' = no history posted yet."""
+    series = ((hist or {}).get("summary") or {}).get("series") or {}
+    st = series.get("mbps") or series.get("overlap") or {}
+    vals = st.get("spark") or []
+    if not vals:
+        return "-"
+    try:                                  # importable both as a script
+        from bps_doctor import sparkline  # (tools/ on path) and as the
+    except ImportError:                   # tools.bps_top module
+        from tools.bps_doctor import sparkline
+    return sparkline(vals)
+
+
+def _alert_rules(entry: dict) -> list:
+    """Firing health-rule ids from a rank's snapshot gauges (the
+    ``health.alerts_active{rule=}`` family; value 1 = firing)."""
+    import re
+    gauges = (entry.get("metrics") or {}).get("gauges") or {}
+    out = []
+    for series, v in gauges.items():
+        m = re.match(r'^health\.alerts_active\{rule="([^"]+)"\}$', series)
+        if m and v:
+            out.append(m.group(1))
+    return sorted(out)
+
+
 def _rank_row(rank: int, entry: dict, slow=None, probation=(),
               role: str = "trainer", arc: float = None,
-              label: str = None) -> tuple:
+              label: str = None, hist: dict = None) -> tuple:
     """One table row from a rank's cached snapshot (missing fields render
     as '-': a rank mid-transition posts partial snapshots).  ``slow`` is
     the bus's per-rank step-barrier phi score, ``probation`` the demoted
@@ -147,6 +177,9 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=(),
         _conn_cell(gauges),
         # compression (ISSUE 11): which codec(s) this rank's pushes ride
         _codec_cell(gauges),
+        # history (ISSUE 16): throughput sparkline over the rank's
+        # piggybacked time-series window
+        _trend_cell(hist),
         # gray-failure columns: the coordinator's phi suspicion of this
         # rank's step-barrier lag, and whether it is demoted right now
         fmt(slow, "{:.1f}"),
@@ -161,6 +194,7 @@ def render(cluster: dict) -> str:
     """The table for one cluster_metrics() reply (pure; unit-tested)."""
     slow = cluster.get("slow") or {}
     probation = set(cluster.get("probation") or ())
+    history = cluster.get("history") or {}
     rows = [_COLUMNS]
     ranks = cluster.get("ranks", {})
     coordinator = cluster.get("coordinator")
@@ -171,7 +205,8 @@ def render(cluster: dict) -> str:
         rows.append(_rank_row(
             rank, ranks.get(rank, {}), slow=slow.get(rank),
             probation=probation,
-            role="coordinator" if rank == coordinator else "trainer"))
+            role="coordinator" if rank == coordinator else "trainer",
+            hist=history.get(rank)))
     # serving-tier rows (server/serving_tier.py): every host in the
     # bus's serving directory is a first-class row — id prefixed 's',
     # ROLE=serve, ring-arc share from the same ring math every client
@@ -206,10 +241,19 @@ def render(cluster: dict) -> str:
                  "answering, local-only view)")
     elif cluster.get("local_only"):
         head += " (local-only view: no membership bus)"
-    lines = [
-        head,
-        "  ".join(c.rjust(w) for c, w in zip(rows[0], widths)),
-    ]
+    lines = [head]
+    # health banner (ISSUE 16): every firing SLO rule, named per rank,
+    # from the health.alerts_active{rule=} gauges riding the snapshots —
+    # the same source a --once --json consumer reads, so the banner and
+    # the JSON never disagree
+    firing = {rank: _alert_rules(entry)
+              for rank, entry in sorted(ranks.items())}
+    firing = {r: rules for r, rules in firing.items() if rules}
+    if firing:
+        lines.append("ALERTS: " + "; ".join(
+            "rank %s: %s" % (r, ",".join(rules))
+            for r, rules in firing.items()))
+    lines.append("  ".join(c.rjust(w) for c, w in zip(rows[0], widths)))
     for row in rows[1:]:
         lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
     missing = sorted(set(cluster.get("world", []))

@@ -19,7 +19,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="bpslint",
         description="Project-invariant analyzer: env-knob / metric-name /"
-                    " chaos-site / lock-discipline drift, bidirectional.")
+                    " chaos-site / lock-discipline / health-rule drift, "
+                    "bidirectional.")
     ap.add_argument("paths", nargs="*",
                     help="directories/files to scan (default: "
                          "[tool.bpslint] paths from pyproject.toml)")

@@ -19,7 +19,8 @@ from typing import Dict, List, Optional
 
 # Rule names are the analyzer's public contract: pragma rule lists and
 # the enable/disable config are validated against this set.
-RULE_NAMES = ("env-knob", "metric-name", "chaos-site", "lock-discipline")
+RULE_NAMES = ("env-knob", "metric-name", "chaos-site", "lock-discipline",
+              "health-rule")
 
 # bare "sleep" matches any receiver (time.sleep included); a dotted
 # entry would narrow a spec to one receiver, so none is needed here
@@ -45,6 +46,7 @@ class BpslintConfig:
     env_doc: str = "docs/env.md"
     metrics_doc: str = "docs/observability.md"
     injector_module: str = "byteps_tpu/fault/injector.py"
+    health_module: str = "byteps_tpu/common/health.py"
     blocking_calls: List[str] = dataclasses.field(
         default_factory=lambda: list(_DEFAULT_BLOCKING))
     callback_names: List[str] = dataclasses.field(
@@ -66,6 +68,7 @@ _TOP_KEYS = {
     "env-doc": ("env_doc", str),
     "metrics-doc": ("metrics_doc", str),
     "injector-module": ("injector_module", str),
+    "health-module": ("health_module", str),
 }
 _LOCK_KEYS = {
     "blocking-calls": ("blocking_calls", list),

@@ -263,7 +263,8 @@ def run(root: Path, cfg: Optional[BpslintConfig] = None,
         paths: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run every enabled rule over the tree; returns unsuppressed
     findings sorted by (path, line)."""
-    from . import rules_chaos, rules_env, rules_locks, rules_metrics
+    from . import (rules_chaos, rules_env, rules_health, rules_locks,
+                   rules_metrics)
     if cfg is None:
         from .config import load_config
         cfg = load_config(root)
@@ -285,6 +286,7 @@ def run(root: Path, cfg: Optional[BpslintConfig] = None,
         "metric-name": rules_metrics.check,
         "chaos-site": rules_chaos.check,
         "lock-discipline": rules_locks.check,
+        "health-rule": rules_health.check,
     }
     for rule in cfg.enabled_rules():
         findings.extend(checkers[rule](tree))

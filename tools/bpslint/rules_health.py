@@ -1,0 +1,119 @@
+"""Rule ``health-rule``: SLO health-rule drift, bidirectionally.
+
+Contract (common/health.py module docstring):
+
+1. every rule id in the health module's ``RULE_IDS`` literal tuple must
+   have a row in the ``docs/observability.md`` health-rule table (the
+   one whose header row starts with ``| Rule |``) — an operator paged
+   by ``health.alerts_active{rule=}`` must be able to look the rule up;
+   and
+2. every rule id in that table must appear in ``RULE_IDS`` — a renamed
+   or deleted rule cannot leave a live-looking doc row behind.
+
+The rule is inert when the configured health module does not exist
+(``health-module`` in ``[tool.bpslint]``): a project without an SLO
+engine has no table to drift from.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, LintTree
+
+_ID_SPAN = re.compile(r"`([^`]+)`")
+_ID_SHAPE = re.compile(r"^[a-z0-9_]+$")
+
+
+def doc_rules(lines: List[str]) -> Dict[str, int]:
+    """``{rule id: line}`` from the table whose header row starts with
+    ``| Rule |`` (same grammar as the metric-name table: ids are
+    backtick spans in the first column)."""
+    out: Dict[str, int] = {}
+    in_table = False
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if cells and cells[0] == "Rule":
+            in_table = True
+            continue
+        if not in_table or not cells:
+            continue
+        if set(cells[0]) <= set("-: "):
+            continue
+        for span in _ID_SPAN.findall(cells[0]):
+            if _ID_SHAPE.match(span):
+                out.setdefault(span, i)
+    return out
+
+
+def declared_rules(pf) -> Optional[List[Tuple[str, int]]]:
+    """``(rule id, line)`` entries of the health module's module-level
+    ``RULE_IDS`` literal tuple/list; None when no such assignment
+    exists (itself a finding — the table has no code anchor)."""
+    if pf.tree is None:
+        return None
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "RULE_IDS"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return None
+        out = []
+        for elt in node.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append((elt.value, elt.lineno))
+        return out
+    return None
+
+
+def check(tree: LintTree) -> List[Finding]:
+    cfg = tree.cfg
+    pf = tree.file(cfg.health_module)
+    if pf is None:
+        return []   # no SLO engine in this tree — nothing to drift
+    declared = declared_rules(pf)
+    if declared is None:
+        return [Finding(
+            "health-rule", pf.rel, 1,
+            "health module declares no literal RULE_IDS tuple — the "
+            "health-rule table cannot be checked against it")]
+
+    lines = tree.doc_text(cfg.metrics_doc)
+    if lines is None:
+        return [Finding("health-rule", cfg.metrics_doc, 1,
+                        "metrics doc missing — the health-rule rule has "
+                        "no documentation source")]
+    documented = doc_rules(lines)
+    if declared and not documented:
+        return [Finding(
+            "health-rule", cfg.metrics_doc, 1,
+            "no `| Rule | ... |` health-rule table found — every "
+            "RULE_IDS entry needs a documented row (operators look "
+            "firing rules up here)")]
+
+    findings: List[Finding] = []
+    declared_ids = {rid for rid, _ in declared}
+    for rid, line in declared:
+        if rid not in documented:
+            findings.append(Finding(
+                "health-rule", pf.rel, line,
+                f"health rule {rid!r} is declared in RULE_IDS but has "
+                f"no row in the {cfg.metrics_doc} health-rule table — "
+                f"document what fires it and what clears it"))
+    if tree.requested_path(cfg.metrics_doc):
+        for rid, line in sorted(documented.items()):
+            if rid not in declared_ids:
+                findings.append(Finding(
+                    "health-rule", cfg.metrics_doc, line,
+                    f"documented health rule {rid!r} is not declared in "
+                    f"{cfg.health_module} RULE_IDS — dead doc row "
+                    f"(delete it, or declare the rule)"))
+    return findings

@@ -14,7 +14,7 @@
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
 #               | serve_dist | straggler | compressed | trace
-#               | transport | lint | all
+#               | transport | doctor | lint | all
 #         serve_dist: the distributed-serving-tier chaos slice
 #              (server/serving_tier.py, docs/serving.md) — ≥3 real
 #              serving-host processes behind the TCP transport serve a
@@ -67,6 +67,18 @@
 #              serving tail under one slow endpoint
 #              (tests/test_straggler.py, tests/test_serving.py hedge
 #              tests, tests/test_sync_deadline.py stall guards)
+#         doctor: the history/health slice (ISSUE 16) — a 3-process run
+#              on a fast sampling cadence under a sustained straggler
+#              fault (slow:rank=1:site=sync) with a slow_socket rule
+#              armed: the matching health rules fire on the victim
+#              within a few sampling windows, its /healthz flips to 503
+#              (and back to 200 after the fault budget exhausts and K
+#              clean windows pass), cluster_metrics() grows the history
+#              view, and bps_doctor --postmortem over the run's flight
+#              dumps + saved /timeseries windows names the culprit rank
+#              and injection site (tests/test_doctor_chaos.py), plus
+#              the in-process ring/health unit pins
+#              (tests/test_timeseries_health.py)
 #         obs: the observability-under-chaos slice — every rank of a
 #              3-process chaos run serves /metrics//healthz, the
 #              membership bus answers cluster_metrics, and a
@@ -111,6 +123,7 @@ case "${1:-}" in
     compressed) MARK="chaos or integrity"; KEXPR="compress"; shift ;;
     transport) MARK="chaos or integrity"; KEXPR="transport"; shift ;;
     trace)     MARK="chaos"; KEXPR="trace or attrib"; shift ;;
+    doctor)    MARK="chaos"; KEXPR="doctor or timeseries or health"; shift ;;
     all)       MARK="chaos or integrity"; shift ;;
     lint)
         shift
