@@ -227,12 +227,17 @@ def _abort_socket(sock: socket.socket) -> None:
         pass
 
 
-def _chaos_send(sock: socket.socket, data: bytes) -> None:
+def _chaos_send(sock: socket.socket, data: bytes, peer: int = -1) -> None:
     """One frame onto the wire, through the socket-level chaos gate.
     ``partition`` and ``drop`` blackhole the frame (the caller's send
     deadline surfaces the silence); ``conn_reset``/``partial_write``
-    tear the connection down like the real failures they model."""
+    tear the connection down like the real failures they model.  A
+    ranks-scoped partition (``partition:ranks=A|B``) blackholes only
+    frames whose ``peer`` is across the cut — callers that know the
+    remote rank pass it."""
     if _fault.ENABLED:
+        if peer >= 0 and _fault.edge_cut(peer):
+            return  # severed edge: bytes vanish, connection stays "up"
         act = _fault.socket_fault("transport", "send")
         if act == "partition":
             return  # blackholed: bytes vanish, connection stays "up"
@@ -252,15 +257,18 @@ def _chaos_send(sock: socket.socket, data: bytes) -> None:
     sock.sendall(data)
 
 
-def _chaos_recv_gate(sock: socket.socket) -> Optional[str]:
+def _chaos_recv_gate(sock: socket.socket, peer: int = -1) -> Optional[str]:
     """Chaos decision for ONE received frame — consulted AT ARRIVAL
     time (deciding before the blocking read would let a pre-partition
     verdict swallow a frame arriving after the partition healed).
     ``conn_reset`` kills the socket here; ``partition`` tells the
     caller to discard the frame (a deaf peer still drains its TCP
-    buffers)."""
+    buffers).  ``peer`` scopes ranks-partitions to the severed edges
+    only."""
     if not _fault.ENABLED:
         return None
+    if peer >= 0 and _fault.edge_cut(peer):
+        return "partition"
     act = _fault.socket_fault("transport", "recv")
     if act == "conn_reset":
         _abort_socket(sock)
@@ -370,9 +378,9 @@ class Connection:
     # -- the supervisor -----------------------------------------------------
 
     def _dial(self) -> socket.socket:
-        if (_fault.ENABLED
-                and _fault.socket_fault("transport", "connect")
-                == "partition"):
+        if _fault.ENABLED and (
+                _fault.socket_fault("transport", "connect") == "partition"
+                or _fault.edge_cut(self.peer)):
             raise ConnectionRefusedError("injected partition (chaos)")
         sock = socket.create_connection(self.addr,
                                         timeout=self._connect_timeout)
@@ -383,7 +391,8 @@ class Connection:
             # means the server actually answered, not just SYN/ACK
             _chaos_send(sock, _pack_frame(OP_HELLO, 0,
                                           {"rank": self.rank,
-                                           "peer": self.peer}))
+                                           "peer": self.peer}),
+                        self.peer)
             sock.settimeout(self._connect_timeout)
             op, _rid, _meta, _payload = _read_frame(sock)
             if op != OP_ACK:
@@ -463,7 +472,7 @@ class Connection:
         while True:
             try:
                 op, req_id, meta, payload = _read_frame(sock)
-                discard = _chaos_recv_gate(sock) == "partition"
+                discard = _chaos_recv_gate(sock, self.peer) == "partition"
             except ConnectionResetError as e:
                 counters.inc("transport.conn_resets")
                 return repr(e)
@@ -567,7 +576,7 @@ class Connection:
             try:
                 with self._send_mutex:
                     self._last_send = t0
-                    _chaos_send(sock, frame)
+                    _chaos_send(sock, frame, self.peer)
             except ConnectionResetError as e:
                 counters.inc("transport.conn_resets")
                 self._kill_socket()
@@ -750,7 +759,9 @@ class TransportServer:
             while True:
                 try:
                     op, req_id, meta, payload = _read_frame(sock)
-                    discard = _chaos_recv_gate(sock) == "partition"
+                    with self._lock:
+                        peer = self._conns.get(sock, -1)
+                    discard = _chaos_recv_gate(sock, peer) == "partition"
                 except ConnectionResetError:
                     counters.inc("transport.conn_resets")
                     return
@@ -809,8 +820,9 @@ class TransportServer:
             with self._lock:
                 if self._closed:
                     return False
+                peer = self._conns.get(sock, -1)
             with send_lock:
-                _chaos_send(sock, reply)
+                _chaos_send(sock, reply, peer)
             return True
         except OSError:
             return False

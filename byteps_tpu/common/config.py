@@ -100,6 +100,27 @@ def trace_dir_from_env() -> str:
     return os.environ.get("BYTEPS_TRACE_DIR") or _default_trace_dir()
 
 
+def _default_flight_dir() -> str:
+    """Default crash-dump location when ``BYTEPS_FLIGHT_DIR`` is unset:
+    a stable per-USER tmp subdir, mirroring :func:`_default_trace_dir`.
+    Dumping to cwd was the old default and it leaks ``bps_flight_*.json``
+    files into whatever directory the process happened to start in
+    (source trees included)."""
+    try:
+        who = str(os.getuid())
+    except AttributeError:  # no getuid (non-POSIX)
+        who = os.environ.get("USERNAME") or os.environ.get("USER") or "user"
+    return os.path.join(tempfile.gettempdir(), f"byteps_flight_{who}")
+
+
+def flight_dir_from_env() -> str:
+    """``BYTEPS_FLIGHT_DIR`` if set and non-empty, else the per-user tmp
+    default — the ONE derivation shared by the Config field default and
+    ``Config.from_env`` (a set-but-EMPTY var must not send crash dumps
+    to cwd)."""
+    return os.environ.get("BYTEPS_FLIGHT_DIR") or _default_flight_dir()
+
+
 def _parse_trace_sample(spec: str) -> int:
     """``BYTEPS_TRACE_SAMPLE`` grammar: '' / '0' = off; 'N' or '1/N' =
     capture every Nth push.  Lives here (not common/tracing.py) so
@@ -315,6 +336,34 @@ class Config:
     #                                  BYTEPS_MEMBERSHIP_SYNC_TIMEOUT: step
     #                                  barrier quorum window; a member
     #                                  missing past it is failure evidence
+    bus_retries: int = 64            # BYTEPS_BUS_RETRIES: bus-client
+    #                                  attempt ceiling (membership sync /
+    #                                  shrink hello) — how long a worker
+    #                                  rides out a coordinator failover
+    #                                  before escalating; detection-vs-
+    #                                  patience dial, was a hardcoded 64
+
+    # --- gossip membership (fault/gossip.py) ---
+    gossip_on: bool = False          # BYTEPS_GOSSIP_ON: SWIM-style
+    #                                  gossip membership plane — per-rank
+    #                                  table (incarnation/state/heartbeat)
+    #                                  anti-entropy over the bus, and
+    #                                  quorum-gated world agreement: a
+    #                                  shrink commits only with a strict
+    #                                  majority of the last agreed world
+    #                                  reachable; the minority parks
+    gossip_interval_s: float = 0.2   # BYTEPS_GOSSIP_INTERVAL_S:
+    #                                  anti-entropy exchange period
+    gossip_fanout: int = 3           # BYTEPS_GOSSIP_FANOUT: random peers
+    #                                  contacted per gossip period (k)
+    gossip_suspect_s: float = 1.0    # BYTEPS_GOSSIP_SUSPECT_S: no
+    #                                  heartbeat progress for this long
+    #                                  marks a rank suspect (refutable
+    #                                  via incarnation bump)
+    gossip_dead_s: float = 3.0       # BYTEPS_GOSSIP_DEAD_S: suspect for
+    #                                  this long (beyond suspect onset)
+    #                                  marks a rank dead; must exceed
+    #                                  gossip_suspect_s
 
     # --- parameter serving (server/serving.py, server/serve_client.py) ---
     serve_replicas: int = 1          # BYTEPS_SERVE_REPLICAS: total shards
@@ -560,11 +609,12 @@ class Config:
     #                                  detector trip/quarantine/chaos
     #                                  kill (common/flight_recorder.py)
     flight_capacity: int = 4096      # BYTEPS_FLIGHT_CAPACITY: ring size
-    flight_dir: str = dataclasses.field(
-        default_factory=lambda: os.environ.get("BYTEPS_FLIGHT_DIR", "."))
-    #                                  BYTEPS_FLIGHT_DIR: dump directory.
-    #                                  The env var backs the DEFAULT even
-    #                                  for explicitly constructed
+    flight_dir: str = dataclasses.field(default_factory=flight_dir_from_env)
+    #                                  BYTEPS_FLIGHT_DIR: dump directory
+    #                                  (unset/empty = a per-user tmp
+    #                                  subdir, never cwd).  The env var
+    #                                  backs the DEFAULT even for
+    #                                  explicitly constructed
     #                                  Config(...) objects: a crash dump
     #                                  must land where the operator (or
     #                                  the test harness) pointed, not in
@@ -654,6 +704,20 @@ class Config:
         if (self.membership_rendezvous_timeout_s <= 0
                 or self.membership_sync_timeout_s <= 0):
             raise ValueError("membership timeouts must be positive")
+        if self.bus_retries < 1:
+            raise ValueError("bus_retries must be >= 1 (at least one "
+                             "attempt)")
+        if self.gossip_interval_s <= 0:
+            raise ValueError("gossip_interval_s must be positive")
+        if self.gossip_fanout < 1:
+            raise ValueError("gossip_fanout must be >= 1")
+        if self.gossip_suspect_s <= 0:
+            raise ValueError("gossip_suspect_s must be positive")
+        if self.gossip_dead_s <= self.gossip_suspect_s:
+            raise ValueError(
+                "gossip_dead_s must exceed gossip_suspect_s — a rank "
+                "must pass through suspect (the refutation window) "
+                "before it can be declared dead")
         if self.sync_deadline_s < 0:
             raise ValueError("sync_deadline_s must be >= 0 (0 = off)")
         if not 0 <= self.membership_port < 65536:
@@ -822,6 +886,12 @@ class Config:
             failure_exit_code=_env_int("BYTEPS_FAILURE_EXIT_CODE", 17),
             sync_deadline_s=_env_float("BYTEPS_SYNC_DEADLINE_S", 0.0),
             membership_hosts=_env_str("BYTEPS_MEMBERSHIP_HOSTS", ""),
+            bus_retries=_env_int("BYTEPS_BUS_RETRIES", 64),
+            gossip_on=_env_bool("BYTEPS_GOSSIP_ON", False),
+            gossip_interval_s=_env_float("BYTEPS_GOSSIP_INTERVAL_S", 0.2),
+            gossip_fanout=_env_int("BYTEPS_GOSSIP_FANOUT", 3),
+            gossip_suspect_s=_env_float("BYTEPS_GOSSIP_SUSPECT_S", 1.0),
+            gossip_dead_s=_env_float("BYTEPS_GOSSIP_DEAD_S", 3.0),
             straggler_policy=_env_str("BYTEPS_STRAGGLER_POLICY",
                                       "wait").strip().lower(),
             slowness_phi=_env_float("BYTEPS_SLOWNESS_PHI", 8.0),
@@ -891,7 +961,7 @@ class Config:
             obs_host=_env_str("BYTEPS_OBS_HOST", "127.0.0.1"),
             flight_recorder_on=_env_bool("BYTEPS_FLIGHT_RECORDER", True),
             flight_capacity=_env_int("BYTEPS_FLIGHT_CAPACITY", 4096),
-            flight_dir=_env_str("BYTEPS_FLIGHT_DIR", "."),
+            flight_dir=flight_dir_from_env(),
             flight_dump_on_exit=_env_bool("BYTEPS_FLIGHT_DUMP_ON_EXIT",
                                           False),
             ts_on=_env_bool("BYTEPS_TS_ON", True),

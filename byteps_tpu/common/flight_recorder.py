@@ -81,7 +81,8 @@ class FlightRecorder:
             from .config import get_config
             return get_config().flight_dir
         except Exception:  # noqa: BLE001 — dumping must never fail on config
-            return "."
+            import tempfile
+            return tempfile.gettempdir()
 
     # -- recording ---------------------------------------------------------
 

@@ -37,6 +37,7 @@ RULE_IDS = (
     "ef_growth",
     "attrib_skew",
     "slow_peer",
+    "quorum_loss",
 )
 
 _BURN_RULES = {
@@ -154,6 +155,20 @@ class HealthEngine:
             if skews:
                 out["attrib_skew"] = {"worst": skews[0],
                                       "count": len(skews)}
+
+        # quorum loss: the gossip plane says a strict majority of the
+        # last agreed world is NOT reachable from here — this side of a
+        # partition cannot commit epochs (fault/gossip.py quorum_ok)
+        qprov = _quorum_provider
+        if qprov is not None:
+            try:
+                q = qprov() or {}
+                reach = int(q.get("reachable", 0))
+                world = int(q.get("world", 0))
+            except Exception:  # noqa: BLE001 — same tick-safety contract
+                reach = world = 0
+            if world >= 2 and 2 * reach <= world:
+                out["quorum_loss"] = {"reachable": reach, "world": world}
         return out
 
     # -- the state machine ----------------------------------------------
@@ -193,6 +208,7 @@ _engine_lock = threading.Lock()
 _engine: Optional[HealthEngine] = None
 _enabled = True
 _cluster_history_provider: Optional[Callable[[], Dict[int, dict]]] = None
+_quorum_provider: Optional[Callable[[], Dict[str, int]]] = None
 
 
 def configure(cfg) -> None:
@@ -223,6 +239,23 @@ def clear_cluster_history_provider(fn) -> None:
         _cluster_history_provider = None
 
 
+def set_quorum_provider(
+        fn: Optional[Callable[[], Dict[str, int]]]) -> None:
+    """Registered by the gossip agent: returns ``{"reachable": R,
+    "world": W}`` against the last agreed world, feeding the
+    ``quorum_loss`` rule."""
+    global _quorum_provider
+    _quorum_provider = fn
+
+
+def clear_quorum_provider(fn) -> None:
+    """Unregister ``fn`` if it is still the active provider (same
+    contract as :func:`clear_cluster_history_provider`)."""
+    global _quorum_provider
+    if _quorum_provider is fn:
+        _quorum_provider = None
+
+
 def evaluate(store) -> None:
     """One tick: called by the time-series sampler after each sample."""
     eng = _engine
@@ -242,8 +275,9 @@ def get_engine() -> Optional[HealthEngine]:
 
 
 def _reset_for_tests() -> None:
-    global _engine, _enabled, _cluster_history_provider
+    global _engine, _enabled, _cluster_history_provider, _quorum_provider
     with _engine_lock:
         _engine = None
         _enabled = True
         _cluster_history_provider = None
+        _quorum_provider = None

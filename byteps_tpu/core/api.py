@@ -12,6 +12,7 @@ machines.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -53,7 +54,9 @@ def init(config: Optional[Config] = None,
             fault_injector.arm(cfg.fault_spec, seed=cfg.fault_seed,
                                rank=cfg.host_id)
         else:
-            fault_injector.disarm()
+            # engine-scoped only: a persist-armed injector (e.g. a
+            # partition blackhole) outlives the resume it provoked
+            fault_injector.disarm(engine_scoped_only=True)
         comm = mesh_mod.bootstrap(cfg, devices=devices)
         engine = PushPullEngine(comm, cfg)
         if cfg.heartbeat_on and jax.process_count() > 1:
@@ -140,9 +143,11 @@ def shutdown(wait: bool = True) -> None:
         _engine = None
         mesh_mod.shutdown_comm()
         # chaos disarms with the engine; a subsequent init()/resume()
-        # re-arms from config (fresh step counter, same seeded schedule)
+        # re-arms from config (fresh step counter, same seeded schedule).
+        # persist-armed chaos (partition blackholes) stays: the network
+        # does not heal because the engine suspended
         from ..fault import injector as fault_injector
-        fault_injector.disarm()
+        fault_injector.disarm(engine_scoped_only=True)
 
 
 def membership_epoch() -> int:
@@ -378,6 +383,37 @@ def cluster_metrics(bus: Optional[str] = None,
     from ..fault import membership as _membership
     m = _membership.active_membership()
     view = m.view() if (bus is None and m is not None) else None
+    if view is not None and getattr(m, "gossip", None) is not None:
+        # gossip-local answer (ISSUE 17): the SWIM table already holds
+        # every rank's piggybacked metrics/history payloads, so the
+        # query needs NO bus round-trip — and keeps working on either
+        # side of a partition, where the bus may be unreachable
+        table = m.gossip
+        now = time.time()
+        out = {"epoch": _membership.current_epoch(),
+               "world": list(view.world), "gossip": True,
+               "states": table.snapshot(), "ranks": {}, "history": {}}
+        for kind, dest in (("metrics", out["ranks"]),
+                           ("history", out["history"])):
+            for r, v in table.payloads_of_kind(kind).items():
+                if not isinstance(v, dict) or "t" not in v:
+                    continue
+                age = max(0.0, now - float(v["t"]))
+                dest[int(r)] = (
+                    {"age_s": round(age, 3), "metrics": v.get("v")}
+                    if kind == "metrics"
+                    else {"age_s": round(age, 3), "summary": v.get("v")})
+        sd = table.payloads_of_kind("serve_dir")
+        if sd:
+            newest = max(sd.values(),
+                         key=lambda p: p.get("t", 0)
+                         if isinstance(p, dict) else 0)
+            if isinstance(newest, dict):
+                d = newest.get("v") or {}
+                out["serve_hosts"] = {int(h): v for h, v in
+                                      (d.get("hosts") or {}).items()}
+                out["serve_gen"] = d.get("gen", 0)
+        return out
     if view is not None:
         # the live membership already tracks the bus through failovers
         # (including explicitly-constructed addresses no env resolution

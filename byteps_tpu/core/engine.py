@@ -360,6 +360,14 @@ class PushPullEngine:
         """
         if not self._running:
             raise RuntimeError("engine is shut down")
+        if _membership.is_parked():
+            # minority side of a partition: no epoch can be agreed from
+            # here, so fail the enqueue loudly instead of queueing work
+            # a suspended engine will never complete
+            raise RuntimeError(
+                "membership is parked on the minority side of a "
+                "partition (membership.partition_minority): wait for "
+                "the partition to heal, then rejoin()")
         if _fault.ENABLED:
             # one "step" per enqueued tensor: kill:step=N counts these
             _fault.on_step()

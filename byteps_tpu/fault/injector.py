@@ -158,7 +158,7 @@ VALID_KINDS = ("bitflip", "conn_reset", "delay", "drop", "kill",
 VALID_SITES = (
     # bpslint: ignore[chaos-site] reason=kill-only predicate matched in on_step (die while hosting the control plane), never a woven fire() site
     "coordinator",
-    "dcn", "dispatch", "heartbeat", "kv_push",
+    "dcn", "dispatch", "gossip", "heartbeat", "kv_push",
     "serve_host",
     "serve_pull", "server_pull", "server_push", "sync", "transport")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
@@ -180,7 +180,7 @@ _KIND_FIELDS = {
     "slow": ("rank", "site", "ms", "n"),
     "drop": ("rank", "site", "p"),
     "bitflip": ("rank", "site", "p"),
-    "partition": ("rank", "site", "n"),
+    "partition": ("rank", "site", "n", "ranks", "ms"),
     "conn_reset": ("rank", "site", "p", "n"),
     "partial_write": ("rank", "site", "p", "n"),
     "slow_socket": ("rank", "site", "p", "ms"),
@@ -200,11 +200,11 @@ class FaultRule:
     changes over a run, guarded by the injector's lock."""
 
     __slots__ = ("kind", "site", "rank", "step", "p", "ms", "code", "n",
-                 "left", "skey", "rng")
+                 "left", "skey", "rng", "ranks", "cut_t0", "healed")
 
     def __init__(self, kind: str, site: Optional[str], rank: Optional[int],
                  step: Optional[int], p: float, ms: float, code: int,
-                 n: Optional[int] = None):
+                 n: Optional[int] = None, ranks=None):
         self.kind = kind
         self.site = site
         self.rank = rank
@@ -216,6 +216,13 @@ class FaultRule:
         self.left = n
         self.skey: Optional[str] = None  # lifetime-budget key (slow only)
         self.rng: Optional[random.Random] = None  # bound by FaultInjector
+        # ranks-partition state (kind=partition with ranks=A|B): the two
+        # sides as frozensets, the monotonic time of the FIRST severed
+        # edge (the heal clock's zero when ms= is set), and the healed
+        # latch — a healed partition never cuts again
+        self.ranks = ranks
+        self.cut_t0: Optional[float] = None
+        self.healed = False
 
     def __repr__(self) -> str:  # actionable in logs and error messages
         parts = [self.kind]
@@ -223,6 +230,10 @@ class FaultRule:
             v = getattr(self, f)
             if v is not None:
                 parts.append(f"{f}={v}")
+        if self.ranks is not None:
+            parts.append("ranks=%s|%s" % (
+                ".".join(map(str, sorted(self.ranks[0]))),
+                ".".join(map(str, sorted(self.ranks[1])))))
         return ":".join(parts)
 
 
@@ -294,6 +305,34 @@ def parse_spec(spec: str) -> List[FaultRule]:
                                       "p/ms numbers") from None
         if not 0.0 < p <= 1.0:
             raise _fail(spec, clause, f"p={p} must be in (0, 1]")
+        ranks = None
+        if "ranks" in fields:
+            # partition:ranks=A|B — two '.'-separated rank sets, e.g.
+            # ranks=0|1.2 severs every edge between {0} and {1,2}
+            sides = fields["ranks"].split("|")
+            if len(sides) != 2:
+                raise _fail(spec, clause,
+                            "ranks must name exactly two sides as "
+                            "A|B (ranks '.'-separated, e.g. 0|1.2)")
+            try:
+                a = frozenset(int(x) for x in sides[0].split(".") if x)
+                b = frozenset(int(x) for x in sides[1].split(".") if x)
+            except ValueError:
+                raise _fail(spec, clause,
+                            "ranks sides must be '.'-separated "
+                            "integers") from None
+            if not a or not b:
+                raise _fail(spec, clause,
+                            "both partition sides must be non-empty")
+            if a & b:
+                raise _fail(spec, clause,
+                            f"partition sides overlap: "
+                            f"{sorted(a & b)} on both")
+            ranks = (a, b)
+        if kind == "partition" and ms < 0:
+            raise _fail(spec, clause,
+                        "partition ms=N (heal-after window) must be "
+                        ">= 0 (0 = never heals)")
         # per-kind requirements, checked here so a broken spec fails at
         # init() with an actionable message instead of never firing
         if kind == "kill" and step is None:
@@ -350,7 +389,8 @@ def parse_spec(spec: str) -> List[FaultRule]:
             if n is not None and n <= 0:
                 raise _fail(spec, clause,
                             f"{kind} n=N (fault budget) must be > 0")
-        rules.append(FaultRule(kind, site, rank, step, p, ms, code, n))
+        rules.append(FaultRule(kind, site, rank, step, p, ms, code, n,
+                               ranks=ranks))
     if not rules:
         raise ValueError(
             f"BYTEPS_FAULT_SPEC={spec!r} contains no fault clauses")
@@ -386,8 +426,15 @@ class FaultInjector:
             if r.site is not None:
                 self._by_site.setdefault(r.site, []).append(r)
         self._kills = [r for r in self.rules if r.kind == "kill"]
+        # ranks-scoped partitions: consulted via edge_cut(peer) from any
+        # peer-aware site (transport, heartbeat, bus, gossip), not via
+        # the blanket socket_fault path
+        self._edge_rules = [r for r in self.rules
+                            if r.kind == "partition" and r.ranks is not None]
         self._step = 0
         self._serves = 0   # answered serving pulls (site=serve_host kills)
+        # survives disarm(engine_scoped_only=True) — see module arm()
+        self.persist = False
         self._lock = threading.Lock()
 
     # -- site hooks --------------------------------------------------------
@@ -538,6 +585,8 @@ class FaultInjector:
                     time.sleep(r.ms / 1000.0)
                 continue
             if r.kind == "partition":
+                if r.ranks is not None:
+                    continue  # edge-scoped: consulted via edge_cut(peer)
                 # unconditional while the budget lasts: a partition is
                 # a state, not a per-op coin flip
                 if self._consume_budget(r):
@@ -558,6 +607,63 @@ class FaultInjector:
             counters.inc("fault.partial_write")
             return "partial_write"
         return None
+
+    def edge_cut(self, peer: int) -> bool:
+        """True when a ``partition:ranks=A|B`` rule severs the edge
+        between THIS process and ``peer`` right now — the symmetric
+        blackhole every peer-aware site (transport sends/recvs/dials,
+        heartbeat datagrams, bus requests, gossip exchanges) consults.
+
+        The heal clock starts at the FIRST severed edge (``cut_t0``):
+        with ``ms=N`` the partition heals N milliseconds later and never
+        cuts again (``fault.partition`` / ``fault.partition_healed``
+        flight events bracket the incident for bps_doctor).  An ``n=``
+        budget bounds the number of blackholed operations instead."""
+        if peer is None or peer < 0 or not self._edge_rules:
+            return False
+        now = time.monotonic()
+        for r in self._edge_rules:
+            if r.healed:
+                continue
+            a, b = r.ranks
+            if not ((self.rank in a and peer in b)
+                    or (self.rank in b and peer in a)):
+                continue
+            with self._lock:
+                if r.healed:
+                    continue
+                if r.cut_t0 is None:
+                    r.cut_t0 = now
+                    counters.inc("fault.partition")
+                    from ..common import flight_recorder as _flight
+                    _flight.record("fault.partition", rank=self.rank,
+                                   side_a=sorted(a), side_b=sorted(b),
+                                   heal_ms=r.ms or None)
+                    get_logger().warning(
+                        "fault injector: partition %s|%s active "
+                        "(rank %d)", sorted(a), sorted(b), self.rank)
+                if r.ms > 0 and (now - r.cut_t0) * 1000.0 >= r.ms:
+                    r.healed = True
+                    counters.inc("fault.partition_healed")
+                    from ..common import flight_recorder as _flight
+                    _flight.record(
+                        "fault.partition_healed", rank=self.rank,
+                        side_a=sorted(a), side_b=sorted(b),
+                        after_ms=round((now - r.cut_t0) * 1000.0, 1))
+                    get_logger().warning(
+                        "fault injector: partition %s|%s healed "
+                        "(rank %d)", sorted(a), sorted(b), self.rank)
+                    continue
+                if r.left is not None:
+                    if r.left <= 0:
+                        continue
+                    r.left -= 1
+                    if r.skey is not None:
+                        _slow_consumed[r.skey] = \
+                            _slow_consumed.get(r.skey, 0) + 1
+            counters.inc("fault.edge_cut")
+            return True
+        return False
 
     def should_drop(self, site: str) -> bool:
         """True when a drop rule says to suppress this message."""
@@ -600,20 +706,35 @@ class FaultInjector:
 # -- module-level arm/disarm (the init()/shutdown() contract) ---------------
 
 
-def arm(spec: str, seed: int = 0, rank: int = 0) -> FaultInjector:
+def arm(spec: str, seed: int = 0, rank: int = 0, *,
+        persist: bool = False) -> FaultInjector:
     """Validate ``spec`` and install the process-wide injector.  Raises
     ValueError (with the valid kind/site lists) on a malformed spec —
-    called eagerly by ``bps.init()`` so chaos-run typos fail fast."""
+    called eagerly by ``bps.init()`` so chaos-run typos fail fast.
+
+    ``persist=True`` pins the injector across the engine lifecycle:
+    ``disarm(engine_scoped_only=True)`` — what ``api.suspend()`` /
+    ``api.shutdown()`` issue — leaves it armed.  A ``partition:ranks``
+    blackhole must survive the very suspend/resume transition it
+    provokes: the network does not heal because the engine restarted,
+    only the ``ms=`` clock heals it."""
     global ENABLED, _active
     _active = FaultInjector(spec, seed=seed, rank=rank)
+    _active.persist = persist
     ENABLED = True
     get_logger().warning("fault injection ARMED (rank %d, seed %d): %s",
                          rank, seed, "; ".join(map(repr, _active.rules)))
     return _active
 
 
-def disarm() -> None:
+def disarm(engine_scoped_only: bool = False) -> None:
+    """Drop the process-wide injector.  ``engine_scoped_only=True`` is
+    the engine-lifecycle form (init/shutdown): it spares an injector
+    armed with ``persist=True``."""
     global ENABLED, _active
+    if engine_scoped_only and _active is not None \
+            and getattr(_active, "persist", False):
+        return
     ENABLED = False
     _active = None
 
@@ -649,6 +770,12 @@ def socket_fault(site: str, op: str) -> Optional[str]:
     """Socket-shim delegate (see :meth:`FaultInjector.socket_fault`);
     None when chaos is disarmed."""
     return None if _active is None else _active.socket_fault(site, op)
+
+
+def edge_cut(peer: int) -> bool:
+    """Ranks-partition delegate (see :meth:`FaultInjector.edge_cut`);
+    False when chaos is disarmed."""
+    return _active is not None and _active.edge_cut(peer)
 
 
 def corrupt(site: str, arr):

@@ -118,9 +118,9 @@ from ..common.telemetry import counters
 
 __all__ = [
     "MembershipView", "ElasticMembership", "WorldChanged", "Evicted",
-    "Demoted", "MembershipTimeout", "current_epoch", "advance_epoch",
-    "set_epoch", "resolve_bus_addr", "bus_request", "active_membership",
-    "SERVE_RANK_BASE",
+    "Demoted", "PartitionMinority", "MembershipTimeout", "current_epoch",
+    "advance_epoch", "set_epoch", "resolve_bus_addr", "bus_request",
+    "active_membership", "is_parked", "SERVE_RANK_BASE",
 ]
 
 # Serving hosts (server/serving_tier.py) publish metrics snapshots into
@@ -145,6 +145,15 @@ def active_membership() -> Optional["ElasticMembership"]:
     change."""
     ref = _active_ref
     return ref() if ref is not None else None
+
+
+def is_parked() -> bool:
+    """True while this process sits parked on the minority side of a
+    partition (quorum gate refused the epoch; engine suspended).  The
+    engine checks this at enqueue so a parked rank fails loudly instead
+    of queueing work no epoch will ever complete."""
+    m = active_membership()
+    return bool(m is not None and m.parked)
 
 
 # -- the process-wide membership epoch --------------------------------------
@@ -233,6 +242,29 @@ class Demoted(RuntimeError):
             f"{sorted(probation)}); recover, then rejoin()")
         self.view = view
         self.probation = sorted(probation)
+
+
+class PartitionMinority(RuntimeError):
+    """This rank is on the MINORITY side of a partition: a shrink it
+    proposed (or joined) cannot reach a strict majority of the last
+    agreed world, so committing it could split-brain the epoch
+    (``BYTEPS_GOSSIP_ON`` quorum gate, fault/gossip.py quorum_ok).  The
+    rank PARKS — engine suspended, no epoch advanced — and rejoins
+    through the ordinary :meth:`ElasticMembership.rejoin` path once the
+    partition heals.  Deliberately not an :class:`Evicted`: nobody
+    agreed a world without this rank; there is, for now, no agreed new
+    world at all on this side."""
+
+    def __init__(self, view: MembershipView, reachable, proposed):
+        super().__init__(
+            f"partition minority: only {sorted(reachable)} of the last "
+            f"agreed world {list(view.world)} (epoch {view.epoch}) are "
+            f"reachable — no strict majority, parking instead of "
+            f"committing epoch {view.epoch + 1}; rejoin() after the "
+            f"partition heals")
+        self.view = view
+        self.reachable = sorted(reachable)
+        self.proposed = sorted(proposed)
 
 
 class MembershipTimeout(TimeoutError):
@@ -501,6 +533,17 @@ class _BusServer:
         from ..common.config import get_config
         from ..utils.slowness import SlownessTracker
         cfg = get_config()
+        # quorum-gated agreement (ISSUE 17): with the gossip plane on, a
+        # shrink commits only when a strict majority of the last agreed
+        # world answered the hello — the server-side half of the
+        # split-brain gate (fault/gossip.py quorum_ok)
+        self._quorum_gate = bool(getattr(cfg, "gossip_on", False))
+        # the bus's gossip table (fault/gossip.py): every `gossip` verb
+        # merges the caller's digest here and answers with the merged
+        # table, so two ranks that never talk directly still converge
+        # through the bus.  The hosting ElasticMembership installs its
+        # own agent's table; a bare bus lazily builds a relay-only one.
+        self.gossip_table = None
         self._straggler_policy = cfg.straggler_policy
         self._phi = cfg.slowness_phi
         self._demote_after = cfg.straggler_demote_after
@@ -679,6 +722,14 @@ class _BusServer:
             conn.settimeout(self._sync_timeout + self._rdv_timeout + 30.0)
             msg = _recv_obj(conn)
             op = msg.get("op")
+            # ranks-partition chaos: a caller across the cut never
+            # reaches this bus — drop the request unanswered (the
+            # client's connect/read timeout surfaces the silence), same
+            # shape as a real severed control network
+            from . import injector as _fault
+            if (_fault.ENABLED and msg.get("rank") is not None
+                    and _fault.edge_cut(int(msg["rank"]))):
+                return
             if op == "sync":
                 reply = self._do_sync(msg)
             elif op == "hello":
@@ -701,6 +752,8 @@ class _BusServer:
                 reply = self._do_serve_dir()
             elif op == "serve_scale":
                 reply = self._do_serve_scale(msg)
+            elif op == "gossip":
+                reply = self._do_gossip(msg)
             else:
                 reply = {"ok": False, "error": f"unknown op {op!r}"}
             # replication piggyback: every reply to the STANDBY carries a
@@ -1033,6 +1086,8 @@ class _BusServer:
                 # agreed world
                 expected = frozenset.intersection(*got.values())
                 if set(got) >= expected:
+                    if self._quorum_minority_locked(got):
+                        return self._minority_reply(proposed_epoch, got)
                     self._agree(proposed_epoch, sorted(got))
                     return {"ok": True, "epoch": self.epoch,
                             "world": sorted(self.world)}
@@ -1040,6 +1095,8 @@ class _BusServer:
                 if remaining <= 0:
                     # double failure during the shrink: whoever never
                     # helloed inside the window is dropped too
+                    if self._quorum_minority_locked(got):
+                        return self._minority_reply(proposed_epoch, got)
                     get_logger().error(
                         "membership: rendezvous for epoch %d timed out "
                         "waiting for %s — proceeding with responders %s",
@@ -1050,6 +1107,30 @@ class _BusServer:
                             "world": sorted(self.world)}
                 self._cv.wait(min(remaining, 0.25))
         return self._stale_reply()
+
+    def _quorum_minority_locked(self, got) -> bool:
+        """True when the quorum gate is armed and the rendezvous
+        responders are NOT a strict majority of the last agreed world
+        (caller holds the condition).  The server-side half of the
+        split-brain proof: an agreement that this side of a partition
+        could commit concurrently with the other side's is refused."""
+        return self._quorum_gate and 2 * len(got) <= len(self.world)
+
+    def _minority_reply(self, proposed_epoch: int, got) -> dict:
+        """Refuse a minority agreement (caller holds the condition): no
+        epoch advances; the caller parks (:class:`PartitionMinority`)."""
+        counters.inc("membership.quorum_refused")
+        _flight.record("membership.quorum_refused",
+                       proposed_epoch=proposed_epoch,
+                       responders=sorted(got),
+                       world=sorted(self.world), epoch=self.epoch)
+        get_logger().error(
+            "membership bus: REFUSING epoch %d — responders %s are not "
+            "a strict majority of the last agreed world %s (partition "
+            "minority); parking instead of split-braining",
+            proposed_epoch, sorted(got), sorted(self.world))
+        return {"ok": False, "minority": True, "epoch": self.epoch,
+                "world": sorted(self.world), "responders": sorted(got)}
 
     def _agree(self, epoch: int, world: List[int]) -> None:
         """Commit a shrink agreement (caller holds the condition)."""
@@ -1159,6 +1240,41 @@ class _BusServer:
                                     "summary": h}
                                 for r, (t, h) in self._history.items()
                                 if r in self.world}}
+
+    # -- verb: gossip (anti-entropy relay, fault/gossip.py) ----------------
+
+    def _do_gossip(self, msg: dict) -> dict:
+        """Merge the caller's gossip digest into the bus-side table and
+        answer with the merged digest — one round trip is one
+        anti-entropy exchange, so two ranks that never talk directly
+        still converge through the bus.  Metrics/history payloads riding
+        the digest seed the bus's own observability caches: the bus is a
+        thin compatibility server FED from the gossip table, and
+        ``cluster_metrics()``/``bps_top``/``bps_doctor`` keep working
+        unchanged."""
+        digest = msg.get("digest") or {}
+        table = self.gossip_table
+        if table is None:
+            from .gossip import GossipTable
+            with self._cv:
+                if self.gossip_table is None:
+                    rank = (self.host_rank if self.host_rank is not None
+                            else (min(self.world) if self.world else 0))
+                    self.gossip_table = GossipTable(rank,
+                                                    sorted(self.world))
+                table = self.gossip_table
+        table.merge(digest)
+        with self._cv:
+            for kind, cache in (("metrics", self._metrics),
+                                ("history", self._history)):
+                for r, v in table.payloads_of_kind(kind).items():
+                    if not isinstance(v, dict) or "t" not in v:
+                        continue
+                    cur = cache.get(r)
+                    if cur is None or float(v["t"]) > cur[0]:
+                        cache[r] = (float(v["t"]), v.get("v"))
+        return {"ok": True, "epoch": self.epoch,
+                "world": sorted(self.world), "digest": table.digest()}
 
     # -- verbs: replicate / ping (coordinator-failover support) ------------
 
@@ -1333,6 +1449,9 @@ class ElasticMembership:
                              f"{list(self._view.world)}")
         self._bus_arg = bus
         self.bus_addr = resolve_bus_addr(bus, self._view)
+        # the rank serving bus_addr right now (moves with _ensure_bus's
+        # re-resolution, AHEAD of the view during a failover rendezvous)
+        self._bus_host_rank = self._view.coordinator
         self.devices = devices
         self.assigner = assigner
         self.server_engine = server_engine
@@ -1346,11 +1465,12 @@ class ElasticMembership:
         # The bus client must ride out a coordinator FAILOVER: detection
         # (heartbeat timeout) + successor bind can span many short
         # connect-refused attempts, so the attempt budget is raised well
-        # past the bootstrap default and the retry deadline is the real
-        # bound.
+        # past the bootstrap default (BYTEPS_BUS_RETRIES — the
+        # detection-vs-patience dial) and the retry deadline is the
+        # real bound.
         self._retry = retry or RetryPolicy.from_config(
             cfg, retry_on=(_BusUnreachable,),
-            max_attempts=max(cfg.retry_max_attempts, 64))
+            max_attempts=max(cfg.retry_max_attempts, cfg.bus_retries))
         self._apply_lock = named_lock("membership.apply")
         self._ready_cv = threading.Condition()
         self._bus: Optional[_BusServer] = None
@@ -1368,6 +1488,14 @@ class ElasticMembership:
         # applied world change so the UDP server follows the coordinator
         self._hb = None
         self._hb_args: Optional[dict] = None
+        # -- gossip plane (BYTEPS_GOSSIP_ON, fault/gossip.py) --------------
+        self._gossip_on = bool(getattr(cfg, "gossip_on", False))
+        self._gossip_table = None
+        self._gossip_agent = None
+        # True after a minority park: the engine is suspended and no
+        # epoch was advanced on this side; cleared only by a successful
+        # rejoin through a healed world
+        self._parked = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1377,12 +1505,17 @@ class ElasticMembership:
         global _active_ref
         set_epoch(self._view.epoch)
         self._ensure_bus(self._view)
+        if self._gossip_on:
+            self._start_gossip()
         _active_ref = weakref.ref(self)
         self._sync_clock()
         return self
 
     def stop(self) -> None:
         global _active_ref
+        if self._gossip_agent is not None:
+            self._gossip_agent.stop()
+            self._gossip_agent = None
         if self._hb is not None:
             self._hb.stop()
             self._hb = None
@@ -1391,6 +1524,71 @@ class ElasticMembership:
             self._bus = None
         if _active_ref is not None and _active_ref() is self:
             _active_ref = None
+
+    def _start_gossip(self) -> None:
+        """Arm the SWIM plane: one table + one agent for this rank, the
+        bus ``gossip`` verb as the wire, metrics/history snapshots as
+        piggybacked payloads, and the health engine's ``quorum_loss``
+        provider registered against the LAST AGREED world."""
+        from .gossip import GossipAgent, GossipTable
+        if self._gossip_table is None:
+            self._gossip_table = GossipTable(self.rank, self._view.world)
+
+        def wire(peer: int, digest: dict):
+            # the bus is the exchange point; `peer` only scopes chaos
+            # (a severed edge to the bus host is already honored in
+            # _request) — the reply digest is the anti-entropy return
+            reply = self._request({"op": "gossip", "rank": self.rank,
+                                   "digest": digest},
+                                  timeout=max(2.0, self.sync_timeout_s / 4))
+            return reply.get("digest") if reply.get("ok") else None
+
+        def payloads() -> dict:
+            # refreshed once per gossip period; values are wall-stamped
+            # ``{"t", "v"}`` pairs so _do_gossip can seed the bus caches
+            # by freshness; None snapshots are skipped by the agent
+            now = time.time()
+            out = {}
+            m = self._local_metrics()
+            if m is not None:
+                out["metrics"] = {"t": now, "v": m}
+            h = self._local_history()
+            if h is not None:
+                out["history"] = {"t": now, "v": h}
+            bus = self._bus
+            if bus is not None:
+                # the hosting rank also gossips the serving-tier
+                # directory, so routers on the far side of a partition
+                # can keep serving from the last-known host map
+                try:
+                    out["serve_dir"] = {"t": now, "v": bus._do_serve_dir()}
+                except Exception:  # noqa: BLE001 — serving is optional
+                    pass
+            return out
+
+        self._gossip_agent = GossipAgent(
+            self._gossip_table, wire,
+            world_fn=lambda: self._view.world,
+            payload_fn=payloads)
+        self._gossip_agent.register_health_provider()
+        self._gossip_agent.start()
+        if self._bus is not None:
+            # the hosting rank's bus serves anti-entropy FROM this same
+            # table: verb replies and the local agent converge as one
+            self._bus.gossip_table = self._gossip_table
+
+    @property
+    def gossip(self):
+        """The local :class:`~byteps_tpu.fault.gossip.GossipTable`
+        (None unless BYTEPS_GOSSIP_ON armed it) — observability callers
+        (cluster_metrics, bps_top) answer from it bus-free."""
+        return self._gossip_table
+
+    @property
+    def parked(self) -> bool:
+        """True while this rank sits parked on the minority side of a
+        partition (engine suspended, no epoch agreed)."""
+        return self._parked
 
     def __enter__(self):
         return self.start()
@@ -1463,6 +1661,7 @@ class ElasticMembership:
         path (shrink failure → restartable exit) take over."""
         addr = resolve_bus_addr(self._bus_arg, view)
         self.bus_addr = addr
+        self._bus_host_rank = min(view.world)
         if self.rank != min(view.world) or self._bus is not None:
             return
         if prev_coordinator is None:
@@ -1501,6 +1700,10 @@ class ElasticMembership:
                 "nothing answers there — refusing to leave the world "
                 "busless: %s", self.rank, addr[0], addr[1], e)
             raise
+        if self._gossip_table is not None and self._bus is not None:
+            # a failover successor's bus answers anti-entropy from the
+            # SAME table its local agent already converged
+            self._bus.gossip_table = self._gossip_table
         if prev_coordinator != self.rank:
             counters.inc("membership.coordinator_failover")
             _flight.record("membership.coordinator_failover",
@@ -1532,6 +1735,19 @@ class ElasticMembership:
         every reply carries a piggybacked ``replica`` snapshot — it is
         stripped from the reply and cached as the failover seed."""
         def once():
+            from . import injector as _fault
+            # gate on the rank actually HOSTING the resolved address, not
+            # the view's coordinator: during a failover shrink the hello
+            # targets the PROPOSED successor while the view still names
+            # the severed old coordinator — that edge must stay open
+            if (_fault.ENABLED and self._bus is None
+                    and _fault.edge_cut(self._bus_host_rank)):
+                # ranks-partition chaos: the bus host is across the cut
+                # — fail fast instead of waiting out a connect timeout
+                # per retry (the real network would blackhole the SYN)
+                raise _BusUnreachable(
+                    f"bus {self.bus_addr}: severed by injected "
+                    f"partition (chaos)")
             try:
                 s = socket.create_connection(self.bus_addr, timeout=3.0)
             except OSError as e:
@@ -1564,11 +1780,12 @@ class ElasticMembership:
         or nothing answers yet — the rejoin request's own backoff keeps
         retrying the resolved address."""
         _, default_port = resolve_bus_addr()   # the ONE port resolution
-        for host, port in _membership_host_map():
+        for host_rank, (host, port) in enumerate(_membership_host_map()):
             addr = (host, port if port is not None else default_port)
             try:
                 if bus_request(addr, {"op": "ping"}, timeout=2.0).get("ok"):
                     self.bus_addr = addr
+                    self._bus_host_rank = host_rank
                     return True
             except Exception:  # noqa: BLE001 — dead entry, try the next
                 continue
@@ -1878,6 +2095,12 @@ class ElasticMembership:
                 self.shrink(set(stale))
             else:
                 self.reconcile()
+        except PartitionMinority:
+            # NOT a failure exit: this rank parked on the minority side
+            # of a partition (engine suspended, no epoch agreed).  The
+            # training thread observes the park at its next step_sync;
+            # the process stays up to rejoin when the partition heals.
+            return
         except Exception:  # noqa: BLE001 — end of the in-process line
             counters.inc("membership.shrink_failed")
             from ..utils.failure_detector import _failure_exit_code
@@ -1906,6 +2129,34 @@ class ElasticMembership:
             "(shrink-to-survivors; it rejoins when healthy)", rank)
         return self.shrink({rank})
 
+    def _park_minority(self, view: MembershipView, proposed_world,
+                       reachable) -> None:
+        """Park this rank on the minority side of a partition: engine
+        suspended, ``membership.partition_minority`` counter + flight
+        event, gossip state ``parked`` — and raise
+        :class:`PartitionMinority`.  No epoch is agreed (or even
+        proposed further) on this side; the rank returns through the
+        ordinary :meth:`rejoin` path when the partition heals."""
+        self._parked = True
+        counters.inc("membership.partition_minority")
+        _flight.record("membership.partition_minority",
+                       rank=self.rank, epoch=view.epoch,
+                       world=list(view.world),
+                       reachable=sorted(reachable),
+                       proposed=sorted(proposed_world))
+        get_logger().error(
+            "membership: rank %d is on the MINORITY side of a partition "
+            "(reachable %s of last agreed world %s) — parking; rejoin "
+            "when the partition heals", self.rank, sorted(reachable),
+            list(view.world))
+        if self._gossip_table is not None:
+            from .gossip import PARKED
+            self._gossip_table.mark(self.rank, PARKED)
+        from ..core import api
+        if api.initialized():
+            api.suspend()
+        raise PartitionMinority(view, reachable, proposed_world)
+
     def shrink(self, stale: Set[int]) -> MembershipView:
         """Drop ``stale`` ranks: epoch guard up → drain/suspend →
         epoch-tagged rendezvous → resume at the survivor world.
@@ -1931,6 +2182,14 @@ class ElasticMembership:
         if self.rank not in proposed_world:
             raise Evicted(f"rank {self.rank} was declared stale by its "
                           "own detector input")
+        if self._gossip_on:
+            # quorum gate, BEFORE the epoch guard goes up: a minority
+            # proposal must not even stamp a local epoch — park instead
+            # (the other side of the partition may be committing the
+            # legitimate successor world right now)
+            from .gossip import quorum_ok
+            if not quorum_ok(proposed_world, view.world):
+                self._park_minority(view, proposed_world, proposed_world)
         counters.inc("membership.shrink_started")
         _flight.record("membership.shrink_started", stale=sorted(stale),
                        proposed_epoch=proposed_epoch,
@@ -1956,7 +2215,7 @@ class ElasticMembership:
             # evidence it died mid-failover
             hello_retry = RetryPolicy.from_config(
                 get_config(), retry_on=(_BusUnreachable,),
-                max_attempts=64,
+                max_attempts=get_config().bus_retries,
                 deadline_s=max(self.rendezvous_timeout_s, 2.0))
             try:
                 reply = self._request(
@@ -1987,6 +2246,20 @@ class ElasticMembership:
                     raise Evicted(
                         f"rank {self.rank} has no surviving world left "
                         f"(every lower rank is unreachable)")
+                if self._gossip_on:
+                    # the ladder descended below quorum: every bus this
+                    # side can reach is gone — a partition, not a pile
+                    # of dead coordinators; park instead of committing
+                    from .gossip import quorum_ok
+                    if not quorum_ok(proposed_world, view.world):
+                        self._park_minority(view, proposed_world,
+                                            proposed_world)
+        if reply.get("minority"):
+            # the server-side gate refused the agreement: our local
+            # evidence said majority, the actual rendezvous responders
+            # were not one (the backstop half of the split-brain proof)
+            self._park_minority(view, proposed_world,
+                                reply.get("responders") or ())
         agreed = MembershipView(reply["epoch"], tuple(reply["world"]))
         if self.rank not in agreed.world:
             raise Evicted(f"rank {self.rank} is outside the agreed world "
@@ -2047,6 +2320,11 @@ class ElasticMembership:
                 "membership: reconcile could not reach the bus — treating "
                 "coordinator %d as failed", coord)
             return self.shrink({coord})
+        if reply.get("minority"):
+            # the bus answered but refused: this side of a partition
+            # mustered only a minority at the rendezvous — park
+            self._park_minority(view, view.world,
+                                reply.get("responders") or ())
         agreed = MembershipView(reply["epoch"], tuple(reply["world"]))
         if self.rank not in agreed.world:
             raise Evicted(f"rank {self.rank} is outside the agreed world "

@@ -340,6 +340,8 @@ class HeartbeatMonitor:
                 r = int(data[len(_MAGIC):])
             except ValueError:
                 continue
+            if _fault.ENABLED and _fault.edge_cut(r):
+                continue  # ranks-partition: deaf to the other side
             if r in self.ranks:
                 self._last_seen[r] = time.monotonic()
             try:
@@ -371,8 +373,12 @@ class HeartbeatMonitor:
             try:
                 # chaos site: drop:site=heartbeat:p=... suppresses the
                 # send, simulating a lossy/partitioned control network —
-                # the reply read then times out like a real loss would
-                if _fault.ENABLED and _fault.should_drop("heartbeat"):
+                # the reply read then times out like a real loss would.
+                # A ranks-partition cutting the edge to the heartbeat
+                # server has the same shape: beats blackholed both ways.
+                if _fault.ENABLED and (
+                        _fault.should_drop("heartbeat")
+                        or _fault.edge_cut(self.server_rank)):
                     raise socket.timeout()
                 sock.sendto(_MAGIC + str(self.rank).encode(), self.addr)
                 data, _ = sock.recvfrom(bufsize)

@@ -22,10 +22,10 @@ if "xla_force_host_platform_device_count" not in flags:
 # then this only exercises the Config parsing path.
 os.environ.setdefault("BYTEPS_MIN_COMPRESS_BYTES", "0")
 
-# Flight-recorder dumps default to the cwd; under pytest that is the repo
-# root, and every chaos test that trips a detector/quarantine/kill would
-# shed a JSON file into it.  Route them to one session-scoped temp dir
-# (tests that assert on dumps set BYTEPS_FLIGHT_DIR explicitly anyway).
+# Flight-recorder dumps default to a per-user temp dir (config.py
+# _default_flight_dir); still route them to one session-scoped temp dir
+# so parallel test sessions never see each other's dumps (tests that
+# assert on dumps set BYTEPS_FLIGHT_DIR explicitly anyway).
 if "BYTEPS_FLIGHT_DIR" not in os.environ:
     import tempfile
 

@@ -42,6 +42,24 @@ Scenarios, driven by env:
   deadline fires, the installed failure action runs a membership
   *reconcile* (never ``os._exit``), and training continues; the worker
   prints ``DEADLINE-TRIPS``/``RECONCILES`` counters before FINAL.
+- **partition** (``BYTEPS_ELASTIC_PARTITION_SPEC=partition:ranks=A|B...``
+  + ``BYTEPS_ELASTIC_PARTITION_STEP=K``): at step K every rank arms the
+  edge-cut spec locally (same step boundary, deterministic).  The
+  majority side detects the severed coordinator (instant
+  ``_BusUnreachable`` per attempt), shrinks through the failover
+  ladder, and keeps training; the minority side's shrink proposal fails
+  the quorum gate and raises ``PartitionMinority`` — the worker prints
+  ``PARKED <rank> <epoch> <step>``, dumps its flight ring, stops the
+  old membership, and loops ``ElasticMembership.rejoin`` (host-map bus
+  discovery) until the ``ms=`` heal lets it back in, then resumes
+  training to FINAL.  Every rank in partition mode dumps its flight
+  ring before exiting and prints ``FLIGHT <path>`` so the test can
+  assert the split-brain proof from both sides' records.
+
+``BYTEPS_ELASTIC_BUS`` may be EMPTY in partition runs: the membership
+then resolves the bus from ``BYTEPS_MEMBERSHIP_HOSTS`` per view, so a
+failover successor binds its OWN entry (rank 0's process is still
+alive across the cut, holding its port).
 """
 
 from __future__ import annotations
@@ -114,10 +132,48 @@ def _stale_probes(api, mm) -> int:
     return 0
 
 
+def _parked_rejoin(mm, m, rank, w):
+    """Minority-park flow (partition scenario): dump the flight ring —
+    the split-brain proof reads membership.partition_minority and the
+    ABSENCE of any agreed epoch from it — stop the parked membership,
+    then retry :meth:`ElasticMembership.rejoin` (host-map bus discovery)
+    until the partition heals and the majority's bus admits this rank.
+    Returns ``(membership, w, next_step)`` resumed from the survivors'
+    broadcast state."""
+    import numpy as np
+
+    from byteps_tpu.common import flight_recorder as _flight
+    from byteps_tpu.fault.membership import ElasticMembership
+    from byteps_tpu.utils.failure_detector import install_failure_action
+
+    print("PARKED", rank, mm.current_epoch(), flush=True)
+    print("FLIGHT", _flight.dump("parked"), flush=True)
+    m.stop()
+    bus = os.environ["BYTEPS_ELASTIC_BUS"] or None
+    deadline = time.monotonic() + 120.0
+    while True:
+        try:
+            m2, step0, state = ElasticMembership.rejoin(rank, bus,
+                                                        timeout=5.0)
+            break
+        except Exception as e:  # noqa: BLE001 — severed until the heal
+            if time.monotonic() > deadline:
+                print("REJOIN-DEADLINE", repr(e), flush=True)
+                raise
+            time.sleep(0.5)
+    install_failure_action(m2.on_failure)
+    w = np.asarray(state["w"], np.float32)
+    print("REJOINED", mm.current_epoch(),
+          ",".join(map(str, m2.view().world)), step0, flush=True)
+    return m2, w, int(step0) + 1
+
+
 def main() -> int:
     rank = int(os.environ["BYTEPS_ELASTIC_RANK"])
     world = [int(r) for r in os.environ["BYTEPS_ELASTIC_WORLD"].split(",")]
-    bus = os.environ["BYTEPS_ELASTIC_BUS"]
+    # empty → view-aware resolution (BYTEPS_MEMBERSHIP_HOSTS): partition
+    # runs need the failover bus bound at the SUCCESSOR's own entry
+    bus = os.environ["BYTEPS_ELASTIC_BUS"] or None
     hb_port = os.environ.get("BYTEPS_ELASTIC_HB_PORT", "")
     n_steps = int(os.environ["BYTEPS_ELASTIC_STEPS"])
     start_step = int(os.environ.get("BYTEPS_ELASTIC_START_STEP", "1"))
@@ -127,6 +183,9 @@ def main() -> int:
     die_on_detect = os.environ.get("BYTEPS_ELASTIC_DIE_ON_DETECT", "") == "1"
     wedge_step = int(os.environ.get("BYTEPS_ELASTIC_WEDGE_STEP", "0"))
     wedge_s = float(os.environ.get("BYTEPS_ELASTIC_WEDGE_S", "4"))
+    partition_spec = os.environ.get("BYTEPS_ELASTIC_PARTITION_SPEC", "")
+    partition_step = int(os.environ.get("BYTEPS_ELASTIC_PARTITION_STEP",
+                                        "0"))
 
     import jax
 
@@ -135,7 +194,8 @@ def main() -> int:
     import byteps_tpu.core.api as api
     from byteps_tpu.fault import membership as mm
     from byteps_tpu.fault.membership import (ElasticMembership,
-                                             MembershipTimeout, WorldChanged)
+                                             MembershipTimeout,
+                                             PartitionMinority, WorldChanged)
     from byteps_tpu.utils.failure_detector import install_failure_action
 
     if rejoining:
@@ -179,10 +239,22 @@ def main() -> int:
     step = start_step
     retries = 0
     wedged = False
+    partition_armed = False
+    conn_errs = 0
     while step <= n_steps:
         if retries > 200:   # a real wedge must fail loudly, not spin
             print("RETRY-BUDGET-EXHAUSTED at", step, flush=True)
             return 6
+        if (partition_spec and partition_step and step == partition_step
+                and not partition_armed):
+            # every rank severs the same edges at the same step boundary
+            # — a deterministic network split, no global trigger needed
+            from byteps_tpu.fault import injector as fault_injector
+            partition_armed = True
+            # persist: the cut must survive the suspend/resume cycles of
+            # the very shrink/park it provokes — only ms= heals it
+            fault_injector.arm(partition_spec, rank=rank, persist=True)
+            print("PARTITION-ARMED", rank, "at", step, flush=True)
         try:
             eng = api._require()
             if wedge_step and step == wedge_step and not wedged:
@@ -218,7 +290,35 @@ def main() -> int:
         except MembershipTimeout:
             retries += 1
             continue
+        except PartitionMinority:
+            # this side mustered only a minority: park, wait out the
+            # heal, and return through the ordinary rejoin path
+            m, w, step = _parked_rejoin(mm, m, rank, w)
+            retries = conn_errs = 0
+            continue
+        except ConnectionError:
+            if not partition_armed:
+                raise
+            conn_errs += 1
+            if conn_errs < 2:
+                # one unreachable round is not yet failure evidence (a
+                # failover bind or a just-healed rejoin still settling)
+                time.sleep(0.5)
+                continue
+            # the bus host is across the cut: name the coordinator as
+            # failed and take the failover shrink (quorum-gated)
+            try:
+                view = m.shrink({m.view().coordinator})
+            except PartitionMinority:
+                m, w, step = _parked_rejoin(mm, m, rank, w)
+                retries = conn_errs = 0
+                continue
+            conn_errs = 0
+            print("WORLD", view.epoch,
+                  ",".join(map(str, view.world)), "at", step, flush=True)
+            continue
         retries = 0
+        conn_errs = 0
         grads = [np.asarray(p) for p in payloads.values()]
         w = w - np.float32(LR) * (np.sum(grads, axis=0,
                                          dtype=np.float32)
@@ -238,6 +338,11 @@ def main() -> int:
     view = m.view()
     print("FINAL", view.epoch, ",".join(map(str, view.world)),
           repr(float(w[0])), flush=True)
+    if partition_armed:
+        # both sides of the split ship their evidence: the test asserts
+        # the no-second-epoch proof from every rank's flight records
+        from byteps_tpu.common import flight_recorder as _flight
+        print("FLIGHT", _flight.dump("partition_done"), flush=True)
     install_failure_action(None)
     m.stop()   # stops the managed heartbeat too
     api.shutdown()

@@ -109,7 +109,7 @@ def _field_value(kind, field):
     if field == "site":
         return _SITE_FOR.get(kind, "dcn")
     return {"rank": "1", "step": "3", "p": "0.5", "ms": "5",
-            "code": "9", "n": "4"}[field]
+            "code": "9", "n": "4", "ranks": "0|1.2"}[field]
 
 
 def test_master_field_table_is_derived_from_kind_tables():

@@ -1,0 +1,156 @@
+"""The split-brain proof (ISSUE 17 headline): a real network partition
+across three worker processes, asserted from both sides' flight records.
+
+``partition:ranks=0|1.2:ms=10000`` severs the coordinator (rank 0) from
+ranks 1 and 2 at a deterministic step boundary — every rank arms the
+same edge-cut spec locally, so the "network" splits without any global
+trigger.  What must happen, and what this test pins:
+
+- the MAJORITY side (ranks 1, 2) detects the unreachable coordinator,
+  takes the quorum-gated failover shrink (epoch 1, world {1,2} — 2 of 3
+  IS a strict majority), and keeps training;
+- the MINORITY side (rank 0) proposes world {0}, fails the strict-
+  majority gate, and PARKS — ``membership.partition_minority`` in its
+  flight ring, and crucially NO ``membership.shrink_started`` and no
+  epoch ever advanced on that side: the two sides never agree two
+  different worlds at any epoch (the split-brain proof);
+- when the ``ms=`` heal opens the edges again, rank 0 returns through
+  the ordinary rejoin path (host-map bus discovery — its OWN old bus
+  socket is gone), epoch 2 re-agrees world {0,1,2}, and every rank's
+  final weights are bit-identical to a fault-free float32 replay of the
+  same piecewise world schedule;
+- ``bps_doctor --postmortem`` over the run's flight dumps folds the
+  whole incident into sides / parked ranks / heal time (satellite 3).
+"""
+
+import json
+
+import pytest
+
+from .conftest import free_port as _free_port
+from .test_elastic import _communicate, _final, _simulate, _spawn
+
+
+def _world_step(out, epoch, world):
+    """Parse 'WORLD <epoch> <world> at <step>' (first occurrence)."""
+    for line in out.splitlines():
+        if line.startswith(f"WORLD {epoch} {world} at "):
+            return int(line.rsplit(" ", 1)[1])
+    raise AssertionError(
+        f"no 'WORLD {epoch} {world}' line in:\n" + out[-3000:])
+
+
+def _flight_paths(out):
+    return [line.split(" ", 1)[1].strip() for line in out.splitlines()
+            if line.startswith("FLIGHT ")]
+
+
+def _events(path):
+    with open(path) as f:
+        return json.load(f)["events"]
+
+
+def _applied_worlds(events):
+    """{epoch: world} committed by this rank, per its flight ring."""
+    out = {}
+    for ev in events:
+        if ev.get("kind") == "membership.applied":
+            out[int(ev["epoch"])] = tuple(ev["world"])
+    return out
+
+
+@pytest.mark.chaos
+def test_partition_minority_parks_majority_trains_heal_rejoins(tmp_path):
+    n, cut_at, heal_ms = 40, 4, 10000
+    ports = [_free_port() for _ in range(3)]
+    hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+    extra = {
+        # EMPTY bus: per-view host-map resolution, so the failover
+        # successor binds its OWN entry — rank 0's process is alive
+        # across the cut, still holding hosts[0]
+        "BYTEPS_ELASTIC_BUS": "",
+        "BYTEPS_MEMBERSHIP_HOSTS": hosts,
+        "BYTEPS_GOSSIP_ON": "1",
+        "BYTEPS_GOSSIP_INTERVAL_S": "0.1",
+        # tight budgets so each severed round surfaces in seconds
+        "BYTEPS_BUS_RETRIES": "8",
+        "BYTEPS_RETRY_DEADLINE": "3",
+        "BYTEPS_MEMBERSHIP_SYNC_TIMEOUT": "4",
+        "BYTEPS_MEMBERSHIP_RENDEZVOUS_TIMEOUT": "5",
+        "BYTEPS_ELASTIC_STEP_SLEEP": "0.4",
+        "BYTEPS_ELASTIC_PARTITION_SPEC":
+            f"partition:ranks=0|1.2:ms={heal_ms}",
+        "BYTEPS_ELASTIC_PARTITION_STEP": str(cut_at),
+        "BYTEPS_FLIGHT_DIR": str(tmp_path),
+    }
+    procs = {r: _spawn(r, "0,1,2", ports[0], "", n, extra=extra)
+             for r in (0, 1, 2)}
+    outs = _communicate(procs, timeout=240)
+    for r in (0, 1, 2):
+        assert procs[r].returncode == 0, outs[r][-4000:]
+        assert f"PARTITION-ARMED {r} at {cut_at}" in outs[r]
+
+    # -- the minority parked; nobody exited ---------------------------
+    assert "PARKED 0 0" in outs[0], outs[0][-4000:]
+    assert "REJOINED 2 0,1,2" in outs[0], outs[0][-4000:]
+
+    # -- the majority shrank to {1,2} (epoch 1), then re-admitted rank
+    #    0 after the heal (epoch 2) — both survivors agree both steps
+    s1 = _world_step(outs[2], 1, "1,2")
+    s2 = _world_step(outs[2], 2, "0,1,2")
+    assert _world_step(outs[1], 1, "1,2") == s1
+    assert _world_step(outs[1], 2, "0,1,2") == s2
+    assert cut_at <= s1 < s2 <= n
+
+    # -- finals: all three ranks, same epoch/world/weights, and the
+    #    weights are a bit-exact float32 replay of the world schedule
+    finals = {r: _final(outs[r]) for r in (0, 1, 2)}
+    for r in (0, 1, 2):
+        assert finals[r][0] == 2 and finals[r][1] == "0,1,2", finals[r]
+    expected = _simulate(
+        _simulate(_simulate(0.0, (0, 1, 2), s1 - 1), (1, 2), s2 - s1),
+        (0, 1, 2), n - s2 + 1)
+    for r in (0, 1, 2):
+        assert finals[r][2] == expected, (finals, expected, s1, s2)
+
+    # -- the split-brain proof, from the flight records ---------------
+    # rank 0's FIRST dump is the park-time ring: the minority side
+    # recorded the refusal and NEVER started a shrink or committed an
+    # epoch past the last agreed one
+    park_events = _events(_flight_paths(outs[0])[0])
+    park_kinds = [e["kind"] for e in park_events]
+    assert "membership.partition_minority" in park_kinds
+    minority = [e for e in park_events
+                if e["kind"] == "membership.partition_minority"][0]
+    assert minority["epoch"] == 0 and minority["world"] == [0, 1, 2]
+    assert "membership.shrink_started" not in park_kinds
+    assert all(ep == 0 for ep in _applied_worlds(park_events)), \
+        park_kinds
+    # no epoch is ever agreed with two different worlds across ALL
+    # ranks' records — concurrent epochs would show up exactly here
+    agreed = {}
+    for r in (0, 1, 2):
+        for ep, world in _applied_worlds(
+                _events(_flight_paths(outs[r])[-1])).items():
+            assert agreed.setdefault(ep, world) == world, \
+                (r, ep, world, agreed)
+    assert agreed[1] == (1, 2) and agreed[2] == (0, 1, 2)
+
+    # the majority side observed the cut and (later) the heal
+    maj_events = _events(_flight_paths(outs[1])[-1])
+    maj_kinds = [e["kind"] for e in maj_events]
+    assert "fault.partition" in maj_kinds
+    assert "fault.partition_healed" in maj_kinds
+    healed = [e for e in maj_events
+              if e["kind"] == "fault.partition_healed"][0]
+    assert healed["after_ms"] >= heal_ms
+
+    # -- satellite 3: bps_doctor folds the dumps into one incident ----
+    from tools.bps_doctor import diagnose_postmortem, render_markdown
+    report = diagnose_postmortem(str(tmp_path))
+    p = report["partition"]
+    assert p["side_a"] == [0] and p["side_b"] == [1, 2]
+    assert p["parked_ranks"] == [0]
+    assert p["healed"] is True
+    assert p["split_ms"] >= heal_ms
+    assert "Network partition" in render_markdown(report)

@@ -179,12 +179,52 @@ def load_flight_dumps(dir_: str) -> List[dict]:
     return docs
 
 
+def _partition_incident(faults: List[dict],
+                        parks: List[dict]) -> Optional[dict]:
+    """Fold the partition-flavored flight events (``fault.partition`` /
+    ``fault.partition_healed`` from the injector, ``membership.
+    partition_minority`` / ``membership.quorum_refused`` from the
+    quorum gate) into one incident: the two sides, which ranks parked
+    as the minority, and how long the split lasted.  None when the
+    incident directory shows no partition at all."""
+    cuts = [f for f in faults if f.get("kind") == "partition"]
+    heals = [f for f in faults if f.get("kind") == "partition_healed"]
+    if not cuts and not parks:
+        return None
+    side_a: List[int] = []
+    side_b: List[int] = []
+    for f in cuts:
+        d = f.get("detail") or {}
+        if d.get("side_a"):
+            side_a = sorted({int(r) for r in d["side_a"]} | set(side_a))
+        if d.get("side_b"):
+            side_b = sorted({int(r) for r in d["side_b"]} | set(side_b))
+    parked = sorted({int(p["rank"]) for p in parks
+                     if p.get("kind") == "partition_minority"
+                     and p.get("rank") is not None})
+    out: Dict = {"side_a": side_a, "side_b": side_b,
+                 "parked_ranks": parked,
+                 "cut_t": cuts[0].get("t") if cuts else None,
+                 "healed": bool(heals)}
+    if heals:
+        h = heals[0]
+        out["heal_t"] = h.get("t")
+        after = (h.get("detail") or {}).get("after_ms")
+        if after is not None:
+            out["split_ms"] = float(after)
+        elif out["cut_t"] is not None and h.get("t") is not None:
+            out["split_ms"] = round(
+                (float(h["t"]) - float(out["cut_t"])) * 1000.0, 1)
+    return out
+
+
 def diagnose_postmortem(dir_: str) -> dict:
     """Correlate one incident directory into the postmortem document
     (pure over files on disk; unit-tested from synthetic dumps)."""
     dumps = load_flight_dumps(dir_)
     alerts: List[dict] = []
     faults: List[dict] = []
+    parks: List[dict] = []
     for doc in dumps:
         rank = doc.get("rank")
         for ev in doc.get("events") or ():
@@ -203,8 +243,17 @@ def diagnose_postmortem(dir_: str) -> dict:
                                "detail": {k: v for k, v in ev.items()
                                           if k not in ("t", "mono",
                                                        "kind", "site")}})
+            elif kind in ("membership.partition_minority",
+                          "membership.quorum_refused"):
+                parks.append({"t": ev.get("t"), "rank": rank,
+                              "kind": kind.split(".", 1)[1],
+                              "detail": {k: v for k, v in ev.items()
+                                         if k not in ("t", "mono",
+                                                      "kind")}})
     alerts.sort(key=lambda a: a.get("t") or 0.0)
     faults.sort(key=lambda f: f.get("t") or 0.0)
+    parks.sort(key=lambda p: p.get("t") or 0.0)
+    partition = _partition_incident(faults, parks)
     firing = [a for a in alerts if a.get("state") == "firing"]
     first = firing[0] if firing else None
 
@@ -279,6 +328,8 @@ def diagnose_postmortem(dir_: str) -> dict:
             "first_degradation": first,
             "alerts": alerts,
             "faults": faults,
+            "partition": partition,
+            "parks": parks,
             "timeseries": ts,
             "trace": trace,
             "culprit": culprit}
@@ -340,6 +391,20 @@ def render_markdown(report: dict) -> str:
                             else ""))
             for e in c["evidence"]:
                 lines.append("  - %s" % e)
+        if report.get("partition"):
+            p = report["partition"]
+            lines.append("\n## Network partition")
+            lines.append("- sides: %s | %s"
+                         % (p.get("side_a"), p.get("side_b")))
+            if p.get("parked_ranks"):
+                lines.append("- minority parked: rank(s) %s (quorum "
+                             "gate refused the epoch)"
+                             % p["parked_ranks"])
+            if p.get("healed"):
+                lines.append("- healed after %sms"
+                             % p.get("split_ms", "?"))
+            else:
+                lines.append("- NEVER healed within the recorded window")
         if report["alerts"]:
             lines.append("\n## Alert timeline")
             for a in report["alerts"]:

@@ -130,7 +130,8 @@ def _alert_rules(entry: dict) -> list:
 
 def _rank_row(rank: int, entry: dict, slow=None, probation=(),
               role: str = "trainer", arc: float = None,
-              label: str = None, hist: dict = None) -> tuple:
+              label: str = None, hist: dict = None,
+              gstate: str = None) -> tuple:
     """One table row from a rank's cached snapshot (missing fields render
     as '-': a rank mid-transition posts partial snapshots).  ``slow`` is
     the bus's per-rank step-barrier phi score, ``probation`` the demoted
@@ -183,7 +184,11 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=(),
         # gray-failure columns: the coordinator's phi suspicion of this
         # rank's step-barrier lag, and whether it is demoted right now
         fmt(slow, "{:.1f}"),
-        "PROBATION" if rank in probation else "ok",
+        # STATE: probation wins; else the gossip membership verdict
+        # (alive/suspect/dead/parked, fault/gossip.py) when the SWIM
+        # plane is on; plain "ok" otherwise
+        ("PROBATION" if rank in probation
+         else (gstate if gstate and gstate != "alive" else "ok")),
         fmt(m.get("epoch")),
         fmt(step.get("step")),
         fmt(entry.get("age_s"), "{:.1f}s"),
@@ -195,18 +200,23 @@ def render(cluster: dict) -> str:
     slow = cluster.get("slow") or {}
     probation = set(cluster.get("probation") or ())
     history = cluster.get("history") or {}
+    # gossip membership states (ISSUE 17): {rank: {"inc","state","hb"}}
+    # from the local SWIM table — suspect/dead/parked rows stay visible
+    # even when their metrics payloads have gone stale
+    gstates = {int(r): (e or {}).get("state")
+               for r, e in (cluster.get("states") or {}).items()}
     rows = [_COLUMNS]
     ranks = cluster.get("ranks", {})
     coordinator = cluster.get("coordinator")
     # demoted ranks leave the world (and the metrics cache) but stay
     # VISIBLE: a probation row with '-' metrics is the operator's cue
     # that the rank is parked, not vanished
-    for rank in sorted(set(ranks) | probation):
+    for rank in sorted(set(ranks) | probation | set(gstates)):
         rows.append(_rank_row(
             rank, ranks.get(rank, {}), slow=slow.get(rank),
             probation=probation,
             role="coordinator" if rank == coordinator else "trainer",
-            hist=history.get(rank)))
+            hist=history.get(rank), gstate=gstates.get(rank)))
     # serving-tier rows (server/serving_tier.py): every host in the
     # bus's serving directory is a first-class row — id prefixed 's',
     # ROLE=serve, ring-arc share from the same ring math every client
@@ -236,6 +246,8 @@ def render(cluster: dict) -> str:
             len(serve_hosts), cluster.get("serve_gen"))
     if probation:
         head += " — probation=%s" % sorted(probation)
+    if cluster.get("gossip"):
+        head += " — gossip view (no bus round-trip)"
     if cluster.get("failover_in_progress"):
         head += (" (COORDINATOR FAILOVER IN PROGRESS — bus not "
                  "answering, local-only view)")

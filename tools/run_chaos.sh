@@ -14,7 +14,19 @@
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
 #               | serve_dist | straggler | compressed | trace
-#               | transport | doctor | lint | all
+#               | transport | doctor | gossip | lint | all
+#         gossip: the partition-tolerance slice (ISSUE 17,
+#              fault/gossip.py, docs/fault_tolerance.md) — the
+#              multi-process split-brain proof (partition:ranks=A|B
+#              cuts the world, the majority side shrinks and keeps
+#              training, the minority parks with
+#              membership.partition_minority, NO second epoch is ever
+#              agreed, heal → rejoin → bit-identical finals), gray
+#              suspect/refutation (a slow-but-live rank un-suspects
+#              itself via incarnation bump), the 64-rank in-process
+#              convergence pins, and the bps_doctor partition
+#              postmortem (tests/test_partition.py,
+#              tests/test_gossip.py)
 #         serve_dist: the distributed-serving-tier chaos slice
 #              (server/serving_tier.py, docs/serving.md) — ≥3 real
 #              serving-host processes behind the TCP transport serve a
@@ -124,6 +136,9 @@ case "${1:-}" in
     transport) MARK="chaos or integrity"; KEXPR="transport"; shift ;;
     trace)     MARK="chaos"; KEXPR="trace or attrib"; shift ;;
     doctor)    MARK="chaos"; KEXPR="doctor or timeseries or health"; shift ;;
+    gossip)    MARK="chaos"
+               KEXPR="gossip or partition or quorum"
+               shift ;;
     all)       MARK="chaos or integrity"; shift ;;
     lint)
         shift
