@@ -431,6 +431,33 @@ class Config:
     #                                  directory (serve_host.py reads it
     #                                  to register; empty = standalone)
 
+    # --- fleet reconciler (launcher/reconciler.py, docs/serving.md) ---
+    reconcile_interval_s: float = 0.5
+    #                                  BYTEPS_RECONCILE_INTERVAL: seconds
+    #                                  between reconcile passes (watch
+    #                                  the directory, converge actual
+    #                                  fleet to the serve_scale target)
+    reconcile_flap_limit: int = 3    # BYTEPS_RECONCILE_FLAP_LIMIT:
+    #                                  crashes inside the flap window
+    #                                  after which a host id is BANNED
+    #                                  (directory ban, arc re-homed to a
+    #                                  fresh id) instead of restarted
+    reconcile_flap_window_s: float = 30.0
+    #                                  BYTEPS_RECONCILE_FLAP_WINDOW:
+    #                                  sliding window (seconds) the flap
+    #                                  limit counts crashes inside
+    reconcile_drain_deadline_s: float = 10.0
+    #                                  BYTEPS_RECONCILE_DRAIN_DEADLINE:
+    #                                  seconds a DRAINING host gets to
+    #                                  finish in-flight pulls and
+    #                                  unregister before the reconciler
+    #                                  escalates to SIGTERM/kill
+    reconcile_ban_s: float = 30.0    # BYTEPS_RECONCILE_BAN: directory
+    #                                  ban length for a flapping host id
+    #                                  (refuses re-registration, so the
+    #                                  crash-looper cannot rejoin the
+    #                                  ring under the same identity)
+
     # --- TCP transport (comm/transport.py, docs/transport.md) ---
     transport_hosts: str = ""        # BYTEPS_TRANSPORT_HOSTS: per-rank
     #                                  "host[:port]" list (comma-separated,
@@ -801,6 +828,20 @@ class Config:
                              "serve_tier_min_hosts")
         if self.serve_tier_cooldown_s < 0:
             raise ValueError("serve_tier_cooldown_s must be >= 0")
+        if self.reconcile_interval_s <= 0:
+            raise ValueError("reconcile_interval_s must be positive")
+        if self.reconcile_flap_limit < 1:
+            raise ValueError("reconcile_flap_limit must be >= 1 (the "
+                             "crash count that triggers the ban)")
+        if self.reconcile_flap_window_s <= 0:
+            raise ValueError("reconcile_flap_window_s must be positive")
+        if self.reconcile_drain_deadline_s <= 0:
+            raise ValueError("reconcile_drain_deadline_s must be "
+                             "positive — a 0 deadline would kill every "
+                             "drain before its first in-flight pull "
+                             "finished")
+        if self.reconcile_ban_s < 0:
+            raise ValueError("reconcile_ban_s must be >= 0")
         if self.obs_port is not None and not 0 <= self.obs_port < 65536:
             raise ValueError("obs_port must be in 0..65535 (0 = ephemeral)")
         if self.flight_capacity <= 0:
@@ -920,6 +961,15 @@ class Config:
             serve_tier_cooldown_s=_env_float(
                 "BYTEPS_SERVE_TIER_COOLDOWN", 5.0),
             serve_tier_bus=_env_str("BYTEPS_SERVE_TIER_BUS", ""),
+            reconcile_interval_s=_env_float("BYTEPS_RECONCILE_INTERVAL",
+                                            0.5),
+            reconcile_flap_limit=_env_int("BYTEPS_RECONCILE_FLAP_LIMIT",
+                                          3),
+            reconcile_flap_window_s=_env_float(
+                "BYTEPS_RECONCILE_FLAP_WINDOW", 30.0),
+            reconcile_drain_deadline_s=_env_float(
+                "BYTEPS_RECONCILE_DRAIN_DEADLINE", 10.0),
+            reconcile_ban_s=_env_float("BYTEPS_RECONCILE_BAN", 30.0),
             transport_hosts=_env_str("BYTEPS_TRANSPORT_HOSTS", ""),
             transport_port_base=_env_int("BYTEPS_TRANSPORT_PORT_BASE", 0),
             transport_connect_timeout_s=_env_float(

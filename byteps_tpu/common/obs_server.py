@@ -142,6 +142,10 @@ def debug_state() -> dict:
         # staged/committed/shed state on a serving host
         "serving_tier": [c.debug_state()
                          for c in _metrics.components("serving_tier")],
+        # the fleet reconciler (launcher/reconciler.py): supervised
+        # hosts, pending crash-loop restarts, draining set, ban list
+        "reconciler": [c.debug_state()
+                       for c in _metrics.components("reconciler")],
         # the TCP transport (comm/transport.py): per-connection state
         # machine snapshots (CONNECTING/READY/DRAINING/DEAD, in-flight
         # bytes, reconnect counts) + per-server attachment/peer views

@@ -457,7 +457,13 @@ def cluster_metrics(bus: Optional[str] = None,
                            if r >= base},
            "serve_hosts": {int(h): v for h, v in
                            (reply.get("serve_hosts") or {}).items()},
-           "serve_gen": reply.get("serve_gen", 0)}
+           "serve_gen": reply.get("serve_gen", 0),
+           # fleet reconciliation view (ISSUE 18): the autoscaler's
+           # target and the DRAINING set — bps_top's fleet banner
+           # (target=N actual=M) and per-host DRAINING state read these
+           "serve_target": reply.get("serve_target"),
+           "serve_draining": [int(h) for h in
+                              (reply.get("serve_draining") or ())]}
     for k in ("coordinator", "standby", "bus_rank"):
         if reply.get(k) is not None:
             out[k] = reply[k]

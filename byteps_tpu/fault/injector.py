@@ -24,6 +24,11 @@ Spec grammar (``BYTEPS_FAULT_SPEC``, ``;``- or ``,``-separated faults)::
                                    lifetime counter is already past the
                                    step is never cascade-killed by the
                                    re-armed schedule
+    kill:site=serve_host_start:step=1   die at serve-host startup,
+                                   BEFORE HOST-UP (step=N = the Nth
+                                   start of this process; N=1 is the
+                                   deterministic crash-looper the
+                                   reconciler's flap ban is tested with)
     delay:site=dcn:p=0.01:ms=200   sleep 200ms with prob 0.01 per visit
     bitflip:site=server_push:p=0.001   flip one random bit of the pushed
                                    value with prob 0.001
@@ -160,6 +165,8 @@ VALID_SITES = (
     "coordinator",
     "dcn", "dispatch", "gossip", "heartbeat", "kv_push",
     "serve_host",
+    # bpslint: ignore[chaos-site] reason=kill-only predicate matched in on_serve_start (die at serve-host startup, before HOST-UP), never a woven fire() site
+    "serve_host_start",
     "serve_pull", "server_pull", "server_push", "sync", "transport")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
@@ -341,15 +348,19 @@ def parse_spec(spec: str) -> List[FaultRule]:
                                       "the ANSWERED-PULL count for "
                                       "site=serve_host)")
         if kind == "kill" and site not in (None, "coordinator",
-                                           "serve_host"):
+                                           "serve_host",
+                                           "serve_host_start"):
             raise _fail(spec, clause,
                         "kill supports only site=coordinator (die only "
-                        "while hosting the membership control plane) or "
+                        "while hosting the membership control plane), "
                         "site=serve_host (die at the Nth answered serving "
-                        "pull — the ring-aware mid-storm host kill)")
-        if kind != "kill" and site == "coordinator":
+                        "pull — the ring-aware mid-storm host kill), or "
+                        "site=serve_host_start (die at serve-host "
+                        "startup, before HOST-UP — the launch crash the "
+                        "reconciler's flap ban absorbs)")
+        if kind != "kill" and site in ("coordinator", "serve_host_start"):
             raise _fail(spec, clause,
-                        "site=coordinator is a kill-only predicate, not a "
+                        f"site={site} is a kill-only predicate, not a "
                         "woven code site")
         if kind in ("delay", "drop") and site is None:
             raise _fail(spec, clause,
@@ -433,6 +444,7 @@ class FaultInjector:
                             if r.kind == "partition" and r.ranks is not None]
         self._step = 0
         self._serves = 0   # answered serving pulls (site=serve_host kills)
+        self._serve_starts = 0   # serve-host startups (serve_host_start)
         # survives disarm(engine_scoped_only=True) — see module arm()
         self.persist = False
         self._lock = threading.Lock()
@@ -500,6 +512,32 @@ class FaultInjector:
             from ..common import flight_recorder as _flight
             _flight.record("fault.kill", step=n, rank=self.rank,
                            code=r.code, site="serve_host")
+            _flight.dump("chaos_kill")
+            _exit(r.code)
+
+    def on_serve_start(self) -> None:
+        """Advance the serve-host startup counter and honor
+        ``site=serve_host_start`` kill rules — die BEFORE HOST-UP, the
+        deterministic launch crash (``step=1`` = die at the first start
+        of this process) the reconciler's crash-loop backoff and flap
+        ban are tested against."""
+        with self._lock:
+            self._serve_starts += 1
+            n = self._serve_starts
+        for r in self._kills:
+            if r.site != "serve_host_start":
+                continue
+            if r.rank is not None and r.rank != self.rank:
+                continue
+            if n != r.step:
+                continue
+            counters.inc("fault.kill")
+            get_logger().error(
+                "fault injector: serve_host_start kill at start %d "
+                "(host %d) — exiting %d", n, self.rank, r.code)
+            from ..common import flight_recorder as _flight
+            _flight.record("fault.kill", step=n, rank=self.rank,
+                           code=r.code, site="serve_host_start")
             _flight.dump("chaos_kill")
             _exit(r.code)
 
@@ -755,6 +793,13 @@ def on_serve() -> None:
     """Serving-host twin of :func:`on_step` (``kill:site=serve_host``)."""
     if _active is not None:
         _active.on_serve()
+
+
+def on_serve_start() -> None:
+    """Serve-host startup twin (``kill:site=serve_host_start`` — die
+    before HOST-UP)."""
+    if _active is not None:
+        _active.on_serve_start()
 
 
 def fire(site: str) -> None:

@@ -581,6 +581,16 @@ class _BusServer:
         # into each other).  Changing it bumps the generation so every
         # ring consumer re-routes the demoted arcs.
         self._serve_probation: set = set()
+        # host ids mid graceful drain (launcher/reconciler.py): marked
+        # by the host's own re-registration with draining=True; routers
+        # and the publisher exclude them exactly like probation (the
+        # transition bumps the generation), in-flight pulls finish, and
+        # the final unregister clears the mark
+        self._serve_draining: set = set()
+        # scale-down victims the autoscaler PROPOSED (serve_scale
+        # victims=[...]): the reconciler reads them from serve_dir and
+        # drains exactly those hosts — cleared as each victim leaves
+        self._serve_victims: set = set()
         # host_id -> wall time until which re-registration is refused: a
         # retired host whose CONTROL plane still heartbeats (the gray
         # failure: bus reachable, data plane dead) must not flap back
@@ -624,6 +634,10 @@ class _BusServer:
             self._serve_target = srv.get("target")
             self._serve_probation = {int(h) for h in
                                      (srv.get("probation") or ())}
+            self._serve_draining = {int(h) for h in
+                                    (srv.get("draining") or ())}
+            self._serve_victims = {int(h) for h in
+                                   (srv.get("victims") or ())}
             self._serve_banned = {int(h): float(t) for h, t in
                                   (srv.get("banned") or {}).items()}
         self._stop = threading.Event()
@@ -696,6 +710,12 @@ class _BusServer:
                       "gen": self._serve_gen,
                       "target": self._serve_target,
                       "probation": sorted(self._serve_probation),
+                      # mid-drain marks and proposed victims survive a
+                      # failover: a successor that forgot them would
+                      # route new pulls back onto a host that is busy
+                      # finishing its last ones and exiting
+                      "draining": sorted(self._serve_draining),
+                      "victims": sorted(self._serve_victims),
                       # wall-clock expiry stamps, valid on any host —
                       # without them a failover forgets the ban and a
                       # retired-but-heartbeating host flaps back into
@@ -1218,6 +1238,11 @@ class _BusServer:
                     and (r - SERVE_RANK_BASE) in self._serve_hosts)}
             return {"ok": True, "epoch": self.epoch,
                     "serve_gen": self._serve_gen,
+                    # fleet reconciliation view (bps_top's banner and
+                    # DRAINING rows): the autoscaler's target and who is
+                    # mid-drain right now
+                    "serve_target": self._serve_target,
+                    "serve_draining": sorted(self._serve_draining),
                     "serve_hosts": {
                         h: {"addr": list(v["addr"]),
                             "age_s": round(now - v["ts"], 3)}
@@ -1313,6 +1338,10 @@ class _BusServer:
                 if now - v["ts"] > v["ttl"]]
         for h in dead:
             del self._serve_hosts[h]
+            # an expired host's drain/victim marks are residue — a
+            # fresh registration under the same id must start clean
+            self._serve_draining.discard(h)
+            self._serve_victims.discard(h)
         if dead:
             self._serve_gen += 1
 
@@ -1344,7 +1373,20 @@ class _BusServer:
             self._serve_hosts[hid] = {"addr": addr, "ts": time.time(),
                                       "ttl": ttl,
                                       "meta": dict(msg.get("meta") or {})}
-            if prev is None or tuple(prev["addr"]) != addr:
+            changed = prev is None or tuple(prev["addr"]) != addr
+            # the drain mark rides the registration (the host flips
+            # itself to DRAINING and keeps heartbeating the mark until
+            # its final unregister); either transition is membership-
+            # visible — routers must stop (or resume) sending new pulls
+            # at the next gen-driven re-sync
+            draining = bool(msg.get("draining"))
+            if draining != (hid in self._serve_draining):
+                if draining:
+                    self._serve_draining.add(hid)
+                else:
+                    self._serve_draining.discard(hid)
+                changed = True
+            if changed:
                 self._serve_gen += 1
             return {"ok": True, "host_id": hid, "gen": self._serve_gen,
                     "epoch": self.epoch}
@@ -1359,6 +1401,10 @@ class _BusServer:
             hid = int(msg["host_id"])
             if self._serve_hosts.pop(hid, None) is not None:
                 self._serve_gen += 1
+            # the drain handshake completes here: the departing host's
+            # final unregister clears its mark (and its victim entry)
+            self._serve_draining.discard(hid)
+            self._serve_victims.discard(hid)
             ban = float(msg.get("ban_s") or 0.0)
             if ban > 0:
                 self._serve_banned[hid] = time.time() + ban
@@ -1376,6 +1422,8 @@ class _BusServer:
                     "epoch": self.epoch,
                     "target": self._serve_target,
                     "probation": sorted(self._serve_probation),
+                    "draining": sorted(self._serve_draining),
+                    "victims": sorted(self._serve_victims),
                     "hosts": {h: {"addr": list(v["addr"]),
                                   "age_s": round(now - v["ts"], 3),
                                   "meta": dict(v.get("meta") or {})}
@@ -1398,8 +1446,17 @@ class _BusServer:
                 if new != self._serve_probation:
                     self._serve_probation = new
                     self._serve_gen += 1
+            if "victims" in msg:
+                # scale-down victim PROPOSALS (autoscaler dispose mode):
+                # carried, not acted on — the reconciler reads them from
+                # serve_dir and runs the drain; no gen bump, routing
+                # only changes when a victim actually flips to DRAINING
+                self._serve_victims = {int(h)
+                                       for h in (msg["victims"] or ())
+                                       if int(h) in self._serve_hosts}
             return {"ok": True, "target": self._serve_target,
                     "probation": sorted(self._serve_probation),
+                    "victims": sorted(self._serve_victims),
                     "gen": self._serve_gen}
 
 

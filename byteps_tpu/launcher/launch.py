@@ -16,8 +16,16 @@ half of the recovery story whose inner half is
 :class:`byteps_tpu.fault.RecoveryCoordinator`.  Any other exit code (a
 real crash, a signal) passes through unretried.
 
+Fleet mode: a leading ``--fleet`` embeds the
+:class:`~byteps_tpu.launcher.reconciler.FleetReconciler` — with a
+command it runs on a background thread beside the worker (the launcher
+that starts training also keeps the serving fleet converged to the
+autoscaler's target); with no command it is equivalent to
+``python -m byteps_tpu.launcher.reconciler`` (standalone loop).
+
 Usage:
-    bpslaunch [--restart N] python train.py ...
+    bpslaunch [--restart N] [--fleet] python train.py ...
+    bpslaunch --fleet                  # standalone reconciler
 Env (DMLC-compatible, reference docs/env.md:7-45):
     DMLC_ROLE                worker|server|scheduler (default worker)
     DMLC_NUM_WORKER          number of hosts (default 1)
@@ -89,15 +97,25 @@ def launch_worker(cmd: list, restart_limit: Optional[int] = None) -> int:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     restart_limit = None
-    # only a LEADING --restart N belongs to bpslaunch; anything after the
-    # command is the command's own business
-    if argv[:1] == ["--restart"]:
+    fleet = False
+    # only LEADING --restart N / --fleet belong to bpslaunch; anything
+    # after the command is the command's own business
+    while argv[:1] in (["--restart"], ["--fleet"]):
+        if argv[0] == "--fleet":
+            fleet = True
+            argv = argv[1:]
+            continue
         if len(argv) < 2 or not argv[1].isdigit():
-            print("usage: bpslaunch [--restart N] COMMAND [ARGS...]",
-                  file=sys.stderr)
+            print("usage: bpslaunch [--restart N] [--fleet] "
+                  "COMMAND [ARGS...]", file=sys.stderr)
             return 2
         restart_limit = int(argv[1])
         argv = argv[2:]
+    if fleet and not argv:
+        # standalone reconciler: same as `python -m
+        # byteps_tpu.launcher.reconciler`
+        from .reconciler import main as reconciler_main
+        return reconciler_main([])
     role = os.environ.get("DMLC_ROLE", "worker").lower()
     if role in ("server", "scheduler"):
         # The reference runs `python3 -c 'import byteps.server'` here
@@ -109,10 +127,29 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 0
     if not argv:
-        print("usage: bpslaunch [--restart N] COMMAND [ARGS...]",
-              file=sys.stderr)
+        print("usage: bpslaunch [--restart N] [--fleet] COMMAND "
+              "[ARGS...]", file=sys.stderr)
         return 2
-    return launch_worker(argv, restart_limit=restart_limit)
+    rec = None
+    if fleet:
+        # embedded: the reconciler supervises the serving fleet on a
+        # background thread while the worker trains
+        import threading
+        from .reconciler import FleetReconciler
+        rec = FleetReconciler()
+        if rec.directory.bus is None:
+            print("bpslaunch: --fleet needs BYTEPS_SERVE_TIER_BUS; "
+                  "running the worker without fleet supervision",
+                  file=sys.stderr)
+            rec = None
+        else:
+            threading.Thread(target=rec.run, daemon=True,
+                             name="bps-fleet-reconciler").start()
+    try:
+        return launch_worker(argv, restart_limit=restart_limit)
+    finally:
+        if rec is not None:
+            rec.close()
 
 
 if __name__ == "__main__":

@@ -65,10 +65,16 @@ class TierAutoscaler:
                  max_hosts: Optional[int] = None,
                  cooldown_s: Optional[float] = None,
                  low_pulls_per_s: float = 50.0,
-                 hot_n: int = 8):
+                 hot_n: int = 8,
+                 dispose: str = "retire"):
         from ..common.config import get_config
         cfg = get_config()
+        if dispose not in ("retire", "drain"):
+            raise ValueError("dispose must be 'retire' (unregister the "
+                             "victim now) or 'drain' (propose it to the "
+                             "fleet reconciler's graceful drain)")
         self.tier = tier
+        self.dispose = dispose
         self.min_hosts = (cfg.serve_tier_min_hosts if min_hosts is None
                           else int(min_hosts))
         self.max_hosts = (cfg.serve_tier_max_hosts if max_hosts is None
@@ -229,6 +235,18 @@ class TierAutoscaler:
             counters.inc("serve.tier_scale_up")
         else:
             counters.inc("serve.tier_scale_down")
-            for v in decision.victims:
-                self.tier.retire_host(v, reason=decision.reason)
+            if self.dispose == "drain":
+                # the autoscaler PROPOSES, the reconciler DISPOSES:
+                # victims ride the bus and are retired through the
+                # graceful drain (in-flight pulls finish, final
+                # unregister handshake, bounded by the drain deadline)
+                try:
+                    self.tier.directory.propose_victims(decision.victims)
+                except (ConnectionError, TimeoutError):
+                    get_logger().warning("serve autoscaler: victim "
+                                         "proposal could not reach the "
+                                         "bus")
+            else:
+                for v in decision.victims:
+                    self.tier.retire_host(v, reason=decision.reason)
         return decision

@@ -23,8 +23,12 @@ Environment:
   (``kill:site=serve_host:step=N`` dies at the Nth answered pull)
 
 Prints ``HOST-UP <host_id> <host> <port>`` once serving, then runs until
-SIGTERM/SIGINT (clean: unregister, close) or the chaos injector kills
-it.
+SIGTERM/SIGINT (clean: unregister, close), a graceful drain
+(``serve_ctl drain``: mark the directory DRAINING, finish in-flight
+pulls, final unregister handshake, print ``HOST-DRAINED <host_id>``,
+exit 0), or the chaos injector kills it
+(``kill:site=serve_host_start:step=1`` dies before HOST-UP — the
+deterministic crash-looper the reconciler's flap ban is tested with).
 """
 
 from __future__ import annotations
@@ -76,6 +80,12 @@ def main(argv=None) -> int:
             core.server.server_id = hid
             if spec:
                 inj.arm(spec, seed=cfg.fault_seed, rank=hid)
+    if inj.ENABLED:
+        # the startup kill site: a ``kill:site=serve_host_start`` rule
+        # dies HERE — registered (the directory will see the flap) but
+        # before HOST-UP, the launch-crash the reconciler's crash-loop
+        # backoff and flap ban must absorb
+        inj.on_serve_start()
     print(f"HOST-UP {hid} {srv.host} {srv.port}", flush=True)
 
     stop = threading.Event()
@@ -87,13 +97,17 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, _sig)
 
     def heartbeat():
-        """Directory TTL refresh + autoscaler signals + bps_top row."""
+        """Directory TTL refresh + autoscaler signals + bps_top row.
+        Drain-aware: once the drain latch is set, every beat re-asserts
+        the DRAINING mark (a plain re-registration would clear it and
+        flap the host back into the ring mid-drain)."""
         while not stop.wait(max(directory.ttl_s / 3.0, 0.5)):
             if directory.bus is None:
                 continue
             try:
                 directory.register(
                     srv.addr, host_id=hid,
+                    draining=core.draining.is_set(),
                     meta={"pulls": core.pulls, "sheds": core.sheds,
                           "hot": core.hot_keys(8), "role": "serve"})
                 snap = metrics_snapshot(light=True)
@@ -117,16 +131,54 @@ def main(argv=None) -> int:
 
     threading.Thread(target=heartbeat, daemon=True,
                      name=f"bps-serve-host-hb-{hid}").start()
+    drained = False
     try:
         while not stop.wait(0.25):
-            pass
+            if core.draining.is_set():
+                break
+        if core.draining.is_set() and not stop.is_set():
+            # -- the graceful drain state machine --------------------
+            # 1) mark the directory: the gen bump re-routes every
+            #    consumer off this arc at its next sync
+            if directory.bus is not None:
+                try:
+                    directory.register(
+                        srv.addr, host_id=hid, draining=True,
+                        meta={"pulls": core.pulls, "sheds": core.sheds,
+                              "hot": core.hot_keys(8), "role": "serve"})
+                except (ConnectionError, TimeoutError):
+                    get_logger().warning(
+                        "serve host %d: drain mark could not reach the "
+                        "bus (heartbeat retries)", hid)
+            # 2) in-flight pulls finish.  Quiet for a short settle
+            #    window, not just a zero sample: stale routers (one
+            #    sync interval behind the gen bump) may still land a
+            #    last pull — answered normally, never refused.  The
+            #    deadline bounds a wedged drain; the reconciler's own
+            #    deadline escalates to kill beyond it.
+            deadline = (time.monotonic()
+                        + cfg.reconcile_drain_deadline_s)
+            quiet_t = None
+            while time.monotonic() < deadline and not stop.is_set():
+                if core.admission.inflight > 0:
+                    quiet_t = None
+                elif quiet_t is None:
+                    quiet_t = time.monotonic()
+                elif time.monotonic() - quiet_t >= 0.3:
+                    break
+                time.sleep(0.05)
+            drained = True
     finally:
+        # 3) the final unregister handshake (clears the DRAINING mark
+        #    on the bus), then clean exit
         if directory.bus is not None:
             try:
                 directory.unregister(hid)
             except Exception:  # noqa: BLE001 — TTL finishes the job
                 pass
         srv.close()
+    if drained:
+        print(f"HOST-DRAINED {hid} {core.pulls}", flush=True)
     return 0
 
 

@@ -189,6 +189,10 @@ class ServingHostCore:
         self._pull_counts: Dict[str, int] = {}
         self.pulls = 0
         self.sheds = 0
+        # graceful-drain latch (``serve_ctl drain``): the owning process
+        # (serve_host.py main loop) watches it — marks the directory,
+        # lets in-flight pulls finish, unregisters, exits clean
+        self.draining = threading.Event()
         from ..common import metrics as _metrics
         _metrics.register_component("serving_tier", self)
 
@@ -315,6 +319,16 @@ class ServingHostCore:
         if cmd == "chaos_disarm":
             _fault.disarm()
             return {"disarmed": True}
+        if cmd == "drain":
+            # graceful retirement (the reconciler's scale-down path):
+            # flip the latch and ACK with the current in-flight depth —
+            # the process-level state machine (serve_host.py) marks the
+            # directory, finishes in-flight pulls, unregisters, exits.
+            # Idempotent: a retransmitted drain finds the latch set.
+            self.draining.set()
+            counters.inc("serve.drain_requested")
+            return {"draining": True,
+                    "inflight": self.admission.inflight}
         raise ValueError(f"unknown serve_ctl command {cmd!r}")
 
     # -- the read path -------------------------------------------------------
@@ -388,6 +402,7 @@ class ServingHostCore:
                 "staged": staged,
                 "pulls": self.pulls,
                 "sheds": self.sheds,
+                "draining": self.draining.is_set(),
                 "hot_keys": self.hot_keys(4),
                 "admission": self.admission.snapshot()}
 
@@ -502,6 +517,8 @@ class TierDirectory:
         self._hosts: Dict[int, Tuple[str, int]] = {}
         self._meta: Dict[int, dict] = {}
         self._probation: List[int] = []
+        self._draining: List[int] = []
+        self._victims: List[int] = []
         self._target: Optional[int] = None
         self._fetched = 0.0
         self._next_id = itertools.count(0)
@@ -517,20 +534,34 @@ class TierDirectory:
     # -- registration (host side) -------------------------------------------
 
     def register(self, addr, host_id: Optional[int] = None,
-                 meta: Optional[dict] = None) -> int:
+                 meta: Optional[dict] = None,
+                 draining: bool = False) -> int:
+        """``draining=True`` marks the registration as mid graceful
+        drain: the directory keeps the host visible (its in-flight pulls
+        still need the address) but every consumer's :meth:`hosts` view
+        excludes it, so no NEW pulls route there — the routing half of
+        the ``serve_ctl drain`` protocol (docs/serving.md)."""
         addr = (str(addr[0]), int(addr[1]))
         if self.bus is None:
             with self._lock:
                 if host_id is None:
                     host_id = (max(self._hosts) + 1 if self._hosts else 0)
-                changed = self._hosts.get(int(host_id)) != addr
-                self._hosts[int(host_id)] = addr
-                self._meta[int(host_id)] = dict(meta or {})
+                hid = int(host_id)
+                changed = self._hosts.get(hid) != addr
+                self._hosts[hid] = addr
+                self._meta[hid] = dict(meta or {})
+                if draining != (hid in self._draining):
+                    if draining:
+                        self._draining.append(hid)
+                    else:
+                        self._draining.remove(hid)
+                    changed = True
                 if changed:
                     self._gen += 1
-                return int(host_id)
+                return hid
         reply = self._request({"op": "serve_register", "host_id": host_id,
                               "addr": list(addr), "ttl_s": self.ttl_s,
+                              "draining": bool(draining),
                               "meta": meta or {}})
         if not reply.get("ok"):
             if reply.get("banned"):
@@ -545,9 +576,14 @@ class TierDirectory:
                    ban_s: Optional[float] = None) -> None:
         if self.bus is None:
             with self._lock:
-                if self._hosts.pop(int(host_id), None) is not None:
-                    self._meta.pop(int(host_id), None)
+                hid = int(host_id)
+                if self._hosts.pop(hid, None) is not None:
+                    self._meta.pop(hid, None)
                     self._gen += 1
+                if hid in self._draining:
+                    self._draining.remove(hid)
+                if hid in self._victims:
+                    self._victims.remove(hid)
             return
         try:
             self._request({"op": "serve_unregister",
@@ -587,6 +623,8 @@ class TierDirectory:
             self._meta = {int(h): dict(v.get("meta") or {})
                           for h, v in reply["hosts"].items()}
             self._probation = [int(r) for r in reply.get("probation") or ()]
+            self._draining = [int(h) for h in reply.get("draining") or ()]
+            self._victims = [int(h) for h in reply.get("victims") or ()]
             self._target = reply.get("target")
 
     def hosts(self, force: bool = False) -> Tuple[int, Dict[int,
@@ -598,11 +636,15 @@ class TierDirectory:
         unboundedly stale data as fresh).  Probation changes bump the
         generation, so consumers re-sync exactly when it changes.  The
         raw registration list (probation included) is in
-        :meth:`info`."""
+        :meth:`info`.  DRAINING hosts are excluded the same way: a
+        draining host finishes its in-flight pulls but must receive no
+        new ones — the gen bump at the drain mark re-syncs every
+        consumer off its arc."""
         self.refresh(force=force)
         with self._lock:
             return self._gen, {h: a for h, a in self._hosts.items()
-                               if h not in self._probation}
+                               if h not in self._probation
+                               and h not in self._draining}
 
     def info(self) -> dict:
         self.refresh()
@@ -610,6 +652,8 @@ class TierDirectory:
             return {"gen": self._gen, "hosts": dict(self._hosts),
                     "meta": {h: dict(m) for h, m in self._meta.items()},
                     "probation": list(self._probation),
+                    "draining": list(self._draining),
+                    "victims": list(self._victims),
                     "target": self._target}
 
     def set_target(self, target: Optional[int]) -> None:
@@ -631,6 +675,20 @@ class TierDirectory:
                     self._gen += 1
             return
         self._request({"op": "serve_scale", "probation": probation})
+
+    def propose_victims(self, hosts) -> None:
+        """Publish the autoscaler's scale-down victim PROPOSALS (rides
+        ``serve_scale`` like the target): the reconciler reads them from
+        ``serve_dir`` and retires each through the graceful drain
+        protocol instead of an immediate unregister.  No gen bump —
+        routing only changes when a victim actually flips to
+        DRAINING."""
+        victims = sorted(int(h) for h in hosts)
+        if self.bus is None:
+            with self._lock:
+                self._victims = [h for h in victims if h in self._hosts]
+            return
+        self._request({"op": "serve_scale", "victims": victims})
 
     def target(self) -> Optional[int]:
         self.refresh()
@@ -725,10 +783,17 @@ class ServingTier:
                 return
             self._gen = gen
             placed = set(hosts) - self._probation
+            # a host re-registered under the SAME id at a NEW address is
+            # a new incarnation (the reconciler's restart-in-place): its
+            # staged state is gone, so the cached connection, the acked
+            # map, and the fail streak all describe a dead process —
+            # drop them, and the next cut re-ships the full owned slice
+            moved = {h for h, a in hosts.items()
+                     if h in self._addrs and self._addrs[h] != a}
             stale_eps = [self._eps.pop(h) for h in list(self._eps)
-                         if h not in hosts]
+                         if h not in hosts or h in moved]
             for h in list(self._shipped):
-                if h not in hosts:
+                if h not in hosts or h in moved:
                     del self._shipped[h]
                     self._fails.pop(h, None)
             self._addrs = dict(hosts)
@@ -1002,12 +1067,19 @@ class TierRouter:
             if gen == self._gen:
                 return
             self._gen = gen
+            # same-id re-registration at a new address = a RESTARTED
+            # host (new incarnation): the cached connection dials a dead
+            # port and the delta base refers to the old process's
+            # snapshot numbering — both must go, or every pull to the
+            # arc parks on the corpse / reads a bogus delta
+            moved = {h for h, a in hosts.items()
+                     if h in self._addrs and self._addrs[h] != a}
             self._addrs = dict(hosts)
             self._owner_memo.clear()
             dead_eps = [self._eps.pop(h) for h in list(self._eps)
-                        if h not in hosts]
+                        if h not in hosts or h in moved]
             for h in list(self._since):
-                if h not in hosts:
+                if h not in hosts or h in moved:
                     del self._since[h]
         self.ring.set_hosts(hosts)
         for ep in dead_eps:

@@ -762,6 +762,14 @@ def test_bench_smoke_floor_and_gate_arithmetic(tmp_path, monkeypatch):
     monkeypatch.setattr(bs, "_measure_trace", lambda: {
         "sample_n": 4, "overhead_ratio": 0.95, "events_buffered": 8,
         "events_dropped": 0})
+    # the fleet measurement spawns real host processes and churns them
+    # for seconds; its gate arithmetic is pinned separately below
+    monkeypatch.setattr(bs, "_measure_fleet", lambda: {
+        "base_hosts": 2, "peak_hosts": 4, "pulls_per_s": 1e9,
+        "p50_ms": 0.1, "p99_ms": 1.0, "pushes_per_s": 10.0,
+        "failed_reads": 0, "spawned": 4, "drain_started": 2,
+        "drained": 2, "drain_escalated": 0, "banned": 0,
+        "final_hosts": 2, "still_draining": []})
     monkeypatch.setattr(bs, "setup_cpu8_mesh", lambda: None)
     monkeypatch.setenv("BENCH_SMOKE_TOLERANCE", "0.30")
     monkeypatch.setattr(sys, "argv", ["bench_smoke.py"])
@@ -809,6 +817,53 @@ def test_bench_smoke_serve_dist_floor_and_gate_arithmetic():
     slow = sd()
     slow["pulls_per_s"] = 0.1
     assert not bs._serve_dist_ok(slow, floor, 0.3)
+
+
+def test_bench_smoke_fleet_floor_and_gate_arithmetic():
+    """ISSUE 18: the fleet lane gates on zero failed reads through
+    autoscaler-driven churn (absolute), the churn actually happening
+    (spawns to the peak AND at least one graceful drain), drains
+    landing clean (none escalated, none stuck), and pulls/s under churn
+    over the floor with the lane tolerance.  Pin the floor file's entry
+    and the pure gate function."""
+    from tools import bench_smoke as bs
+    with open(bs.FLOOR_PATH) as f:
+        floor = json.load(f)
+    assert floor["fleet_pulls_per_s_floor"] > 0
+
+    def fl():
+        return {"failed_reads": 0, "pulls_per_s": 1e9, "peak_hosts": 4,
+                "spawned": 4, "drained": 2, "drain_escalated": 0,
+                "still_draining": []}
+
+    good = fl()
+    assert bs._fleet_ok(good, floor, 0.3)
+    assert good["gate_pulls_per_s"] == round(
+        floor["fleet_pulls_per_s_floor"] * 0.7, 1)
+    # one failed read mid-churn fails the lane outright — no tolerance
+    bad = fl()
+    bad["failed_reads"] = 1
+    assert not bs._fleet_ok(bad, floor, 0.3)
+    # a bench whose fleet never grew gates nothing — fail loudly
+    still = fl()
+    still["spawned"] = 2
+    assert not bs._fleet_ok(still, floor, 0.3)
+    # ...same when no drain ever completed
+    nodrain = fl()
+    nodrain["drained"] = 0
+    assert not bs._fleet_ok(nodrain, floor, 0.3)
+    # an escalated (killed) drain is not a graceful scale-down
+    esc = fl()
+    esc["drain_escalated"] = 1
+    assert not bs._fleet_ok(esc, floor, 0.3)
+    # a drain still stuck at the end means the deadline machinery broke
+    stuck = fl()
+    stuck["still_draining"] = [3]
+    assert not bs._fleet_ok(stuck, floor, 0.3)
+    # a churn-machinery collapse fails the throughput floor
+    slow = fl()
+    slow["pulls_per_s"] = 0.1
+    assert not bs._fleet_ok(slow, floor, 0.3)
 
 
 def test_bench_smoke_compressed_floor_and_gate_arithmetic():

@@ -158,6 +158,29 @@ def test_rejoin_in_place_at_step_boundary():
     # bus (what bpslaunch-dist --elastic does with BYTEPS_ELASTIC_REJOIN)
     out_victim, _ = procs[1].communicate(timeout=120)
     assert procs[1].returncode == 1, out_victim[-3000:]
+    # wait for the SURVIVORS' SHRINK TO LAND (epoch 1, world {0,2}) on
+    # the bus before restarting the victim — not a sleep: under
+    # full-suite load the detector + shrink rendezvous can trail the
+    # victim's exit by seconds, and a rejoiner arriving mid-shrink
+    # would be admitted into a different epoch than the one this test
+    # pins.  The bus ping is the ground truth the rejoiner itself would
+    # consult.
+    import time as _time
+    from byteps_tpu.fault.membership import bus_request
+    deadline = _time.monotonic() + 90.0
+    while True:
+        try:
+            pong = bus_request(("127.0.0.1", int(bus)), {"op": "ping"},
+                               timeout=3.0)
+        except (ConnectionError, TimeoutError):
+            pong = {}
+        if (pong.get("ok") and int(pong.get("epoch", 0)) >= 1
+                and sorted(pong.get("world") or ()) == [0, 2]):
+            break
+        if _time.monotonic() > deadline:
+            pytest.fail(f"survivors never shrank to world {{0,2}}: "
+                        f"last ping {pong!r}")
+        _time.sleep(0.1)
     rejoiner = _spawn(1, "0,1,2", bus, "", n, extra={
         "BYTEPS_ELASTIC_REJOIN": "1",
         "BYTEPS_ELASTIC_STEP_SLEEP": "0.3"})

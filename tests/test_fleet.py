@@ -1,0 +1,865 @@
+"""Fleet reconciler tests (launcher/reconciler.py, ISSUE 18): the
+autoscaler proposes, the reconciler DISPOSES.
+
+What is pinned here:
+
+- the directory's drain/victim semantics, busless and over the bus: a
+  ``draining`` registration stays visible in :meth:`info` (in-flight
+  pulls still need the address) but leaves every :meth:`hosts` routing
+  view with a generation bump; heartbeat re-assertion does NOT bump
+  again; victim proposals are filtered to live hosts and never bump the
+  gen (routing only changes when a victim actually flips to DRAINING);
+- the host core's drain latch: ``serve_ctl drain`` sets the latch,
+  counts ``serve.drain_requested``, acks with the in-flight depth, and
+  a retransmitted drain is idempotent;
+- the ``kill:site=serve_host_start`` chaos predicate: kill-only,
+  requires ``step=N``, counts serve-host STARTS (not answered pulls) —
+  the deterministic crash-looper the flap ban is tested with;
+- the reconciler's unit-testable core (fake processes, injected clock
+  and backoff — ``step()`` never sleeps): converge-to-target without
+  over-spawning cold starts, the max-host clamp, crash → full-jitter
+  backoff as a not-before stamp → restart, the flap ban (directory ban
+  + arc re-homed under a FRESH id, the banned id never reused),
+  scale-down draining probation/highest-id victims, bus-proposed
+  victims drained first and replaced, clean drain completion vs the
+  deadline escalation to kill + force-unregister (which must NOT count
+  as a crash);
+- ``TierAutoscaler(dispose="drain")``: scale-down PROPOSES victims over
+  the bus instead of retiring them (and the dispose value is
+  validated);
+- the observability surfaces: the bps_top fleet banner
+  (``target=N actual=M``, DRAINING rows) fed by the same
+  ``cluster_metrics()`` fields the ``--json`` consumer reads, the
+  ``/debug/state`` reconciler section, and bps_doctor's
+  reconciler-incident postmortem fold;
+- the acceptance storm: a REAL 8-host fleet under one reconciler —
+  pull storm, scale-up with real spawned ``serve_host`` processes,
+  chaos kill-storm healed by supervised restart, a crash-looping host
+  (``kill:site=serve_host_start``) banned without destabilizing the
+  ring, scale-down through the graceful drain — ZERO failed reads,
+  post-heal staleness bounded, finals exact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.telemetry import counters
+from byteps_tpu.fault import injector as inj
+from byteps_tpu.fault.membership import (SERVE_RANK_BASE, MembershipView,
+                                         _BusServer)
+from byteps_tpu.launcher.reconciler import FleetReconciler
+from byteps_tpu.server.kv_store import KVStore
+from byteps_tpu.server.serve_autoscaler import TierAutoscaler
+from byteps_tpu.server.serving_tier import (ServingHostCore, ServingTier,
+                                            TierDirectory, inproc_host)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    yield
+    inj.disarm()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _store(keys, numel=8):
+    s = KVStore()
+    for i, k in enumerate(keys):
+        s.init_key(k, np.full(numel, float(i), np.float32))
+    return s
+
+
+class _FakeProc:
+    """A supervisable stand-in for a serve_host process."""
+
+    def __init__(self):
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def exit(self, code):
+        self.rc = code
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = -15
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        del timeout
+        return self.rc
+
+
+class _FixedRetry:
+    """Deterministic backoff: attempt n -> n/2 seconds (no jitter, so
+    the not-before stamps are exact against the injected clock)."""
+
+    def backoff(self, attempt):
+        return 0.5 * attempt
+
+
+def _await(pred, deadline_s, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if pred():
+            return
+        time.sleep(0.1)
+    pytest.fail(f"timeout after {deadline_s}s waiting for {what}")
+
+
+# -- directory drain/victim semantics ----------------------------------------
+
+
+def test_fleet_directory_drain_mark_gen_and_victims_busless():
+    d = TierDirectory(static_hosts={0: ("h", 1), 1: ("h", 2),
+                                    2: ("h", 3)})
+    gen0, hosts = d.hosts()
+    assert sorted(hosts) == [0, 1, 2]
+    # the drain mark: visible in info (in-flight pulls still need the
+    # address), excluded from routing, gen bumped so consumers re-sync
+    d.register(("h", 2), host_id=1, draining=True)
+    gen1, hosts = d.hosts()
+    assert gen1 > gen0 and sorted(hosts) == [0, 2]
+    info = d.info()
+    assert info["draining"] == [1] and 1 in info["hosts"]
+    # heartbeat re-assertion must NOT bump again (a flapping gen would
+    # force every consumer into a pointless re-sync per beat)
+    d.register(("h", 2), host_id=1, draining=True)
+    gen2, _ = d.hosts()
+    assert gen2 == gen1
+    # victim proposals: filtered to live hosts, NO gen bump — routing
+    # only changes when a victim actually flips to DRAINING
+    d.propose_victims([2, 9])
+    assert d.info()["victims"] == [2]
+    gen3, _ = d.hosts()
+    assert gen3 == gen1
+    # the final unregister clears both marks
+    d.unregister(1)
+    d.unregister(2)
+    info = d.info()
+    assert info["draining"] == [] and info["victims"] == []
+    # un-drain via plain re-registration: back in the ring, gen bumped
+    d.register(("h", 4), host_id=3, draining=True)
+    d.register(("h", 4), host_id=3, draining=False)
+    _, hosts = d.hosts()
+    assert 3 in hosts and d.info()["draining"] == []
+
+
+def test_fleet_bus_directory_drain_victims_target_and_top_parity():
+    port = _free_port()
+    bus = _BusServer(("127.0.0.1", port), MembershipView(0, (0,)), 5.0,
+                     5.0)
+    try:
+        d = TierDirectory(bus=f"127.0.0.1:{port}", ttl_s=5.0)
+        d.register(("127.0.0.1", 7000), host_id=0)
+        d.register(("127.0.0.1", 7001), host_id=1)
+        gen0, hosts = d.hosts(force=True)
+        assert sorted(hosts) == [0, 1]
+        d.register(("127.0.0.1", 7001), host_id=1, draining=True)
+        gen1, hosts = d.hosts(force=True)
+        assert gen1 > gen0 and sorted(hosts) == [0]
+        d.set_target(4)
+        d.propose_victims([0])
+        # a SECOND consumer sees the same view through serve_dir
+        d2 = TierDirectory(bus=f"127.0.0.1:{port}")
+        d2.refresh(force=True)
+        info = d2.info()
+        assert info["draining"] == [1] and 1 in info["hosts"]
+        assert info["target"] == 4 and info["victims"] == [0]
+        # cluster_metrics carries the fleet fields — the SAME dict the
+        # bps_top banner renders from and `--once --json` prints, so
+        # the human and machine views cannot disagree
+        from byteps_tpu.core.api import cluster_metrics
+        cluster = cluster_metrics(bus=f"127.0.0.1:{port}")
+        assert cluster["serve_target"] == 4
+        assert cluster["serve_draining"] == [1]
+        from tools import bps_top
+        text = bps_top.render(cluster)
+        assert "fleet: target=4 actual=1" in text
+        assert "draining=[1]" in text
+        assert "DRAINING" in text
+        json.dumps(cluster, default=str)   # the --json path serializes
+        # the final unregister handshake clears mark + proposal
+        d.unregister(1)
+        d.unregister(0)
+        d2.refresh(force=True)
+        info = d2.info()
+        assert info["draining"] == [] and info["victims"] == []
+    finally:
+        bus.close()
+
+
+# -- the host core's drain latch ---------------------------------------------
+
+
+def test_fleet_host_core_drain_latch_idempotent_and_counted():
+    core = ServingHostCore(host_id=5)
+    c0 = counters.get("serve.drain_requested")
+    r = core.control({"cmd": "drain"})
+    assert r["draining"] is True and "inflight" in r
+    assert core.draining.is_set()
+    # a retransmitted drain finds the latch set — idempotent
+    r2 = core.control({"cmd": "drain"})
+    assert r2["draining"] is True
+    assert counters.get("serve.drain_requested") == c0 + 2
+    assert core.debug_state()["draining"] is True
+
+
+# -- the crash-looper predicate ----------------------------------------------
+
+
+@pytest.mark.chaos
+def test_fleet_kill_site_serve_host_start_validation_and_counter():
+    # kill-only predicate: a woven kind there would silently never fire
+    with pytest.raises(ValueError, match="kill-only"):
+        inj.parse_spec("delay:site=serve_host_start:ms=5")
+    with pytest.raises(ValueError, match="step"):
+        inj.parse_spec("kill:site=serve_host_start")
+    rules = inj.parse_spec("kill:step=1:site=serve_host_start")
+    assert rules[0].site == "serve_host_start"
+    # the START counter matches, not pulls or pushes
+    killed = []
+    inj.arm("kill:step=2:site=serve_host_start", rank=0)
+    orig = inj._exit
+    inj._exit = lambda code: killed.append(code)
+    try:
+        inj.on_serve()        # answered pulls do not consume start kills
+        inj.on_step()
+        inj.on_serve_start()  # 1st start: step=2 not reached
+        assert not killed
+        inj.on_serve_start()  # the 2nd start
+        assert killed
+    finally:
+        inj._exit = orig
+        inj.disarm()
+
+
+# -- the reconciler core (fake processes, injected clock) --------------------
+
+
+def _mk_rec(directory, spawn_fn, clock, **kw):
+    kw.setdefault("flap_limit", 3)
+    kw.setdefault("flap_window_s", 30.0)
+    kw.setdefault("drain_deadline_s", 5.0)
+    kw.setdefault("ban_s", 30.0)
+    kw.setdefault("max_hosts", 8)
+    return FleetReconciler(directory=directory, spawn_fn=spawn_fn,
+                           retry=_FixedRetry(), interval_s=0.05,
+                           now=lambda: clock[0], **kw)
+
+
+def test_fleet_reconciler_converges_without_overspawn_and_clamps():
+    d = TierDirectory()
+    procs = {}
+
+    def spawn(hid, env):
+        # the launch identity travels the child env, fault specs are
+        # opt-in per host (never inherited), overrides apply
+        assert env["BYTEPS_SERVE_HOST_ID"] == str(hid)
+        assert "BYTEPS_FAULT_SPEC" not in env
+        assert env["X_MARK"] == str(hid)
+        p = _FakeProc()
+        procs[hid] = p
+        return p
+
+    clock = [0.0]
+    rec = _mk_rec(d, spawn, clock, max_hosts=4,
+                  spawn_env=lambda hid: {"X_MARK": str(hid)})
+    try:
+        c0 = counters.get("reconcile.spawned")
+        d.set_target(3)
+        rec.step()
+        assert sorted(procs) == [0, 1, 2]
+        # none has registered yet (cold start): further passes must
+        # count the in-flight spawns, not spawn more
+        rec.step()
+        rec.step()
+        assert sorted(procs) == [0, 1, 2]
+        for h in list(procs):
+            d.register(("127.0.0.1", 1000 + h), host_id=h)   # HOST-UP
+        view = rec.step()
+        assert view["target"] == 3 and view["actual"] == 3
+        assert counters.get("reconcile.spawned") == c0 + 3
+        # the ceiling clamps a runaway target
+        d.set_target(99)
+        rec.step()
+        assert sorted(procs) == [0, 1, 2, 3]
+    finally:
+        rec.close()
+
+
+def test_fleet_reconciler_crash_backoff_restart_then_flap_ban():
+    d = TierDirectory()
+    procs = {}
+    spawn_log = []
+
+    def spawn(hid, env):
+        del env
+        p = _FakeProc()
+        procs[hid] = p
+        spawn_log.append(hid)
+        d.register(("127.0.0.1", 1000 + hid), host_id=hid)
+        return p
+
+    clock = [0.0]
+    rec = _mk_rec(d, spawn, clock)
+    try:
+        d.set_target(2)
+        rec.step()
+        assert sorted(procs) == [0, 1]
+        # crash 1: restart is a NOT-BEFORE stamp (attempt 1 -> 0.5s),
+        # never a sleep inside the loop
+        procs[1].exit(1)
+        rec.step()
+        assert counters.get("reconcile.crashed") == 1
+        assert rec.debug_state()["pending_restarts"] == {1: 0.5}
+        clock[0] = 0.2
+        rec.step()                      # before the not-before: no spawn
+        assert spawn_log == [0, 1]
+        clock[0] = 0.6
+        rec.step()
+        assert spawn_log == [0, 1, 1]   # restarted in place
+        assert counters.get("reconcile.restarted") == 1
+        # crash 2: backoff grows (attempt 2 -> 1.0s)
+        procs[1].exit(1)
+        rec.step()
+        assert rec.debug_state()["pending_restarts"][1] == pytest.approx(
+            clock[0] + 1.0)
+        clock[0] += 1.1
+        rec.step()
+        assert spawn_log == [0, 1, 1, 1]
+        # crash 3 inside the flap window: BANNED — directory ban, the
+        # id never reused, the arc re-homed under a FRESH id
+        procs[1].exit(1)
+        rec.step()
+        assert counters.get("reconcile.banned") == 1
+        assert rec.debug_state()["banned"] == [1]
+        assert 1 not in d.info()["hosts"]
+        view = rec.step()               # convergence spawns replacement
+        assert spawn_log == [0, 1, 1, 1, 2]
+        assert 1 not in view["supervised"] and 2 in view["supervised"]
+    finally:
+        rec.close()
+
+
+def test_fleet_reconciler_scale_down_drains_then_escalates():
+    d = TierDirectory()
+    procs = {}
+    cores = {}
+
+    def spawn(hid, env):
+        del env
+        p = _FakeProc()
+        procs[hid] = p
+        # an in-process core stands in for the host's ctl endpoint, so
+        # the drain RPC lands on a real drain latch
+        cores[hid] = inproc_host(ServingHostCore(host_id=hid))
+        d.register(("127.0.0.1", 1000 + hid), host_id=hid)
+        return p
+
+    clock = [0.0]
+    rec = _mk_rec(d, spawn, clock, drain_deadline_s=5.0)
+    try:
+        d.set_target(3)
+        rec.step()
+        assert sorted(procs) == [0, 1, 2]
+        # scale-down: the highest id (youngest arc) drains first
+        d.set_target(2)
+        view = rec.step()
+        assert view["draining"] == [2]
+        assert cores[2].draining.is_set()
+        assert counters.get("reconcile.drain_started") == 1
+        # clean completion: exit 0 + final unregister (what the real
+        # serve_host state machine does) completes the drain
+        procs[2].exit(0)
+        d.unregister(2)
+        rec.step()
+        assert counters.get("reconcile.drained") == 1
+        assert counters.get("reconcile.drain_escalated") == 0
+        # a WEDGED drain: the latch is set but the host never exits —
+        # the deadline escalates to kill + force-unregister
+        d.set_target(1)
+        rec.step()
+        assert cores[1].draining.is_set()
+        clock[0] += 5.1
+        rec.step()
+        assert counters.get("reconcile.drain_escalated") == 1
+        assert procs[1].terminated
+        assert 1 not in d.info()["hosts"]
+        # the escalated corpse reaps WITHOUT counting as a crash (no
+        # restart of a host we just killed on purpose)
+        rec.step()
+        assert counters.get("reconcile.crashed") == 0
+        assert rec.debug_state()["draining"] == []
+    finally:
+        rec.close()
+
+
+def test_fleet_reconciler_bus_proposed_victims_drain_first():
+    d = TierDirectory()
+    procs = {}
+    cores = {}
+
+    def spawn(hid, env):
+        del env
+        p = _FakeProc()
+        procs[hid] = p
+        cores[hid] = inproc_host(ServingHostCore(host_id=hid))
+        d.register(("127.0.0.1", 1000 + hid), host_id=hid)
+        return p
+
+    clock = [0.0]
+    rec = _mk_rec(d, spawn, clock)
+    try:
+        d.set_target(2)
+        rec.step()
+        assert sorted(procs) == [0, 1]
+        # the autoscaler names host 0 (NOT the default highest-id
+        # choice); the reconciler drains it and — target unchanged —
+        # spawns its replacement in the same pass
+        d.propose_victims([0])
+        view = rec.step()
+        assert 0 in view["draining"]
+        assert cores[0].draining.is_set()
+        assert not cores[1].draining.is_set()
+        assert 2 in view["supervised"]     # replacement under a fresh id
+    finally:
+        rec.close()
+
+
+def test_fleet_publisher_and_router_reship_restarted_host():
+    """A host restarted in place (the reconciler's crash-restart path)
+    re-registers under the SAME id at a NEW address with EMPTY state.
+    Publisher and router must both treat it as a new incarnation: the
+    publisher re-ships the full owned slice (its acked map described
+    the dead process), the router drops its delta base and cached
+    connection.  replicas=1 and a strict client so neither failover nor
+    stale-degradation can mask a miss."""
+    keys = [f"r{i}" for i in range(6)]
+    d = TierDirectory(static_hosts={0: ("127.0.0.1", 1),
+                                    1: ("127.0.0.1", 2)})
+    for i in range(2):
+        inproc_host(ServingHostCore(host_id=i))
+    store = _store(keys)
+    tier = ServingTier(store, directory=d, replicas=1,
+                       cut_interval_s=None)
+    try:
+        tier.cut()
+        client = tier.client(max_staleness_s=0.0, stale_on_error=False)
+        assert set(client.pull()) == set(keys)
+        # host 1 crashes and restarts EMPTY: same id, new address
+        new_core = inproc_host(ServingHostCore(host_id=1))
+        d.register(("127.0.0.1", 3), host_id=1)
+        store.push_delta(keys[0], np.ones(8, np.float32))
+        tier.cut()
+        # the full owned slice landed on the new incarnation, not just
+        # the one changed key
+        assert new_core.debug_state()["snapshot_id"] is not None
+        assert new_core.debug_state()["keys"] >= 1
+        # the router follows within one sync interval (0.25s): its next
+        # sync sees the gen bump, drops the stale endpoint + delta base
+        time.sleep(0.3)
+        vals = client.pull()
+        for k in keys:
+            np.testing.assert_array_equal(vals[k], store.pull(k))
+        client.close()
+    finally:
+        tier.close()
+
+
+# -- the autoscaler's dispose="drain" mode ------------------------------------
+
+
+def test_fleet_autoscaler_dispose_drain_proposes_instead_of_retiring():
+    with pytest.raises(ValueError, match="dispose"):
+        TierAutoscaler(object(), dispose="nuke")
+    keys = [f"a{i}" for i in range(6)]
+    d = TierDirectory(static_hosts={i: ("127.0.0.1", i + 1)
+                                    for i in range(3)})
+    for i in range(3):
+        inproc_host(ServingHostCore(host_id=i))
+    store = _store(keys)
+    tier = ServingTier(store, directory=d, replicas=2,
+                       cut_interval_s=None)
+    try:
+        tier.cut()
+        asc = TierAutoscaler(tier, min_hosts=1, max_hosts=4,
+                             cooldown_s=0.0, low_pulls_per_s=50.0,
+                             dispose="drain")
+        first = asc.step(force=True)   # warming: structural zero rates
+        assert first is not None and first.action == "hold"
+        decision = asc.step(force=True)
+        assert decision is not None and decision.action == "down"
+        assert decision.victims
+        # drain mode: victims PROPOSED over the bus for the reconciler,
+        # NOT retired — every host is still registered and placed
+        assert len(tier.ring.hosts()) == 3
+        info = tier.directory.info()
+        assert info["victims"] == decision.victims
+        assert sorted(info["hosts"]) == [0, 1, 2]
+        assert tier.directory.target() == decision.target
+    finally:
+        tier.close()
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+def test_fleet_obs_debug_state_reconciler_section():
+    rec = _mk_rec(TierDirectory(), lambda h, e: _FakeProc(), [0.0])
+    try:
+        from byteps_tpu.common import obs_server
+        doc = obs_server.debug_state()
+        sections = doc["reconciler"]
+        assert sections and sections[0]["kind"] == "reconciler"
+        assert "flap_limit" in sections[0]
+        assert sections[0]["supervised"] == []
+        json.dumps(doc, default=str)
+    finally:
+        rec.close()
+
+
+def test_fleet_doctor_postmortem_reconciler_incidents(tmp_path):
+    events = [
+        {"t": 1.0, "mono": 1.0, "kind": "reconcile.spawn", "host": 4},
+        {"t": 2.0, "mono": 2.0, "kind": "reconcile.crash", "host": 4,
+         "code": 1},
+        {"t": 2.1, "mono": 2.1, "kind": "reconcile.restart", "host": 4,
+         "flaps": 1},
+        {"t": 3.0, "mono": 3.0, "kind": "reconcile.banned", "host": 4,
+         "flap_limit": 3, "ban_s": 30.0},
+        {"t": 4.0, "mono": 4.0, "kind": "reconcile.drain", "host": 2,
+         "deadline_s": 5.0},
+        {"t": 9.5, "mono": 9.5, "kind": "reconcile.drain_escalated",
+         "host": 2},
+    ]
+    path = tmp_path / "bps_flight_1_rank0_100_exit_6.json"
+    path.write_text(json.dumps({"reason": "exit", "wall_time": 10.0,
+                                "pid": 100, "rank": 0, "capacity": 64,
+                                "events": events}))
+    from tools.bps_doctor import diagnose_postmortem, render_markdown
+    report = diagnose_postmortem(str(tmp_path))
+    rec = report["reconciler"]
+    assert [r["kind"] for r in rec] == [
+        "spawn", "crash", "restart", "banned", "drain",
+        "drain_escalated"]
+    assert rec[3]["host"] == 4 and rec[3]["detail"]["flap_limit"] == 3
+    md = render_markdown(report)
+    assert "Reconciler incidents" in md
+    assert "BANNED (crash loop): host(s) [4]" in md
+    assert "ESCALATED" in md
+    json.dumps(report)   # the --json path must serialize
+
+
+def test_fleet_bpslaunch_fleet_flag_requires_bus(monkeypatch, capsys):
+    monkeypatch.delenv("BYTEPS_SERVE_TIER_BUS", raising=False)
+    from byteps_tpu.launcher.launch import main as launch_main
+    assert launch_main(["--fleet"]) == 2
+    assert "no bus" in capsys.readouterr().err
+
+
+# -- the real drain protocol (one host, end to end) ---------------------------
+
+
+def _spawn_host_proc(i, bus_port, ttl=3.0, spec=""):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BYTEPS_SERVE_TIER_BUS=f"127.0.0.1:{bus_port}",
+               BYTEPS_SERVE_HOST_ID=str(i),
+               BYTEPS_SERVE_TIER_TTL=str(ttl),
+               BYTEPS_LOG_LEVEL="ERROR",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    if spec:
+        env["BYTEPS_FAULT_SPEC"] = spec
+    else:
+        env.pop("BYTEPS_FAULT_SPEC", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.server.serve_host"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+@pytest.mark.chaos
+def test_fleet_single_host_graceful_drain_protocol():
+    """The drain handshake against a REAL serve_host process:
+    ``serve_ctl drain`` acks with the in-flight depth, the DRAINING
+    mark lands on the bus (routing excludes the host while its address
+    stays visible), the final unregister clears it, the process prints
+    ``HOST-DRAINED`` and exits 0."""
+    bus_port = _free_port()
+    bus = _BusServer(("127.0.0.1", bus_port), MembershipView(0, (0,)),
+                     5.0, 5.0)
+    proc = None
+    try:
+        proc = _spawn_host_proc(0, bus_port, ttl=2.0)
+        line = proc.stdout.readline()
+        assert "HOST-UP" in line, line
+        d = TierDirectory(bus=f"127.0.0.1:{bus_port}")
+        _await(lambda: 0 in d.hosts(force=True)[1], 30,
+               "host 0 registered")
+        gen0, addrs = d.hosts(force=True)
+        from byteps_tpu.comm.transport import TcpEndpoint
+        ctl = TcpEndpoint(addrs[0], peer=SERVE_RANK_BASE + 0,
+                          send_deadline_s=2.0, keepalive_s=0.0)
+        reply = ctl.serve_ctl(cmd="drain")
+        ctl.close(drain=False)
+        assert reply.get("draining") is True and "inflight" in reply
+        # the DRAINING mark: routing excludes, info keeps the address
+        def _marked():
+            d.refresh(force=True)
+            info = d.info()
+            return (0 in info["draining"] and 0 in info["hosts"]) \
+                or 0 not in info["hosts"]   # already finished draining
+        _await(_marked, 15, "the DRAINING mark on the bus")
+        # in-flight (none) finish; final unregister + clean exit
+        assert proc.wait(timeout=30) == 0
+        rest = proc.stdout.read()
+        assert "HOST-DRAINED 0" in rest, rest
+        def _gone():
+            d.refresh(force=True)
+            info = d.info()
+            return 0 not in info["hosts"] and info["draining"] == []
+        _await(_gone, 15, "the final unregister handshake")
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            proc.wait(timeout=15)
+        bus.close()
+
+
+# -- THE acceptance storm (ISSUE 18) ------------------------------------------
+
+
+@pytest.mark.chaos
+def test_fleet_storm_8hosts_scaleup_killstorm_crashloop_ban_drain():
+    """THE acceptance pin (ISSUE 18): one reconciler supervises a REAL
+    fleet through a full chaos storm —
+
+    - a pull storm runs against the initial 4 hosts while the target is
+      raised to 8 (the ``serve_scale`` verb, the same channel the
+      autoscaler posts on): the reconciler spawns real ``serve_host``
+      processes to converge;
+    - two of the originals die mid-storm (``kill:site=serve_host`` at
+      their Nth answered pull): supervised restart heals them in place;
+    - the host id the scale-up allocates to slot 6 is a deliberate
+      crash-looper (``kill:step=1:site=serve_host_start`` armed through
+      ``spawn_env`` on EVERY spawn of that id — it dies after
+      registering, before HOST-UP): crash-loop backoff absorbs the
+      flaps, the flap ban evicts the id, and its arc re-homes under a
+      fresh id;
+    - the target drops back to 3: the spares retire through the
+      graceful drain, no deadline escalation;
+
+    and the tier keeps its promises: ZERO failed reads end to end,
+    post-heal staleness bounded, finals exact."""
+    nkeys = 6
+    keys = [f"f{i}" for i in range(nkeys)]
+    bound = 0.25
+    bus_port = _free_port()
+    bus = _BusServer(("127.0.0.1", bus_port), MembershipView(0, (0,)),
+                     5.0, 5.0)
+    CRASH = 6                    # the id slot the scale-up will allocate
+    KILL_AT = {1: "kill:step=40:site=serve_host",
+               3: "kill:step=70:site=serve_host"}
+    armed = set()
+
+    def host_env(hid):
+        env = {"JAX_PLATFORMS": "cpu", "BYTEPS_LOG_LEVEL": "ERROR"}
+        if hid == CRASH:
+            # EVERY spawn of this id dies at startup — the respawns die
+            # too, which is exactly what the flap ban must absorb
+            env["BYTEPS_FAULT_SPEC"] = "kill:step=1:site=serve_host_start"
+        elif hid in KILL_AT and hid not in armed:
+            # the kill-storm victims: armed only on their FIRST spawn,
+            # so the supervised restart comes back clean
+            armed.add(hid)
+            env["BYTEPS_FAULT_SPEC"] = KILL_AT[hid]
+        return env
+
+    directory = TierDirectory(bus=f"127.0.0.1:{bus_port}", ttl_s=3.0)
+    rec = FleetReconciler(directory=directory, interval_s=0.2,
+                          flap_limit=3, flap_window_s=60.0,
+                          drain_deadline_s=12.0, ban_s=60.0,
+                          max_hosts=10, spawn_env=host_env,
+                          conn_kw={"send_deadline_s": 1.0,
+                                   "keepalive_s": 1.0})
+    stop = threading.Event()
+    rec_thread = threading.Thread(target=rec.run, args=(stop,),
+                                  daemon=True)
+    tier = None
+    consumer = TierDirectory(bus=f"127.0.0.1:{bus_port}")
+
+    def _live():
+        return set(consumer.hosts(force=True)[1])
+
+    try:
+        directory.set_target(4)
+        rec_thread.start()
+        _await(lambda: len(_live()) >= 4, 90, "the initial 4-host fleet")
+
+        store = KVStore()
+        rng = np.random.RandomState(0)
+        for k in keys:
+            store.init_key(k, rng.randn(64).astype(np.float32))
+        # fail_streak high: the RECONCILER owns healing here — the
+        # publisher retiring+banning a killed id would fight the
+        # supervised restart of that same id
+        tier = ServingTier(store, bus=f"127.0.0.1:{bus_port}",
+                           replicas=2, cut_interval_s=None,
+                           ship_deadline_s=0.75, fail_streak=99,
+                           conn_kw={"send_deadline_s": 0.75,
+                                    "keepalive_s": 1.0})
+        tier.cut()
+
+        pub_lock = threading.Lock()
+        pub_times = {}          # version of keys[0] -> monotonic
+
+        def pusher():
+            while not stop.is_set():
+                store.push_delta(keys[0], np.ones(64, np.float32))
+                for k in keys[1:]:
+                    store.push_delta(k, np.ones(64, np.float32) * 1e-3)
+                snap = tier.cut()
+                if snap is not None:
+                    with pub_lock:
+                        pub_times[snap.versions[keys[0]]] = \
+                            time.monotonic()
+                time.sleep(0.12)
+
+        samples = []            # (t, seen version of keys[0])
+        errors = []
+
+        def puller(idx):
+            client = tier.client(max_staleness_s=bound,
+                                 pull_deadline_s=0.75)
+            try:
+                while not stop.is_set():
+                    try:
+                        client.pull()
+                    except Exception as e:  # noqa: BLE001 — THE assertion
+                        errors.append((idx, repr(e)))
+                        continue
+                    with pub_lock:
+                        samples.append((time.monotonic(),
+                                        client.version(keys[0])))
+                    time.sleep(0.01)
+            finally:
+                client.close()
+
+        push_t = threading.Thread(target=pusher, daemon=True)
+        pull_ts = [threading.Thread(target=puller, args=(i,),
+                                    daemon=True) for i in range(4)]
+        push_t.start()
+        for t in pull_ts:
+            t.start()
+
+        time.sleep(1.5)                     # healthy storm
+        # the storm drives the target up (serve_scale — the channel the
+        # autoscaler posts on); the kill-storm victims' pull counters
+        # are climbing toward their kill steps at the same time
+        directory.set_target(8)
+
+        # heal point: 8 live non-draining hosts, the crash-looper
+        # BANNED (arc re-homed under a fresh id), both kill victims
+        # dead AND restarted in place — crashed >= 5 (2 kills + the
+        # looper's 3 flaps) pins that the kills actually fired, and
+        # live >= 8 with the looper banned means both victims are back
+        def _healed():
+            return (len(_live()) >= 8
+                    and CRASH in rec.debug_state()["banned"]
+                    and counters.get("reconcile.crashed") >= 5)
+        _await(_healed, 90, "scale-up + kill-storm heal + flap ban")
+        assert CRASH not in _live()
+        assert counters.get("reconcile.banned") == 1
+        assert counters.get("reconcile.restarted") >= 2
+        t_heal = time.monotonic()
+        time.sleep(3.0)                     # post-heal steady state
+        t_down = time.monotonic()
+
+        # scale-down: the spares retire through the graceful drain
+        directory.set_target(3)
+
+        def _drained_down():
+            state = rec.debug_state()
+            return (len(_live()) == 3 and not state["draining"]
+                    and not state["pending_restarts"])
+        _await(_drained_down, 90, "graceful scale-down to 3")
+        assert counters.get("reconcile.drained") >= 5
+        assert counters.get("reconcile.drain_escalated") == 0
+        time.sleep(1.0)                     # steady at the new size
+        stop.set()
+        push_t.join(timeout=20)
+        for t in pull_ts:
+            t.join(timeout=20)
+
+        # 1) ZERO failed reads through spawn storm + kills + ban + drain
+        assert not errors, errors[:5]
+        # 2) bounded staleness after the heal: every steady-state sample
+        # between the heal and the scale-down saw at least the newest
+        # version published (bound + slack) before it — the drain churn
+        # itself is covered by the zero-failed-reads promise above
+        slack = 0.8
+        with pub_lock:
+            history = sorted(pub_times.items())
+        checked = 0
+        for t_s, seen in samples:
+            if t_s < t_heal or t_s > t_down:
+                continue
+            floor_v = 0
+            for v, t_pub in history:
+                if t_pub <= t_s - bound - slack:
+                    floor_v = max(floor_v, v)
+            assert seen >= floor_v, (t_s, seen, floor_v)
+            checked += 1
+        assert checked > 10, "no post-heal staleness samples"
+        # 3) finals exact: a fresh blocking pull equals the store.  The
+        # client is COLD (no cache to degrade to), so give the ring a
+        # short settle window after the drain churn before failing.
+        tier.cut()
+        fc = tier.client(max_staleness_s=0.0, pull_deadline_s=2.0)
+        settle = time.monotonic() + 15
+        while True:
+            try:
+                final = fc.pull()
+                break
+            except Exception:  # noqa: BLE001 — transient post-churn
+                if time.monotonic() > settle:
+                    raise
+                time.sleep(0.25)
+        fc.close()
+        for k in keys:
+            np.testing.assert_array_equal(final[k], store.pull(k))
+        # 4) the fleet view agrees end to end
+        state = rec.debug_state()
+        assert state["banned"] == [CRASH]
+        assert len(state["supervised"]) == len(_live())
+    finally:
+        stop.set()
+        if tier is not None:
+            tier.close()
+        rec.close(kill_hosts=True)
+        rec_thread.join(timeout=15)
+        bus.close()

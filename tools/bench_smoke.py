@@ -572,6 +572,43 @@ def _serve_dist_ok(sd: dict, floor: dict, tol: float) -> bool:
             and sd["pulls_per_s"] >= gate)
 
 
+def _measure_fleet():
+    """Fleet churn (ISSUE 18): pulls/s + p99 measured WHILE the fleet
+    reconciler spawns real serve-host processes up to the peak target
+    and gracefully drains back to base — the autoscaler-driven
+    membership churn the self-operating fleet promises to serve
+    through."""
+    from tools import serve_bench
+    out = serve_bench.measure_fleet(
+        seconds=4.0, clients=3, keys=6, numel=16384, replicas=2,
+        staleness=0.05, base_hosts=2, peak_hosts=4)
+    keep = ("base_hosts", "peak_hosts", "pulls_per_s", "p50_ms",
+            "p99_ms", "pushes_per_s", "failed_reads", "spawned",
+            "drain_started", "drained", "drain_escalated", "banned",
+            "final_hosts", "still_draining")
+    return {k: out[k] for k in keep}
+
+
+def _fleet_ok(fl: dict, floor: dict, tol: float) -> bool:
+    """The fleet gate (pure; pinned by a unit test): zero failed reads
+    through the churn is ABSOLUTE, the churn must actually have
+    happened (spawns up to the peak AND at least one graceful drain
+    back — a bench that never grew the fleet would gate nothing), the
+    drains must have completed clean (none escalated to kill, none
+    still draining), and pulls/s under churn must clear the floor with
+    the lane tolerance."""
+    gate = floor.get("fleet_pulls_per_s_floor", 0.0) * (1.0 - tol)
+    fl["gate_pulls_per_s"] = round(gate, 1)
+    churned = (fl.get("spawned", 0) >= fl.get("peak_hosts", 0)
+               and fl.get("drained", 0) >= 1)
+    drains_clean = (fl.get("drain_escalated", 0) == 0
+                    and not fl.get("still_draining"))
+    return (fl["failed_reads"] == 0
+            and churned
+            and drains_clean
+            and fl["pulls_per_s"] >= gate)
+
+
 def main() -> int:
     setup_cpu8_mesh()
     tol = float(os.environ.get("BENCH_SMOKE_TOLERANCE", "0.30"))
@@ -583,6 +620,7 @@ def main() -> int:
     out["ts_sampler"] = _measure_ts_sampler()
     out["transport"] = _measure_transport()
     out["serve_dist"] = _measure_serve_dist()
+    out["fleet"] = _measure_fleet()
     if "--update-floor" in sys.argv:
         # compressed throughput floor: half the measured worst lane —
         # room for host noise, still catches a machinery collapse
@@ -613,6 +651,11 @@ def main() -> int:
                  # tier-machinery collapse
                  "serve_dist_pulls_per_s_floor": round(
                      out["serve_dist"]["pulls_per_s"] / 10, 1),
+                 # fleet: same tenth-of-measured rule — the churn
+                 # figure spans reconciler passes, process spawns, and
+                 # graceful drains, so it is the noisiest lane of all
+                 "fleet_pulls_per_s_floor": round(
+                     out["fleet"]["pulls_per_s"] / 10, 1),
                  "note": "measured floor; the lane fails below "
                          "ratio * (1 - tolerance)"}
         with open(FLOOR_PATH, "w") as f:
@@ -650,8 +693,11 @@ def main() -> int:
     out["transport"]["ok"] = transport_ok
     serve_dist_ok = _serve_dist_ok(out["serve_dist"], floor, tol)
     out["serve_dist"]["ok"] = serve_dist_ok
+    fleet_ok = _fleet_ok(out["fleet"], floor, tol)
+    out["fleet"]["ok"] = fleet_ok
     out["ok"] = (engine_ok and straggler_ok and compressed_ok and trace_ok
-                 and ts_ok and transport_ok and serve_dist_ok)
+                 and ts_ok and transport_ok and serve_dist_ok
+                 and fleet_ok)
     print(json.dumps(out))
     if not engine_ok:
         print(f"bench-smoke FAIL: engine_vs_fused_ratio "
@@ -700,6 +746,17 @@ def main() -> int:
               f"pulls {sd['per_host']} (every host must serve), or "
               f"pulls_per_s {sd['pulls_per_s']} < gate "
               f"{sd['gate_pulls_per_s']} — the distributed tier "
+              f"machinery regressed", file=sys.stderr)
+    if not fleet_ok:
+        fl = out["fleet"]
+        print(f"bench-smoke FAIL: fleet lane violates the floor — "
+              f"failed_reads {fl['failed_reads']} (must be 0 through "
+              f"the churn), spawned {fl['spawned']} / drained "
+              f"{fl['drained']} (the churn must actually happen), "
+              f"drain_escalated {fl['drain_escalated']} / "
+              f"still_draining {fl['still_draining']} (drains must "
+              f"land clean), or pulls_per_s {fl['pulls_per_s']} < gate "
+              f"{fl['gate_pulls_per_s']} — the self-operating fleet "
               f"machinery regressed", file=sys.stderr)
     if not transport_ok:
         trp = out["transport"]

@@ -225,11 +225,22 @@ def diagnose_postmortem(dir_: str) -> dict:
     alerts: List[dict] = []
     faults: List[dict] = []
     parks: List[dict] = []
+    reconcile: List[dict] = []
     for doc in dumps:
         rank = doc.get("rank")
         for ev in doc.get("events") or ():
             kind = ev.get("kind", "")
-            if kind == "alert":
+            if kind.startswith("reconcile."):
+                # fleet-reconciler incidents (ISSUE 18): spawns,
+                # crash-loop restarts, bans, drains and their
+                # escalations — the supervisor's side of the story
+                reconcile.append({"t": ev.get("t"), "rank": rank,
+                                  "kind": kind[len("reconcile."):],
+                                  "host": ev.get("host"),
+                                  "detail": {k: v for k, v in ev.items()
+                                             if k not in ("t", "mono",
+                                                          "kind", "host")}})
+            elif kind == "alert":
                 alerts.append({"t": ev.get("t"), "rank": rank,
                                "rule": ev.get("rule"),
                                "state": ev.get("state"),
@@ -253,6 +264,7 @@ def diagnose_postmortem(dir_: str) -> dict:
     alerts.sort(key=lambda a: a.get("t") or 0.0)
     faults.sort(key=lambda f: f.get("t") or 0.0)
     parks.sort(key=lambda p: p.get("t") or 0.0)
+    reconcile.sort(key=lambda r: r.get("t") or 0.0)
     partition = _partition_incident(faults, parks)
     firing = [a for a in alerts if a.get("state") == "firing"]
     first = firing[0] if firing else None
@@ -330,6 +342,7 @@ def diagnose_postmortem(dir_: str) -> dict:
             "faults": faults,
             "partition": partition,
             "parks": parks,
+            "reconciler": reconcile,
             "timeseries": ts,
             "trace": trace,
             "culprit": culprit}
@@ -411,6 +424,23 @@ def render_markdown(report: dict) -> str:
                 lines.append("- t=%s rank %s: %s %s %s"
                              % (a.get("t"), a.get("rank"), a.get("rule"),
                                 a.get("state"), a.get("detail") or ""))
+        if report.get("reconciler"):
+            lines.append("\n## Reconciler incidents")
+            bans = [r for r in report["reconciler"]
+                    if r["kind"] == "banned"]
+            escalated = [r for r in report["reconciler"]
+                         if r["kind"] == "drain_escalated"]
+            if bans:
+                lines.append("- BANNED (crash loop): host(s) %s"
+                             % sorted({r.get("host") for r in bans}))
+            if escalated:
+                lines.append("- drain deadline ESCALATED to kill: "
+                             "host(s) %s"
+                             % sorted({r.get("host") for r in escalated}))
+            for r in report["reconciler"]:
+                lines.append("- t=%s host %s: %s %s"
+                             % (r.get("t"), r.get("host"), r.get("kind"),
+                                r.get("detail") or ""))
         if report["faults"]:
             lines.append("\n## Injected/recorded faults")
             for f in report["faults"]:

@@ -230,10 +230,14 @@ def render(cluster: dict) -> str:
         except Exception:  # noqa: BLE001 — render must not die on a
             # directory/ring mismatch mid-transition
             shares = {}
+        draining = {int(h) for h in cluster.get("serve_draining") or ()}
         for hid in sorted(serve_hosts):
             rows.append(_rank_row(
                 hid, serve_ranks.get(hid, {}), role="serve",
-                arc=shares.get(hid), label=f"s{hid}"))
+                arc=shares.get(hid), label=f"s{hid}",
+                # DRAINING rides the gossip-state slot: same STATE cell,
+                # same "anything but alive wins over ok" rule
+                gstate="DRAINING" if hid in draining else None))
     widths = [max(len(r[i]) for r in rows) for i in range(len(_COLUMNS))]
     head = "byteps_tpu cluster — epoch %s, world %s" % (
         cluster.get("epoch"), cluster.get("world"))
@@ -244,6 +248,17 @@ def render(cluster: dict) -> str:
     if serve_hosts:
         head += " — serve tier: %d host(s), gen %s" % (
             len(serve_hosts), cluster.get("serve_gen"))
+        # the fleet banner (ISSUE 18): target vs actual is THE
+        # reconciler-health signal — actual counts only non-draining
+        # hosts, so a lagging drain shows as actual > target
+        draining = {int(h) for h in cluster.get("serve_draining") or ()}
+        if cluster.get("serve_target") is not None or draining:
+            target = cluster.get("serve_target")
+            head += " — fleet: target=%s actual=%d" % (
+                "-" if target is None else target,
+                len(set(serve_hosts) - draining))
+            if draining:
+                head += " draining=%s" % sorted(draining)
     if probation:
         head += " — probation=%s" % sorted(probation)
     if cluster.get("gossip"):

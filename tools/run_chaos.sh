@@ -14,7 +14,19 @@
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
 #               | serve_dist | straggler | compressed | trace
-#               | transport | doctor | gossip | lint | all
+#               | transport | doctor | gossip | fleet | lint | all
+#         fleet: the fleet-reconciler slice (ISSUE 18,
+#              launcher/reconciler.py, docs/serving.md "The
+#              self-operating fleet") — the 8-host storm acceptance
+#              (pull storm scales the tier up with REAL spawned
+#              serve_host processes, kill-storm healed back to target,
+#              a deliberately crash-looping host
+#              (kill:site=serve_host_start) banned without
+#              destabilizing the ring, scale-down drains with zero
+#              failed reads), the graceful-drain protocol pins
+#              (DRAINING mark → gen bump → final unregister handshake
+#              → HOST-DRAINED), crash-loop backoff/ban unit pins, and
+#              the drain-deadline escalation (tests/test_fleet.py)
 #         gossip: the partition-tolerance slice (ISSUE 17,
 #              fault/gossip.py, docs/fault_tolerance.md) — the
 #              multi-process split-brain proof (partition:ranks=A|B
@@ -138,6 +150,9 @@ case "${1:-}" in
     doctor)    MARK="chaos"; KEXPR="doctor or timeseries or health"; shift ;;
     gossip)    MARK="chaos"
                KEXPR="gossip or partition or quorum"
+               shift ;;
+    fleet)     MARK="chaos or integrity"
+               KEXPR="fleet"
                shift ;;
     all)       MARK="chaos or integrity"; shift ;;
     lint)

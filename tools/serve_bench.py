@@ -369,6 +369,179 @@ def measure_distributed(*, hosts: int = 3, seconds: float = 3.0,
         bus.close()
 
 
+def measure_fleet(*, seconds: float = 4.0, clients: int = 3,
+                  keys: int = 6, numel: int = 16384, replicas: int = 2,
+                  staleness: float = 0.1, base_hosts: int = 2,
+                  peak_hosts: int = 4) -> dict:
+    """Pulls/s and p99 DURING fleet churn (ISSUE 18): the fleet
+    reconciler spawns the hosts (none are pre-spawned here), the bench
+    drives the autoscaler's actuation channel — ``serve_scale`` target
+    bumps on the bus — up to ``peak_hosts`` mid-storm and back down to
+    ``base_hosts``, so the measurement window contains real spawns AND
+    real graceful drains while the pull storm runs.  The gate: zero
+    failed reads through all of it, and throughput above the floor."""
+    import socket as _socket
+
+    import numpy as np
+
+    from byteps_tpu.fault.membership import MembershipView, _BusServer
+    from byteps_tpu.launcher.reconciler import FleetReconciler
+    from byteps_tpu.server.kv_store import KVStore
+    from byteps_tpu.server.serving_tier import ServingTier, TierDirectory
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    bus_port = s.getsockname()[1]
+    s.close()
+    bus = _BusServer(("127.0.0.1", bus_port), MembershipView(0, (0,)),
+                     5.0, 5.0)
+    tier = None
+    rec = None
+    try:
+        directory = TierDirectory(bus=f"127.0.0.1:{bus_port}", ttl_s=3.0)
+        rec = FleetReconciler(
+            directory=directory, interval_s=0.2, drain_deadline_s=8.0,
+            spawn_env={"JAX_PLATFORMS": "cpu",
+                       "BYTEPS_LOG_LEVEL": "ERROR"})
+        rec_stop = threading.Event()
+        rec_thread = threading.Thread(target=rec.run, args=(rec_stop,),
+                                      daemon=True, name="fleet-bench-rec")
+        directory.set_target(base_hosts)
+        rec_thread.start()
+        deadline = time.monotonic() + 90.0
+        while len(directory.hosts(force=True)[1]) < base_hosts:
+            if time.monotonic() > deadline:
+                raise RuntimeError("reconciler never converged to the "
+                                   "base fleet")
+            time.sleep(0.1)
+
+        store = KVStore()
+        names = [f"serve.fleet.{i}" for i in range(keys)]
+        rng = np.random.RandomState(0)
+        for n in names:
+            store.init_key(n, rng.randn(numel).astype(np.float32))
+        tier = ServingTier(store, bus=f"127.0.0.1:{bus_port}",
+                           replicas=replicas, cut_interval_s=None,
+                           ship_deadline_s=3.0)
+        tier.cut()
+
+        stop = threading.Event()
+        pushes = [0]
+
+        def pusher():
+            delta = np.ones(numel, np.float32) * 1e-3
+            i = 0
+            while not stop.is_set():
+                store.push_delta(names[i % keys], delta)
+                pushes[0] += 1
+                i += 1
+                if i % keys == 0:
+                    tier.cut()
+
+        lat_lock = threading.Lock()
+        latencies: list = []
+        pull_counts = [0] * clients
+        errors = [0]
+
+        def puller(idx: int):
+            client = tier.client(max_staleness_s=staleness)
+            mine = []
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    client.pull()
+                except Exception:  # noqa: BLE001 — zero failed reads
+                    # through the churn is THE fleet promise
+                    errors[0] += 1
+                    continue
+                mine.append((time.perf_counter() - t0) * 1e3)
+                pull_counts[idx] += 1
+            with lat_lock:
+                latencies.extend(mine)
+
+        churn_done = threading.Event()
+
+        def _await_fleet(n: int, deadline_s: float) -> bool:
+            end = time.monotonic() + deadline_s
+            while time.monotonic() < end and not stop.is_set():
+                if len(directory.hosts(force=True)[1]) == n \
+                        and not rec.debug_state()["draining"]:
+                    return True
+                time.sleep(0.1)
+            return False
+
+        def churner():
+            """The autoscaler's actuation channel, STATE-driven: bump
+            the target to the peak and wait for the spawned hosts to
+            actually register (a serve_host cold-starts in seconds —
+            a fixed schedule would end the storm before the fleet ever
+            grew), then drop back to base and wait for the drains to
+            complete.  Both transitions land inside the measurement
+            window because the window ends only after this does."""
+            if stop.wait(max(seconds / 4.0, 0.5)):
+                return
+            directory.set_target(peak_hosts)
+            _await_fleet(peak_hosts, 60.0)
+            directory.set_target(base_hosts)
+            _await_fleet(base_hosts, 60.0)
+            churn_done.set()
+
+        push_thread = threading.Thread(target=pusher, daemon=True)
+        churn_thread = threading.Thread(target=churner, daemon=True)
+        threads = [threading.Thread(target=puller, args=(i,), daemon=True)
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        push_thread.start()
+        churn_thread.start()
+        for t in threads:
+            t.start()
+        # the storm runs until the churn completes (spawns registered,
+        # drains landed), with `seconds` as the minimum and a hard cap
+        # as the wedge guard
+        churn_done.wait(timeout=150.0)
+        remaining = seconds - (time.perf_counter() - t0)
+        if remaining > 0:
+            time.sleep(remaining)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        push_thread.join(timeout=15)
+        churn_thread.join(timeout=15)
+        wall = time.perf_counter() - t0
+
+        import numpy as _np
+        from byteps_tpu.common.telemetry import counters
+        total = sum(pull_counts)
+        lat = _np.asarray(latencies) if latencies else _np.asarray([0.0])
+        state = rec.debug_state()
+        return {
+            "mode": "fleet",
+            "seconds": round(wall, 3),
+            "clients": clients,
+            "base_hosts": base_hosts,
+            "peak_hosts": peak_hosts,
+            "pulls": total,
+            "pulls_per_s": round(total / wall, 1),
+            "p50_ms": round(float(_np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(_np.percentile(lat, 99)), 3),
+            "pushes_per_s": round(pushes[0] / wall, 1),
+            "failed_reads": errors[0],
+            "spawned": counters.get("reconcile.spawned"),
+            "drain_started": counters.get("reconcile.drain_started"),
+            "drained": counters.get("reconcile.drained"),
+            "drain_escalated": counters.get("reconcile.drain_escalated"),
+            "banned": counters.get("reconcile.banned"),
+            "final_hosts": len(directory.hosts(force=True)[1]),
+            "still_draining": state["draining"],
+        }
+    finally:
+        if tier is not None:
+            tier.close()
+        if rec is not None:
+            rec.close(kill_hosts=True)
+        bus.close()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seconds", type=float, default=3.0)
@@ -380,7 +553,17 @@ def main(argv=None) -> int:
     p.add_argument("--hosts", type=int, default=0,
                    help="N > 0: distributed mode with N real "
                         "serving-host processes")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet mode: the reconciler spawns the hosts "
+                        "and the bench churns the target mid-storm")
     args = p.parse_args(argv)
+    if args.fleet:
+        out = measure_fleet(
+            seconds=args.seconds, clients=args.clients, keys=args.keys,
+            numel=args.numel, replicas=args.replicas,
+            staleness=args.staleness or 0.1)
+        print(json.dumps(out))
+        return 0 if out["failed_reads"] == 0 else 1
     if args.hosts > 0:
         out = measure_distributed(
             hosts=args.hosts, seconds=args.seconds, clients=args.clients,
