@@ -42,6 +42,7 @@ from byteps_tpu.core.api import (  # noqa: F401
     cluster_metrics,
     start_serving,
     start_serving_tier,
+    durable_kv_store,
 )
 from byteps_tpu.server import (  # noqa: F401
     KVStore,

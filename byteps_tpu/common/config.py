@@ -568,6 +568,39 @@ class Config:
     fault_seed: int = 0              # BYTEPS_FAULT_SEED: same spec + seed
     #                                  => identical injection schedule
 
+    # --- durable state plane (server/wal.py) ---
+    durable_dir: str = ""            # BYTEPS_DURABLE_DIR: root directory
+    #                                  for the crash-consistent state
+    #                                  plane (WAL segments + atomic
+    #                                  snapshot cuts).  Empty = durability
+    #                                  OFF (the in-memory-only behavior
+    #                                  every release before ISSUE 19
+    #                                  had); set = KVStore mutations are
+    #                                  journaled and serve hosts persist
+    #                                  their committed arc for
+    #                                  restart-in-place
+    wal_fsync: str = "always"        # BYTEPS_WAL_FSYNC: durability/
+    #                                  latency policy — "always" fsyncs
+    #                                  every append (crash loses nothing
+    #                                  acked), "interval" fsyncs at most
+    #                                  every wal_fsync_interval_s (crash
+    #                                  loses at most one interval),
+    #                                  "off" never fsyncs (OS page cache
+    #                                  decides; torn tails still detected
+    #                                  at replay, never trusted)
+    wal_fsync_interval_s: float = 0.05
+    #                                  BYTEPS_WAL_FSYNC_INTERVAL: max
+    #                                  seconds between fsyncs under the
+    #                                  "interval" policy
+    wal_segment_bytes: int = 4 << 20
+    #                                  BYTEPS_WAL_SEGMENT_BYTES: segment
+    #                                  roll size — replay truncation and
+    #                                  retention pruning operate on whole
+    #                                  segments
+    wal_retain_snapshots: int = 2    # BYTEPS_WAL_RETAIN: durable cuts
+    #                                  kept on disk; older cuts and the
+    #                                  WAL segments they cover are pruned
+
     # --- retry/backoff (common/retry.py) ---
     restart_limit: int = 0           # BYTEPS_RESTART_LIMIT: launcher
     #                                  restarts per worker (0 = none)
@@ -868,6 +901,19 @@ class Config:
         if self.health_skew_ratio <= 1:
             raise ValueError("health_skew_ratio must be > 1 — a ratio at "
                              "or below the median can never mean skew")
+        if self.wal_fsync not in ("always", "interval", "off"):
+            raise ValueError(
+                "wal_fsync must be one of always|interval|off — an "
+                "unknown policy would silently weaken the durability "
+                "guarantee the operator thinks they have")
+        if self.wal_fsync_interval_s <= 0:
+            raise ValueError("wal_fsync_interval_s must be positive")
+        if self.wal_segment_bytes < 4096:
+            raise ValueError("wal_segment_bytes must be >= 4096 — a "
+                             "sub-page segment rolls on every record")
+        if self.wal_retain_snapshots < 1:
+            raise ValueError("wal_retain_snapshots must be >= 1 (the "
+                             "latest durable cut must survive pruning)")
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -990,6 +1036,13 @@ class Config:
             lock_witness=_env_bool("BYTEPS_LOCK_WITNESS", False),
             fault_spec=_env_str("BYTEPS_FAULT_SPEC", ""),
             fault_seed=_env_int("BYTEPS_FAULT_SEED", 0),
+            durable_dir=_env_str("BYTEPS_DURABLE_DIR", ""),
+            wal_fsync=_env_str("BYTEPS_WAL_FSYNC",
+                               "always").strip().lower(),
+            wal_fsync_interval_s=_env_float("BYTEPS_WAL_FSYNC_INTERVAL",
+                                            0.05),
+            wal_segment_bytes=_env_int("BYTEPS_WAL_SEGMENT_BYTES", 4 << 20),
+            wal_retain_snapshots=_env_int("BYTEPS_WAL_RETAIN", 2),
             restart_limit=_env_int("BYTEPS_RESTART_LIMIT", 0),
             retry_max_attempts=_env_int("BYTEPS_RETRY_MAX_ATTEMPTS", 3),
             retry_base_delay_s=_env_float("BYTEPS_RETRY_BASE_DELAY", 0.1),

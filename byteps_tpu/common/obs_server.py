@@ -146,6 +146,10 @@ def debug_state() -> dict:
         # hosts, pending crash-loop restarts, draining set, ban list
         "reconciler": [c.debug_state()
                        for c in _metrics.components("reconciler")],
+        # the durable state plane (server/wal.py): journal position,
+        # fsync policy, live segment count, cold-start replay lag
+        "wal": [c.debug_state()
+                for c in _metrics.components("wal")],
         # the TCP transport (comm/transport.py): per-connection state
         # machine snapshots (CONNECTING/READY/DRAINING/DEAD, in-flight
         # bytes, reconnect counts) + per-server attachment/peer views

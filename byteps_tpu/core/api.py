@@ -120,6 +120,16 @@ def init(config: Optional[Config] = None,
         from ..common import timeseries as timeseries_mod
         health_mod.configure(cfg)
         timeseries_mod.ensure_started(cfg)
+        # Durable state plane (server/wal.py, ISSUE 19): with
+        # BYTEPS_DURABLE_DIR set, open the process-lifetime durable
+        # trainer-side KV store — on a cold start this replays the
+        # journal and restores the last snapshot cut BEFORE any push
+        # lands, so a full-world crash resumes from disk instead of
+        # from zero.  Process-lifetime like the obs server: an elastic
+        # suspend/resume must not close and re-replay the journal.
+        if cfg.durable_dir:
+            from ..server import wal as wal_mod
+            wal_mod.ensure_process_store(cfg)
         _engine = engine
         for name in _declared_order:
             _engine.registry.declare(name)
@@ -128,6 +138,16 @@ def init(config: Optional[Config] = None,
 
 def initialized() -> bool:
     return _engine is not None
+
+
+def durable_kv_store():
+    """The process-lifetime durable trainer-side KVStore opened by
+    :func:`init` when ``BYTEPS_DURABLE_DIR`` is set (server/wal.py) —
+    journaled mutations, atomic snapshot cuts, cold-start recovery.
+    None when the durable plane is off."""
+    import sys
+    wal_mod = sys.modules.get("byteps_tpu.server.wal")
+    return None if wal_mod is None else wal_mod.process_store()
 
 
 def shutdown(wait: bool = True) -> None:

@@ -163,14 +163,20 @@ VALID_KINDS = ("bitflip", "conn_reset", "delay", "drop", "kill",
 VALID_SITES = (
     # bpslint: ignore[chaos-site] reason=kill-only predicate matched in on_step (die while hosting the control plane), never a woven fire() site
     "coordinator",
-    "dcn", "dispatch", "gossip", "heartbeat", "kv_push",
+    "dcn",
+    # durable-plane disk faults (server/wal.py): disk_full fails an
+    # append with ENOSPC; fsync drops the sync the policy promised;
+    # wal_write tears the on-disk record short (drop) or flips a bit in
+    # it (bitflip) — the torn-tail/corrupt-segment recovery pins
+    "disk_full", "dispatch", "fsync", "gossip", "heartbeat", "kv_push",
     "serve_host",
     # bpslint: ignore[chaos-site] reason=kill-only predicate matched in on_serve_start (die at serve-host startup, before HOST-UP), never a woven fire() site
     "serve_host_start",
-    "serve_pull", "server_pull", "server_push", "sync", "transport")
+    "serve_pull", "server_pull", "server_push", "sync", "transport",
+    "wal_write")
 # sites where corrupt() is actually woven; a bitflip elsewhere would
 # silently never fire, so validation rejects it
-CORRUPT_SITES = ("kv_push", "serve_pull", "server_push")
+CORRUPT_SITES = ("kv_push", "serve_pull", "server_push", "wal_write")
 # socket-level kinds (comm/transport.py chaos shim): they act on raw
 # socket operations via socket_fault(), not on fire()/corrupt() hooks,
 # so they are only meaningful at the socket site(s) below — validation

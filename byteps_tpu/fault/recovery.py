@@ -59,6 +59,14 @@ class RecoveryResult:
     step: Optional[int]         # restored checkpoint step (None: no ckpt)
     state: Any                  # restored pytree (template when no ckpt)
     elapsed_s: float
+    durable: Optional[dict] = None
+    #                             durable-plane recovery stats
+    #                             (server/wal.py) when BYTEPS_DURABLE_DIR
+    #                             is set: snapshot lsn, records replayed,
+    #                             torn tails truncated — None when the
+    #                             durable plane is off or its restore
+    #                             failed (the in-memory recovery stands
+    #                             either way)
 
 
 class RecoveryCoordinator:
@@ -168,9 +176,28 @@ class RecoveryCoordinator:
                 self.checkpoint_manager.reload()
             step, state = self.checkpoint_manager.restore_latest(
                 self.template)
+        # durable state plane (server/wal.py): when no survivor holds
+        # the KV state in memory, the journal + snapshot cuts on local
+        # disk DO — rebuild the trainer-side store from them.  Failure
+        # is non-fatal: the in-memory recovery above already stands,
+        # and the store simply starts empty (the pre-ISSUE-19 world).
+        dur_stats = None
+        from ..common.config import get_config
+        if get_config().durable_dir:
+            from ..server import wal as _wal
+            try:
+                _store, dur = _wal.recover_process_store()
+                dur_stats = dict(dur.recover_stats)
+                counters.inc("recovery.durable_restore")
+            except Exception:  # noqa: BLE001 — degraded, not dead
+                counters.inc("recovery.durable_restore_failed")
+                get_logger().error(
+                    "recovery: durable KV restore failed — continuing "
+                    "with an empty store", exc_info=True)
         elapsed = time.monotonic() - t0
         result = RecoveryResult(failed_ranks=set(stale), num_workers=k,
-                                step=step, state=state, elapsed_s=elapsed)
+                                step=step, state=state, elapsed_s=elapsed,
+                                durable=dur_stats)
         self._record_span(result, t0)
         counters.inc("recovery.completed")
         get_logger().warning(

@@ -134,6 +134,17 @@ class FleetReconciler:
         # bpslint: ignore[env-knob] reason=WRITTEN into the child's environment (per-process launch identity, like DMLC_WORKER_ID), never read through Config here; documented in env.md
         env["BYTEPS_SERVE_HOST_ID"] = str(hid)
         env["BYTEPS_SERVE_TIER_TTL"] = str(self.directory.ttl_s)
+        # durable restart-in-place (server/wal.py): a restarted host id
+        # gets the SAME per-host durable dir its predecessor persisted
+        # to, so it restores its arc from local disk instead of pulling
+        # the full arc back over DCN (the reconciler's restart path
+        # prefers local recovery over a full re-sync)
+        from ..common.config import get_config
+        cfg = get_config()
+        if cfg.durable_dir:
+            # bpslint: ignore[env-knob] reason=WRITTEN into the child's environment (stable per-host-id subdir of the config-backed BYTEPS_DURABLE_DIR knob), read through Config in the child; documented in env.md
+            env["BYTEPS_DURABLE_DIR"] = os.path.join(
+                cfg.durable_dir, f"host-{hid}")
         env.pop("BYTEPS_FAULT_SPEC", None)   # chaos is opt-IN per host
         over = self._spawn_env
         if callable(over):

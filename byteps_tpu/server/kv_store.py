@@ -101,6 +101,13 @@ class KVStore:
         # version-vector comparison across the reset would skip every
         # re-initialized key and serve pre-clear values as fresh
         self._generation = 0
+        # durable state plane (server/wal.py): when bound, every
+        # mutation is journaled BEFORE it applies (classic WAL intent
+        # ordering — a failed append leaves memory untouched and the
+        # dedup floor unadvanced, so disk and memory can never disagree
+        # about a landed delta); None = the in-memory-only default
+        self._durable = None
+        self._wal = None
         # force the one-time native build/load here, NOT under self._lock in
         # push_delta (the first load may g++-compile core.cc for seconds)
         _native_load()
@@ -202,6 +209,91 @@ class KVStore:
                     "kv store: write subscriber raised for %r", key,
                     exc_info=True)
 
+    # -- durable state plane (server/wal.py) --------------------------------
+
+    def bind_wal(self, durable) -> None:
+        """Arm journaling (called by ``wal.attach`` AFTER recovery — a
+        replay must not re-journal itself)."""
+        with self._lock:
+            self._durable = durable
+            self._wal = durable.wal
+
+    def durable_state(self) -> dict:
+        """The full restorable state as one consistent cut, taken under
+        ONE lock hold: arrays, versions, generation, membership epoch,
+        and the dedup floors (restored floors mean a worker's duplicate
+        retry arriving AFTER a cold restart is still absorbed).  The
+        WAL position is captured under the same hold — appends run
+        under this lock, so the state and the LSN cannot shear."""
+        with self._lock:
+            state = {
+                "arrays": {k: np.array(a, copy=True)
+                           for k, a in self._store.items()},
+                "versions": dict(self._versions),
+                "generation": self._generation,
+                "epoch": self._membership_epoch,
+                "seen": dict(self._seen),
+            }
+            if self._wal is not None:
+                state["wal_lsn"] = self._wal.lsn
+            return state
+
+    def restore_durable_state(self, state: dict) -> None:
+        """Adopt a snapshot cut wholesale (cold-start restore; no
+        subscriber notifications — serving planes attach afterwards and
+        cut from the restored state)."""
+        with self._lock:
+            self._store = {k: np.array(a, copy=True)
+                           for k, a in state["arrays"].items()}
+            self._versions = dict(state["versions"])
+            self._seen = dict(state.get("seen") or {})
+            self._generation = int(state.get("generation", 0))
+            self._membership_epoch = int(
+                state.get("epoch", self._membership_epoch))
+            self._cow.clear()
+
+    def apply_wal_record(self, kind: str, data) -> None:
+        """Replay ONE journaled mutation (``wal.DurableKV._recover``).
+        Deltas re-merge through the normal landing path — the stale
+        gate already passed at journal time, so they apply
+        unconditionally; the ``(worker_id, seq)`` token rebuilds the
+        dedup floor exactly."""
+        if kind == "delta":
+            key, delta, worker_id, seq = data
+            with self._lock:
+                if key not in self._store:
+                    counters.inc("wal.replay_skipped")
+                    get_logger().error(
+                        "wal replay: delta for unknown key %r skipped "
+                        "(journal hole ahead of a lost init record)",
+                        key)
+                    return
+                self._push_delta_locked(key, np.asarray(delta))
+                self._mark_seen(key, worker_id, seq)
+        elif kind == "init":
+            key, value = data
+            with self._lock:
+                if key not in self._store:
+                    self._store[key] = np.array(value, copy=True)
+                    self._versions[key] = 0
+        elif kind == "epoch":
+            with self._lock:
+                if data > self._membership_epoch:
+                    self._membership_epoch = int(data)
+                    self._seen.clear()
+        elif kind == "clear":
+            with self._lock:
+                self._store.clear()
+                self._versions.clear()
+                self._codecs.clear()
+                self._seen.clear()
+                self._cow.clear()
+                self._generation = int(data)
+        else:
+            counters.inc("wal.replay_skipped")
+            get_logger().error("wal replay: unknown record kind %r "
+                               "skipped", kind)
+
     def set_membership_epoch(self, epoch: int) -> None:
         """Adopt a new membership epoch (monotonic); see ServerEngine.
 
@@ -215,6 +307,8 @@ class KVStore:
         dropped as stale in :meth:`_stale`."""
         with self._lock:
             if epoch > self._membership_epoch:
+                if self._wal is not None:
+                    self._wal.append("epoch", int(epoch))
                 self._membership_epoch = epoch
                 self._seen.clear()
 
@@ -262,7 +356,10 @@ class KVStore:
         created = False
         with self._lock:
             if key not in self._store:
-                self._store[key] = np.array(value, copy=True)
+                arr = np.array(value, copy=True)
+                if self._wal is not None:
+                    self._wal.append("init", (key, arr))
+                self._store[key] = arr
                 self._versions[key] = 0
                 created = True
         if created:
@@ -344,6 +441,14 @@ class KVStore:
         version when the merge changed the key (the caller notifies
         subscribers OUTSIDE the lock), None on a merged-screen skip
         (wire bytes wasted)."""
+        if self._wal is not None:
+            # journal the INTENT before the merge: if the append fails
+            # (disk full, torn write) the push fails with the store
+            # untouched and the floor unadvanced — the caller's retry
+            # is legitimate, not a duplicate.  A crash after the append
+            # but before the merge is equally safe: replay re-merges it.
+            self._wal.append("delta",
+                             (key, np.asarray(delta), worker_id, seq))
         before = self._versions.get(key, -1)
         version = self._push_delta_locked(key, delta)
         self._mark_seen(key, worker_id, seq)
@@ -673,6 +778,8 @@ class KVStore:
         a cross-clear version comparison would silently serve pre-clear
         values as fresh."""
         with self._lock:
+            if self._wal is not None:
+                self._wal.append("clear", self._generation + 1)
             self._store.clear()
             self._versions.clear()
             self._codecs.clear()

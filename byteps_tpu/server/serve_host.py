@@ -21,6 +21,11 @@ Environment:
   (default ``127.0.0.1:0`` = ephemeral)
 - ``BYTEPS_FAULT_SPEC``        — chaos schedule, validated at start
   (``kill:site=serve_host:step=N`` dies at the Nth answered pull)
+- ``BYTEPS_DURABLE_DIR``       — durable state plane root (server/wal.py);
+  when set, the committed arc persists to
+  ``<dir>/serve-<host_id>/arc.bin`` and a restart restores it from
+  local disk BEFORE registering (``HOST-RESTORED <host_id> <commit>``)
+  so the publisher re-ships nothing on the happy path
 
 Prints ``HOST-UP <host_id> <host> <port>`` once serving, then runs until
 SIGTERM/SIGINT (clean: unregister, close), a graceful drain
@@ -66,6 +71,13 @@ def main(argv=None) -> int:
                 rank=want_id if want_id is not None else 0)
 
     core = ServingHostCore(host_id=want_id if want_id is not None else 0)
+    if core.restored_commit:
+        # durable restart-in-place (server/wal.py): the committed arc
+        # came back from local disk BEFORE registration, so the
+        # publisher's next cut carries every unchanged key forward
+        # instead of re-shipping the full arc over DCN
+        print(f"HOST-RESTORED {core.host_id} {core.restored_commit}",
+              flush=True)
     srv = tp.TransportServer(host=bind_host, port=int(bind_port),
                              rank=SERVE_RANK_BASE + core.host_id,
                              serving=core, tier=core)

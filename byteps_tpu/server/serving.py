@@ -208,6 +208,21 @@ class SnapshotStore:
         counters.inc("serve.snapshot_cuts")
         gauges.set("serve.snapshot_id", snap.id)
         gauges.set("serve.snapshots_retained", len(self.ring))
+        # durable state plane (server/wal.py): a store with durability
+        # attached persists this cut atomically and truncates the
+        # journal it covers — every published snapshot is also the
+        # bound on cold-start replay cost.  AFTER publish, outside the
+        # cut lock's critical copy path: a failed disk must not fail
+        # the in-memory publication readers are waiting on.
+        dur = getattr(self.store, "_durable", None)
+        if dur is not None:
+            try:
+                dur.checkpoint()
+            except OSError:
+                get_logger().error(
+                    "serving: durable checkpoint failed after cut %d — "
+                    "the journal keeps the history until a later cut "
+                    "lands", snap.id, exc_info=True)
         return snap
 
     def _on_write(self, key: str, version: int) -> None:

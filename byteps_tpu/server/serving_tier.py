@@ -51,6 +51,8 @@ the ring follows MEMBERSHIP, not static config.
 from __future__ import annotations
 
 import itertools
+import os
+import pickle
 import threading
 import time
 import weakref
@@ -58,6 +60,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import integrity as _integrity
 from ..common.lock_witness import named_lock
 from ..common.logging import get_logger
 from ..common.telemetry import counters, gauges
@@ -170,7 +173,8 @@ class ServingHostCore:
 
     def __init__(self, host_id: int = 0, *,
                  retention: Optional[int] = None,
-                 admission: Optional[AdmissionControl] = None):
+                 admission: Optional[AdmissionControl] = None,
+                 durable_dir: Optional[str] = None):
         from ..common.config import get_config
         cfg = get_config()
         self.host_id = int(host_id)
@@ -193,8 +197,107 @@ class ServingHostCore:
         # (serve_host.py main loop) watches it — marks the directory,
         # lets in-flight pulls finish, unregisters, exits clean
         self.draining = threading.Event()
+        # durable arc (server/wal.py, ISSUE 19): when a durable dir is
+        # configured, every committed snapshot is persisted atomically
+        # and restored at construction — a restarted host rejoins with
+        # its arc already published, so the publisher's next cut finds
+        # every unchanged key carried forward and ships NOTHING
+        # (restart-in-place without the full-arc DCN re-ship)
+        dd = cfg.durable_dir if durable_dir is None else durable_dir
+        self._arc_path = (os.path.join(dd, f"serve-{self.host_id}",
+                                       "arc.bin") if dd else None)
+        self.restored_commit = 0
+        if self._arc_path is not None:
+            self._restore_arc()
         from ..common import metrics as _metrics
         _metrics.register_component("serving_tier", self)
+
+    # -- durable arc persistence (server/wal.py) ----------------------------
+
+    def _persist_arc(self, snap: Snapshot) -> None:
+        """Persist the committed snapshot atomically (sealed blob,
+        write-to-temp + fsync + rename).  Best-effort AFTER the
+        in-memory publish: a failing disk degrades restart-in-place to
+        a full re-ship, never a failed commit."""
+        from . import wal as _wal
+        state = {"id": snap.id, "gen": snap.gen, "host_id": self.host_id,
+                 "versions": dict(snap.versions),
+                 "arrays": {k: np.array(a, copy=True)
+                            for k, a in snap.refs.items()},
+                 "codecs": {k: (dict(kw), numel, np.dtype(dt).str)
+                            for k, (kw, _dec, numel, dt)
+                            in snap.codecs.items()},
+                 "enc": dict(snap.enc_cache)}
+        blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _integrity.seal_bytes(blob, key="serve-arc", seq=snap.id)
+        try:
+            os.makedirs(os.path.dirname(self._arc_path), exist_ok=True)
+            _wal._atomic_write(self._arc_path, frame)
+        except OSError:
+            counters.inc("wal.arc_save_failures")
+            get_logger().error(
+                "serve host %d: durable arc persist failed for commit "
+                "%d — a restart re-ships the arc", self.host_id,
+                snap.id, exc_info=True)
+            return
+        counters.inc("wal.arc_saves")
+
+    def _restore_arc(self) -> None:
+        """Cold-start restore of the last committed snapshot — runs in
+        ``__init__`` so the host's ring is populated BEFORE it
+        registers with the directory.  A blob that fails verification
+        is quarantined (removed, counted), never published."""
+        path = self._arc_path
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as fh:
+                frame = fh.read()
+            blob, _meta = _integrity.open_bytes(frame)
+            state = pickle.loads(blob)
+            refs: Dict[str, np.ndarray] = {}
+            for k, a in state["arrays"].items():
+                arr = np.array(a, copy=True)
+                arr.flags.writeable = False
+                refs[k] = arr
+            codecs = {}
+            for k, (kw, numel, dtype_s) in state["codecs"].items():
+                codecs[k] = (dict(kw),
+                             self._decoder(k, (dict(kw), numel, dtype_s)),
+                             numel, np.dtype(dtype_s))
+            snap = Snapshot(id=int(state["id"]), ts=time.monotonic(),
+                            versions=dict(state["versions"]), refs=refs,
+                            gen=int(state["gen"]), codecs=codecs,
+                            enc_cache=dict(state.get("enc") or {}))
+        except Exception as e:  # noqa: BLE001 — any failure here is a
+            # corrupt or torn blob; restart-in-place degrades to the
+            # full re-ship, never a half-restored arc
+            counters.inc("wal.arc_corrupt")
+            get_logger().error(
+                "serve host %d: durable arc at %s failed verification "
+                "(%s) — removed; the publisher re-ships the arc",
+                self.host_id, path, e)
+            from ..common import flight_recorder as _flight
+            _flight.record("wal.arc_corrupt", host=self.host_id,
+                           reason=str(e))
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return
+        with self._stage_lock:
+            self.ring.publish(snap)
+            self._last_commit = snap.id
+            self.restored_commit = snap.id
+        counters.inc("wal.arc_restores")
+        gauges.set("serve.snapshot_id", snap.id)
+        from ..common import flight_recorder as _flight
+        _flight.record("wal.arc_restored", host=self.host_id,
+                       snapshot_id=snap.id, keys=len(refs))
+        get_logger().warning(
+            "serve host %d: restored committed arc from disk — "
+            "snapshot %d, %d key(s) (restart-in-place, no re-ship)",
+            self.host_id, snap.id, len(refs))
 
     # -- the publication path (transport hops land here) --------------------
 
@@ -289,6 +392,8 @@ class ServingHostCore:
                             refs=refs, gen=gen, codecs=codecs,
                             enc_cache=enc)
             self.ring.publish(snap)
+        if self._arc_path is not None:
+            self._persist_arc(snap)
         if missing:
             counters.inc("serve.tier_missing_keys", missing)
             get_logger().warning(
@@ -329,6 +434,19 @@ class ServingHostCore:
             counters.inc("serve.drain_requested")
             return {"draining": True,
                     "inflight": self.admission.inflight}
+        if cmd == "arc_info":
+            # durable restart-in-place (server/wal.py): the publisher
+            # probes a fresh incarnation for what it already publishes
+            # — a host restored from its on-disk arc answers with its
+            # committed versions, and the publisher ships only the
+            # drift instead of the full owned slice
+            with self._stage_lock:
+                snap = self.ring.latest()
+                if snap is None:
+                    return {"snapshot_id": 0, "gen": 0, "versions": {}}
+                return {"snapshot_id": snap.id, "gen": snap.gen,
+                        "versions": dict(snap.versions),
+                        "restored": self.restored_commit}
         raise ValueError(f"unknown serve_ctl command {cmd!r}")
 
     # -- the read path -------------------------------------------------------
@@ -399,6 +517,8 @@ class ServingHostCore:
                 "host_id": self.host_id,
                 "snapshot_id": snap.id if snap is not None else None,
                 "keys": len(snap.versions) if snap is not None else 0,
+                "durable": self._arc_path is not None,
+                "restored_commit": self.restored_commit,
                 "staged": staged,
                 "pulls": self.pulls,
                 "sheds": self.sheds,
@@ -904,10 +1024,26 @@ class ServingTier:
                  if host in self._replica_hosts(k)]
         with self._lock:
             acked = dict(self._shipped.get(host, {}))
-        changed = [k for k in owned if acked.get(k) != snap.versions[k]]
         shipped_bytes = 0
         try:
             ep = self._endpoint(host)
+            if not acked:
+                # no ship history for this incarnation — before blindly
+                # re-shipping the full owned slice, ask the host what it
+                # already publishes: one restored from its durable arc
+                # (server/wal.py restart-in-place) answers with its
+                # committed versions, and only the drift ships over DCN.
+                # A probe failure just means the conservative full ship.
+                try:
+                    info = ep.serve_ctl(cmd="arc_info")
+                    if int(info.get("gen", -1)) == snap.gen:
+                        acked = {k: int(v) for k, v in
+                                 (info.get("versions") or {}).items()}
+                        if acked:
+                            counters.inc("wal.arc_probe_hits")
+                except Exception:  # noqa: BLE001 — probe is best-effort
+                    pass
+            changed = [k for k in owned if acked.get(k) != snap.versions[k]]
             for k in changed:
                 info = snap.codecs.get(k)
                 if info is not None:

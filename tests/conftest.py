@@ -41,6 +41,17 @@ if "BYTEPS_TRACE_DIR" not in os.environ:
     os.environ["BYTEPS_TRACE_DIR"] = tempfile.mkdtemp(
         prefix="bps_trace_test_")
 
+# Durable state plane (server/wal.py): durability is strictly opt-in
+# (durable_dir defaults to ""), so tests run WAL-free unless they arm it
+# themselves.  But if the operator exported BYTEPS_DURABLE_DIR into the
+# test session, re-point it at a temp dir — a test run must never replay
+# or truncate a real deployment's journal.
+if os.environ.get("BYTEPS_DURABLE_DIR"):
+    import tempfile
+
+    os.environ["BYTEPS_DURABLE_DIR"] = tempfile.mkdtemp(
+        prefix="bps_durable_test_")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -118,6 +129,12 @@ def _fresh_telemetry():
     _tier = _sys.modules.get("byteps_tpu.server.serving_tier")
     if _tier is not None:
         _tier._reset_for_tests()
+    # the process-lifetime durable trainer store (server/wal.py) holds an
+    # open journal file handle; close it so the next test's temp dir
+    # starts cold
+    _wal = _sys.modules.get("byteps_tpu.server.wal")
+    if _wal is not None:
+        _wal._reset_for_tests()
     _metrics.registry.reset()
     _metrics._reset_components_for_tests()
     _flight._reset_for_tests()

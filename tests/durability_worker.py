@@ -1,0 +1,81 @@
+"""Worker body for the full-world kill-and-cold-restart durability test.
+
+Launched by tests/test_durability.py (pattern of tests/chaos_worker.py):
+ONE process is the entire world — there is no survivor holding state in
+memory, which is exactly the correlated-failure case the durable state
+plane (byteps_tpu/server/wal.py) exists for.  The worker opens the
+process-lifetime durable KV store, pushes a deterministic delta sequence
+with (worker_id, seq) idempotence tokens, and checkpoints every
+CKPT_EVERY steps.  The parent SIGKILLs it mid-step, then relaunches it
+against the SAME durable dir; the restarted worker cold-recovers
+(snapshot + journal replay), reads the restored dedup floor, and resumes
+pushing from floor+1 — journal-before-merge guarantees the floor names
+EXACTLY the deltas folded into the restored arrays, so the final state
+is bit-identical to a fault-free run, whatever instant the kill landed.
+
+Prints (parent asserts on these):
+  FLOOR <n>          the restored dedup floor at startup (0 = cold dir)
+  RECOVERED <json>   the DurableKV.recover_stats of this incarnation
+  STEP <n>           progress marker (the parent kills after seeing one)
+  FINAL <hex>        sha256 of the final array bytes + generation
+
+Env: BYTEPS_DURABLE_DIR (the shared dir), BYTEPS_DUR_STEPS,
+BYTEPS_DUR_CKPT_EVERY, plus optional BYTEPS_WAL_* knobs under test.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    steps = int(os.environ.get("BYTEPS_DUR_STEPS", "300"))
+    ckpt_every = int(os.environ.get("BYTEPS_DUR_CKPT_EVERY", "20"))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from byteps_tpu.server import wal
+
+    store, dur = wal.ensure_process_store()
+    print("RECOVERED", json.dumps(dur.recover_stats), flush=True)
+
+    # idempotent on a warm restart: init_key is a no-op once the key
+    # exists (restored from the snapshot or replayed from its journal
+    # record)
+    store.init_key("w", np.zeros(64, np.float32))
+
+    floor = store._seen.get(("w", 0), 0)
+    print("FLOOR", floor, flush=True)
+
+    # Deterministic per-seq delta: the fault-free final is a pure
+    # function of `steps`, so bit-exactness is checkable across runs.
+    for seq in range(floor + 1, steps + 1):
+        delta = np.full(64, float(seq % 7) + 0.125, np.float32)
+        store.push_delta("w", delta, worker_id=0, seq=seq)
+        if seq % ckpt_every == 0:
+            dur.checkpoint()
+        if seq % 10 == 0:
+            print("STEP", seq, flush=True)
+        # keep the run long enough for the parent's kill to land mid-way
+        time.sleep(0.002)
+
+    final = store.pull("w")
+    digest = hashlib.sha256(
+        np.ascontiguousarray(final).tobytes()
+        + str(store._generation).encode()).hexdigest()
+    print("FINAL", digest, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

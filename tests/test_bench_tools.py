@@ -770,6 +770,12 @@ def test_bench_smoke_floor_and_gate_arithmetic(tmp_path, monkeypatch):
         "failed_reads": 0, "spawned": 4, "drain_started": 2,
         "drained": 2, "drain_escalated": 0, "banned": 0,
         "final_hosts": 2, "still_draining": []})
+    # the durability measurement is seconds of real journaled pushes
+    # plus a cold replay; its gate arithmetic is pinned separately below
+    monkeypatch.setattr(bs, "_measure_durability", lambda: {
+        "push_ratio": 0.6, "ratio_per_rep": [0.6], "replay_records": 401,
+        "replay_mb": 25.0, "replay_mbps": 250.0, "truncated_tails": 0,
+        "corrupt_records": 0})
     monkeypatch.setattr(bs, "setup_cpu8_mesh", lambda: None)
     monkeypatch.setenv("BENCH_SMOKE_TOLERANCE", "0.30")
     monkeypatch.setattr(sys, "argv", ["bench_smoke.py"])
@@ -864,6 +870,52 @@ def test_bench_smoke_fleet_floor_and_gate_arithmetic():
     slow = fl()
     slow["pulls_per_s"] = 0.1
     assert not bs._fleet_ok(slow, floor, 0.3)
+
+
+def test_bench_smoke_durability_floor_and_gate_arithmetic():
+    """ISSUE 19: the durability lane gates on the journal's push-path
+    cost ratio and the cold-start replay MB/s (both host measurements,
+    lane tolerance), the replay actually reading records back, and a
+    clean journal replaying with ZERO damage detected (absolute — torn
+    tails or corrupt records on a fault-free bench mean the write path
+    itself produces garbage).  Pin the floor file's entries and the
+    pure gate function."""
+    from tools import bench_smoke as bs
+    with open(bs.FLOOR_PATH) as f:
+        floor = json.load(f)
+    assert 0 < floor["durability_push_ratio_floor"] <= 1
+    assert floor["durability_replay_mbps_floor"] > 0
+
+    def du():
+        return {"push_ratio": 0.6, "replay_mbps": 250.0,
+                "replay_records": 401, "truncated_tails": 0,
+                "corrupt_records": 0}
+
+    good = du()
+    assert bs._durability_ok(good, floor, 0.3)
+    assert good["gate_push_ratio"] == round(
+        floor["durability_push_ratio_floor"] * 0.7, 3)
+    assert good["gate_replay_mbps"] == round(
+        floor["durability_replay_mbps_floor"] * 0.7, 1)
+    # the journal taxing the push path fails the ratio floor
+    taxed = du()
+    taxed["push_ratio"] = 0.01
+    assert not bs._durability_ok(taxed, floor, 0.3)
+    # a slow cold start fails the replay floor
+    slow = du()
+    slow["replay_mbps"] = 0.5
+    assert not bs._durability_ok(slow, floor, 0.3)
+    # a replay that read nothing back gates nothing — fail loudly
+    empty = du()
+    empty["replay_records"] = 0
+    assert not bs._durability_ok(empty, floor, 0.3)
+    # damage on a FAULT-FREE run is absolute — no tolerance
+    torn = du()
+    torn["truncated_tails"] = 1
+    assert not bs._durability_ok(torn, floor, 0.3)
+    corrupt = du()
+    corrupt["corrupt_records"] = 2
+    assert not bs._durability_ok(corrupt, floor, 0.3)
 
 
 def test_bench_smoke_compressed_floor_and_gate_arithmetic():

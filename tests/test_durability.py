@@ -1,0 +1,663 @@
+"""Durable state plane tests (byteps_tpu/server/wal.py, ISSUE 19).
+
+What is pinned here:
+
+- the WAL record format and replay state machine: length-prefixed
+  sealed records, LSN continuity, torn tails truncated in place (appends
+  resume right after the valid prefix), a corrupt mid-log record
+  truncating there and DISCARDING later segments — recovery always lands
+  on the last durable point, never past a hole;
+- atomic snapshot cuts: write-to-temp + fsync + rename, manifest with
+  the version vector, retention pruning, and the corrupt-newest-falls-
+  back-to-older path (counted, flight-recorded, never silently used);
+- the KVStore coupling: journal-before-merge (a failed append leaves
+  memory untouched and the dedup floor unburned), checkpoint/recover
+  bit-exactness for arrays + versions + generation + membership epoch +
+  dedup floors, and the epoch/clear record kinds;
+- the chaos sites (``disk_full``, ``wal_write``, ``fsync``) and their
+  counters;
+- SnapshotStore.cut() driving the durable checkpoint + WAL truncation;
+- RecoveryCoordinator composed with the durable trainer-store restore
+  (satellite: fault/recovery.py);
+- the observability surfaces: /debug/state wal section, bps_top's WAL
+  column, bps_doctor's durability postmortem fold;
+- serve-host restart-in-place: the committed arc restored from local
+  disk before registration, the publisher's arc_info probe seeding its
+  acked view so the next cut ships ZERO bytes (fleet lane);
+- the headline acceptance: SIGKILL the ENTIRE world mid-step, cold
+  restart from disk, finals bit-exact vs a fault-free run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.telemetry import counters, gauges
+from byteps_tpu.fault import injector as inj
+from byteps_tpu.server import wal
+from byteps_tpu.server.kv_store import KVStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    yield
+    inj.disarm()
+
+
+def _mk_store(dirpath, n=8, **cfg_over):
+    store = KVStore()
+    dur = wal.attach(store, str(dirpath), cfg=_cfg(**cfg_over))
+    store.init_key("w", np.zeros(n, np.float32))
+    return store, dur
+
+
+def _cfg(**over):
+    from byteps_tpu.common.config import get_config
+    cfg = get_config()
+    if not over:
+        return cfg
+    import dataclasses
+    return dataclasses.replace(cfg, **over)
+
+
+def _digest(store):
+    return hashlib.sha256(
+        np.ascontiguousarray(store.pull("w")).tobytes()
+        + str(store._generation).encode()).hexdigest()
+
+
+# -- WAL record format / replay state machine ---------------------------------
+
+
+def test_wal_roundtrip_records_and_lsn_sequence(tmp_path):
+    log = wal.WriteAheadLog(str(tmp_path))
+    assert log.replay() == ([], {"records": 0, "bytes": 0,
+                                 "truncated_tails": 0, "corrupt_records": 0,
+                                 "dropped_segments": 0})
+    a = np.arange(4, dtype=np.float32)
+    assert log.append("init", ("k", a)) == 1
+    assert log.append("delta", ("k", a, 0, 1)) == 2
+    assert log.append("epoch", 3) == 3
+    assert log.lsn == 3
+    log.close()
+
+    log2 = wal.WriteAheadLog(str(tmp_path))
+    recs, stats = log2.replay()
+    assert [(lsn, kind) for lsn, kind, _ in recs] == [
+        (1, "init"), (2, "delta"), (3, "epoch")]
+    np.testing.assert_array_equal(recs[0][2][1], a)
+    assert stats["records"] == 3 and stats["truncated_tails"] == 0
+    # appends continue the sequence right after the valid prefix
+    assert log2.append("epoch", 4) == 4
+    log2.close()
+
+
+def test_wal_append_before_replay_raises(tmp_path):
+    log = wal.WriteAheadLog(str(tmp_path))
+    with pytest.raises(RuntimeError, match="before replay"):
+        log.append("epoch", 1)
+
+
+def test_wal_torn_tail_truncated_and_appends_resume(tmp_path):
+    log = wal.WriteAheadLog(str(tmp_path))
+    log.replay()
+    for i in range(1, 6):
+        log.append("epoch", i)
+    log.close()
+    seg = log.segments()[-1][1]
+    good_size = os.path.getsize(seg)
+    # a torn final write: half a record's bytes reached the disk
+    with open(seg, "ab") as fh:
+        fh.write(b"\x00\x00\x01\x00" + b"\xde\xad")
+
+    log2 = wal.WriteAheadLog(str(tmp_path))
+    recs, stats = log2.replay()
+    assert [r[0] for r in recs] == [1, 2, 3, 4, 5]
+    assert stats["truncated_tails"] == 1
+    assert stats["corrupt_records"] == 0
+    # the torn bytes are GONE from disk (truncated, fsynced) and appends
+    # resume the LSN sequence
+    assert os.path.getsize(seg) == good_size
+    assert log2.append("epoch", 6) == 6
+    log2.close()
+    log3 = wal.WriteAheadLog(str(tmp_path))
+    recs, stats = log3.replay()
+    assert [r[0] for r in recs] == [1, 2, 3, 4, 5, 6]
+    assert stats["truncated_tails"] == 0
+    log3.close()
+
+
+def test_wal_midlog_corruption_discards_later_segments(tmp_path):
+    # tiny segments force a multi-segment log
+    log = wal.WriteAheadLog(str(tmp_path), segment_bytes=256)
+    log.replay()
+    for i in range(1, 30):
+        log.append("epoch", i)
+    log.close()
+    segs = log.segments()
+    assert len(segs) >= 3
+    # flip one byte in the middle of the FIRST segment's first record
+    first = segs[0][1]
+    with open(first, "r+b") as fh:
+        fh.seek(10)
+        b = fh.read(1)
+        fh.seek(10)
+        fh.write(bytes([b[0] ^ 0x40]))
+
+    before_dropped = counters.get("wal.dropped_segments")
+    log2 = wal.WriteAheadLog(str(tmp_path))
+    recs, stats = log2.replay()
+    # replay stops AT the corruption: nothing later is trusted
+    assert recs == []
+    assert stats["corrupt_records"] == 1
+    assert stats["truncated_tails"] == 0
+    assert stats["dropped_segments"] == len(segs) - 1
+    assert counters.get("wal.dropped_segments") - before_dropped \
+        == len(segs) - 1
+    # the later segments are gone from disk
+    assert len(log2.segments()) <= 1
+    log2.close()
+
+
+def test_wal_fsync_policy_validation_and_off_interval_replay(tmp_path):
+    from byteps_tpu.common.config import Config
+    with pytest.raises(ValueError, match="wal_fsync"):
+        Config(wal_fsync="sometimes")
+    with pytest.raises(ValueError, match="wal_fsync_interval"):
+        Config(wal_fsync_interval_s=0.0)
+    with pytest.raises(ValueError, match="wal_segment_bytes"):
+        Config(wal_segment_bytes=1)
+    with pytest.raises(ValueError, match="wal_retain"):
+        Config(wal_retain_snapshots=0)
+    for policy in ("off", "interval"):
+        d = tmp_path / policy
+        log = wal.WriteAheadLog(str(d), fsync=policy,
+                                fsync_interval_s=0.01)
+        log.replay()
+        for i in range(1, 4):
+            log.append("epoch", i)
+        log.close()
+        log2 = wal.WriteAheadLog(str(d))
+        recs, _ = log2.replay()
+        assert [r[0] for r in recs] == [1, 2, 3]
+        log2.close()
+
+
+def test_wal_segment_roll_and_truncate_upto(tmp_path):
+    log = wal.WriteAheadLog(str(tmp_path), segment_bytes=256)
+    log.replay()
+    for i in range(1, 40):
+        log.append("epoch", i)
+    segs = log.segments()
+    assert len(segs) >= 4
+    # truncate up to the start of the third segment: exactly the first
+    # two (whose every record is covered) are removable
+    cover = segs[2][0] - 1
+    removed = log.truncate_upto(cover)
+    assert removed == 2
+    left = log.segments()
+    assert left[0][0] == segs[2][0]
+    # replay of the survivor suffix still works (expected-LSN chain
+    # starts fresh at the first surviving record)
+    log.close()
+    log2 = wal.WriteAheadLog(str(tmp_path))
+    recs, stats = log2.replay()
+    assert recs[0][0] == segs[2][0] and recs[-1][0] == 39
+    assert stats["corrupt_records"] == 0
+    log2.close()
+
+
+# -- atomic snapshots ---------------------------------------------------------
+
+
+def test_snapshot_save_load_retention_and_manifest(tmp_path):
+    d = str(tmp_path)
+    for lsn in (5, 9, 14):
+        wal.save_snapshot(d, {"arrays": {}, "versions": {"w": lsn},
+                              "generation": 1, "epoch": 0, "seen": {}},
+                          lsn=lsn, generation=1, retain=2)
+    state, lsn = wal.load_snapshot(d)
+    assert lsn == 14 and state["versions"] == {"w": 14}
+    # retention pruned the oldest cut
+    names = sorted(os.listdir(d))
+    assert sum(n.endswith(".bin") for n in names) == 2
+    manifest = json.load(open(os.path.join(d, "kv-manifest.json")))
+    assert manifest["lsn"] == 14 and manifest["generation"] == 1
+    assert manifest["versions"] == {"w": "14"} or \
+        manifest["versions"] == {"w": 14}
+
+
+def test_snapshot_corrupt_newest_falls_back_to_older(tmp_path):
+    d = str(tmp_path)
+    wal.save_snapshot(d, {"versions": {"w": 1}}, lsn=3, generation=0)
+    wal.save_snapshot(d, {"versions": {"w": 2}}, lsn=7, generation=0)
+    newest = [f for f in os.listdir(d) if f.endswith("0000007.bin")][0]
+    path = os.path.join(d, newest)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x5A        # flip a bit mid-payload
+    open(path, "wb").write(bytes(blob))
+    before = counters.get("wal.snapshot_corrupt")
+    state, lsn = wal.load_snapshot(d)
+    assert lsn == 3 and state["versions"] == {"w": 1}
+    assert counters.get("wal.snapshot_corrupt") == before + 1
+
+
+# -- the KVStore coupling -----------------------------------------------------
+
+
+def test_durable_kv_checkpoint_recover_bitexact(tmp_path):
+    store, dur = _mk_store(tmp_path)
+    store.set_membership_epoch(4)
+    for w in range(2):
+        store.push_delta("w", np.full(8, float(w + 1), np.float32),
+                         worker_id=w, seq=1)
+    assert dur.checkpoint() is True
+    assert dur.checkpoint() is False      # nothing journaled since
+    for w in range(2):
+        store.push_delta("w", np.full(8, 0.25, np.float32),
+                         worker_id=w, seq=2)
+    want = _digest(store)
+    want_versions = dict(store._versions)
+    want_seen = dict(store._seen)
+    dur.close()
+
+    store2, stats = wal.recover(str(tmp_path))
+    assert stats["had_snapshot"] == 1 and stats["applied"] >= 2
+    assert _digest(store2) == want
+    assert store2._versions == want_versions
+    assert store2._seen == want_seen
+    assert store2._membership_epoch == 4
+    # the restored dedup floor absorbs a duplicate retry post-restart
+    v = store2.pull("w").copy()
+    store2.push_delta("w", np.full(8, 0.25, np.float32), worker_id=0,
+                      seq=2)
+    np.testing.assert_array_equal(store2.pull("w"), v)
+
+
+def test_durable_kv_clear_and_generation_survive_recovery(tmp_path):
+    store, dur = _mk_store(tmp_path)
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+    gen0 = store._generation
+    store.clear()
+    assert store._generation == gen0 + 1
+    store.init_key("w", np.full(8, 7.0, np.float32))
+    dur.close()
+    store2, _ = wal.recover(str(tmp_path))
+    assert store2._generation == gen0 + 1
+    np.testing.assert_array_equal(store2.pull("w"),
+                                  np.full(8, 7.0, np.float32))
+
+
+@pytest.mark.integrity
+def test_wal_disk_full_append_fails_store_untouched(tmp_path):
+    """Journal-before-merge: a failed append must leave the in-memory
+    value unchanged AND the dedup floor unburned, so the caller's
+    legitimate retry (after space frees) lands exactly once."""
+    store, dur = _mk_store(tmp_path)
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+    v = store.pull("w").copy()
+    inj.arm("drop:site=disk_full:p=1", seed=1, rank=0)
+    before = counters.get("wal.disk_full_errors")
+    with pytest.raises(OSError):
+        store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=2)
+    inj.disarm()
+    assert counters.get("wal.disk_full_errors") == before + 1
+    np.testing.assert_array_equal(store.pull("w"), v)
+    assert store._seen[("w", 0)] == 1
+    # the retry lands once space is back
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=2)
+    np.testing.assert_array_equal(store.pull("w"), v + 1.0)
+    dur.close()
+
+
+@pytest.mark.chaos
+def test_wal_torn_write_chaos_recovers_to_last_durable_point(tmp_path):
+    store, dur = _mk_store(tmp_path)
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+    want = _digest(store)
+    inj.arm("drop:site=wal_write:p=1", seed=2, rank=0)
+    before = counters.get("wal.torn_writes")
+    with pytest.raises(OSError):
+        store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=2)
+    inj.disarm()
+    assert counters.get("wal.torn_writes") == before + 1
+    dur.close()
+    # cold start: the torn tail is truncated; state is the last durable
+    # point (the failed push never reached memory either — consistent)
+    store2, stats = wal.recover(str(tmp_path))
+    assert stats["truncated_tails"] == 1
+    assert _digest(store2) == want
+
+
+@pytest.mark.chaos
+def test_wal_bitflip_chaos_detected_and_truncated_at_replay(tmp_path):
+    store, dur = _mk_store(tmp_path)
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+    want = _digest(store)
+    want_floor = dict(store._seen)
+    inj.arm("bitflip:site=wal_write:p=1", seed=3, rank=0)
+    # the append "succeeds" (memory merges) but the on-disk frame is
+    # corrupt — the crash model where the disk lied about the bytes
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=2)
+    inj.disarm()
+    dur.close()
+    store2, stats = wal.recover(str(tmp_path))
+    assert stats["truncated_tails"] == 1    # last record of last segment
+    assert _digest(store2) == want
+    assert store2._seen == want_floor       # floor matches the arrays
+
+
+@pytest.mark.chaos
+def test_wal_fsync_dropped_chaos_counted_replay_still_whole(tmp_path):
+    inj.arm("drop:site=fsync:p=1", seed=4, rank=0)
+    store, dur = _mk_store(tmp_path)
+    before = counters.get("wal.fsync_dropped")
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+    assert counters.get("wal.fsync_dropped") > before
+    want = _digest(store)
+    inj.disarm()
+    dur.close()
+    # a SIGKILL-style crash keeps the page cache: the un-fsynced bytes
+    # still replay whole (the drop models durability loss on power
+    # failure, which a unit test cannot produce — the counter is the pin)
+    store2, _ = wal.recover(str(tmp_path))
+    assert _digest(store2) == want
+
+
+def test_snapshotstore_cut_checkpoints_and_truncates_wal(tmp_path):
+    from byteps_tpu.server.serving import SnapshotStore
+    store, dur = _mk_store(tmp_path, wal_segment_bytes=4096)
+    snapstore = SnapshotStore(store)
+    try:
+        for seq in range(1, 40):
+            store.push_delta("w", np.full(8, 0.5, np.float32),
+                             worker_id=0, seq=seq)
+        lag_before = dur.wal.lag_bytes()
+        before_saves = counters.get("wal.snapshot_saves")
+        snapstore.cut()
+        assert counters.get("wal.snapshot_saves") == before_saves + 1
+        # the cut bounded the replay suffix: covered whole segments gone
+        assert dur.wal.lag_bytes() < lag_before
+        assert gauges.get("wal.last_snapshot_lsn") == dur.wal.lsn
+    finally:
+        snapstore.detach()
+        dur.close()
+    # cold start restores from the cut without replaying the truncated
+    # prefix
+    store2, stats = wal.recover(str(tmp_path))
+    assert stats["had_snapshot"] == 1
+    assert _digest(store2) == _digest(store)
+
+
+# -- fault/recovery.py composition (satellite) --------------------------------
+
+
+def test_recovery_coordinator_durable_restore(tmp_path, monkeypatch):
+    """RecoveryCoordinator composed with the durable plane: when
+    BYTEPS_DURABLE_DIR is set, the recovery flow rebuilds the trainer
+    store from disk and reports the replay stats on the result."""
+    monkeypatch.setenv("BYTEPS_DURABLE_DIR", str(tmp_path))
+    from byteps_tpu.common.config import reset_config
+    reset_config()
+    # a previous incarnation persisted state
+    store, dur = wal.ensure_process_store()
+    store.init_key("w", np.zeros(8, np.float32))
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+    dur.checkpoint(force=True)
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=2)
+    want = _digest(store)
+
+    from byteps_tpu.fault.recovery import RecoveryCoordinator
+    import byteps_tpu.core.api as api
+    monkeypatch.setenv("BYTEPS_HEARTBEAT_ON", "0")
+    api.init()  # env-built config: durable plane armed
+    try:
+        coord = RecoveryCoordinator(template={"w": np.zeros(8)})
+        res = coord.recover({1})
+        assert res.durable is not None
+        assert res.durable["had_snapshot"] == 1
+        assert res.durable["applied"] >= 1
+        restored = wal.process_store()
+        assert restored is not None
+        assert _digest(restored) == want
+        assert counters.get("recovery.durable_restore") == 1
+    finally:
+        api.shutdown()
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+def test_obs_debug_state_wal_section(tmp_path):
+    store, dur = _mk_store(tmp_path)
+    store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+    from byteps_tpu.common import obs_server
+    doc = obs_server.debug_state()
+    sections = doc["wal"]
+    assert sections and sections[0]["kind"] == "wal"
+    assert sections[0]["lsn"] == dur.wal.lsn
+    assert sections[0]["fsync"] == "always"
+    json.dumps(doc, default=str)
+    dur.close()
+
+
+def test_bps_top_wal_column_and_json_parity(tmp_path):
+    from tools.bps_top import _COLUMNS, _wal_cell, render
+    assert "WAL" in _COLUMNS
+    assert _wal_cell({}) == "-"
+    assert _wal_cell({"wal.lag_bytes": 512}) == "512"
+    assert _wal_cell({"wal.lag_bytes": 8192}) == "8.0K"
+    assert _wal_cell({"wal.lag_bytes": 3 << 20}) == "3.0M"
+    cluster = {"epoch": 1, "world": [0], "coordinator": 0,
+               "ranks": {0: {"metrics": {"counters": {}, "gauges":
+                                         {"wal.lag_bytes": 2048}}}}}
+    out = render(cluster)
+    assert "WAL" in out and "2.0K" in out
+
+
+def test_doctor_postmortem_durability_fold(tmp_path):
+    from tools.bps_doctor import diagnose_postmortem, render_markdown
+    dump = {"rank": 0, "reason": "test", "events": [
+        {"t": 2.0, "kind": "wal.recovered", "snapshot_lsn": 14,
+         "applied": 6, "truncated_tails": 1, "corrupt_records": 0,
+         "dropped_segments": 0},
+        {"t": 1.0, "kind": "wal.truncated_tail",
+         "segment": "kv-0000000000000001.wal", "offset": 812,
+         "reason": "short record body"},
+        {"t": 3.0, "kind": "wal.arc_restored", "host": 2,
+         "snapshot_id": 9, "keys": 6},
+    ]}
+    with open(os.path.join(str(tmp_path), "bps_flight_rank0.json"),
+              "w") as fh:
+        json.dump(dump, fh)
+    report = diagnose_postmortem(str(tmp_path))
+    kinds = [d["kind"] for d in report["durability"]]
+    assert kinds == ["truncated_tail", "recovered", "arc_restored"]
+    md = render_markdown(report)
+    assert "## Durability / cold start" in md
+    assert "restored from local disk" in md
+    assert "truncated to the last durable point" in md
+
+
+# -- serve-host restart-in-place (fleet lane) ---------------------------------
+
+
+@pytest.mark.chaos
+def test_fleet_serve_host_restart_in_place_durable_arc_zero_reship(tmp_path):
+    """A serving host cold-restarted against its durable dir publishes
+    its persisted arc BEFORE registering; the publisher's arc_info probe
+    then seeds the acked view and the next cut ships ZERO bytes — the
+    full-arc DCN re-ship is gone from the happy path."""
+    from byteps_tpu.server.serving_tier import (ServingHostCore,
+                                                ServingTier, TierDirectory,
+                                                inproc_host)
+    keys = [f"r{i}" for i in range(6)]
+    store = KVStore()
+    for i, k in enumerate(keys):
+        store.init_key(k, np.full(32, float(i), np.float32))
+    d = TierDirectory(static_hosts={0: ("127.0.0.1", 1),
+                                    1: ("127.0.0.1", 2)})
+    inproc_host(ServingHostCore(host_id=0))
+    core1 = ServingHostCore(host_id=1, durable_dir=str(tmp_path))
+    inproc_host(core1)
+    tier = ServingTier(store, directory=d, replicas=1,
+                       cut_interval_s=None)
+    try:
+        snap = tier.cut()
+        assert counters.get("wal.arc_saves") >= 1
+        committed = core1.debug_state()["snapshot_id"]
+        assert committed == snap.id
+
+        # the whole host process "dies"; a new incarnation cold-starts
+        # against the SAME durable dir and restores the arc in __init__
+        new_core = ServingHostCore(host_id=1, durable_dir=str(tmp_path))
+        assert new_core.restored_commit == committed
+        assert counters.get("wal.arc_restores") == 1
+        inproc_host(new_core)
+        # re-register at a NEW address: the publisher sees a new
+        # incarnation and drops its acked map (the pre-durable world
+        # would now re-ship the full owned slice)
+        d.register(("127.0.0.1", 3), host_id=1)
+
+        shipped_before = counters.get("serve.tier_ship_bytes")
+        snap2 = tier.cut()
+        assert counters.get("serve.tier_ship_bytes") == shipped_before
+        assert counters.get("wal.arc_probe_hits") >= 1
+        # the restored host committed the new cut entirely from
+        # carried-forward refs
+        st = new_core.debug_state()
+        assert st["snapshot_id"] == snap2.id
+        assert st["restored_commit"] == committed
+        client = tier.client(max_staleness_s=10.0, stale_on_error=False)
+        vals = client.pull()
+        for k in keys:
+            np.testing.assert_array_equal(vals[k], store.pull(k))
+        client.close()
+    finally:
+        tier.close()
+
+
+@pytest.mark.chaos
+def test_fleet_serve_host_corrupt_arc_quarantined_full_reship(tmp_path):
+    """A corrupt on-disk arc is detected, removed, and counted — the
+    host starts EMPTY and the publisher's normal un-acked re-ship path
+    restores it (degraded, never wrong)."""
+    from byteps_tpu.server.serving_tier import ServingHostCore
+    core = ServingHostCore(host_id=5, durable_dir=str(tmp_path))
+    from byteps_tpu.server.serving import Snapshot
+    snap = Snapshot(id=3, ts=time.monotonic(),
+                    versions={"a": 1},
+                    refs={"a": np.ones(8, np.float32)}, gen=0)
+    core._persist_arc(snap)
+    path = core._arc_path
+    with open(path, "r+b") as fh:
+        fh.seek(20)
+        fh.write(b"\x00\x01\x02\x03")
+    before = counters.get("wal.arc_corrupt")
+    core2 = ServingHostCore(host_id=5, durable_dir=str(tmp_path))
+    assert core2.restored_commit == 0
+    assert counters.get("wal.arc_corrupt") == before + 1
+    assert not os.path.exists(path)     # quarantined, never re-read
+
+
+# -- the headline acceptance: full-world kill, cold restart -------------------
+
+
+def _run_worker(env, timeout=120):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "durability_worker.py")],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _worker_env(durable_dir, steps=260, **extra):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+                "JAX_PLATFORMS": "cpu",
+                "BYTEPS_DURABLE_DIR": str(durable_dir),
+                "BYTEPS_DUR_STEPS": str(steps),
+                "BYTEPS_DUR_CKPT_EVERY": "20"})
+    env.pop("BYTEPS_FAULT_SPEC", None)
+    env.update(extra)
+    return env
+
+
+def _final(out: str) -> str:
+    for line in out.splitlines():
+        if line.startswith("FINAL "):
+            return line.split()[1]
+    raise AssertionError(f"no FINAL line in worker output:\n{out}")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_durability_full_world_kill_cold_restart_bitexact(tmp_path):
+    """Kill the ENTIRE world mid-step (SIGKILL — no atexit, no flush),
+    cold-restart from local disk, and finish: the finals must be
+    bit-exact against a fault-free run.  The restored dedup floor names
+    exactly the deltas folded into the restored arrays
+    (journal-before-merge), so resuming at floor+1 double-applies
+    nothing and loses nothing, whatever instant the kill landed."""
+    # fault-free reference
+    ref = _run_worker(_worker_env(tmp_path / "ref"))
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    want = _final(ref.stdout)
+
+    # the chaos run: kill the world mid-step
+    kdir = tmp_path / "kill"
+    env = _worker_env(kdir)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "durability_worker.py")],
+        env=env, stdout=subprocess.PIPE, text=True)
+    saw_step = None
+    t0 = time.monotonic()
+    for line in proc.stdout:
+        if line.startswith("STEP "):
+            saw_step = int(line.split()[1])
+            if saw_step >= 100:
+                break
+        assert time.monotonic() - t0 < 60, "worker never reached step 100"
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=30)
+    proc.stdout.close()
+    assert saw_step is not None and saw_step >= 100
+
+    # cold restart: one process, same dir, zero survivors
+    again = _run_worker(_worker_env(kdir))
+    assert again.returncode == 0, again.stdout + again.stderr
+    stats = json.loads(
+        [ln for ln in again.stdout.splitlines()
+         if ln.startswith("RECOVERED ")][0][len("RECOVERED "):])
+    assert stats["had_snapshot"] == 1      # at least one cut landed
+    floor = int([ln for ln in again.stdout.splitlines()
+                 if ln.startswith("FLOOR ")][0].split()[1])
+    assert floor >= 100                    # restored past the kill point
+    assert _final(again.stdout) == want    # bit-exact
+
+
+@pytest.mark.chaos
+def test_durability_cold_restart_after_clean_exit_bitexact(tmp_path):
+    """The graceful sibling of the kill test (fast, not slow-marked):
+    run to completion, then a second cold start over the same dir must
+    restore the exact final state and add nothing (every seq is at or
+    below the restored floor)."""
+    first = _run_worker(_worker_env(tmp_path, steps=80))
+    assert first.returncode == 0, first.stdout + first.stderr
+    again = _run_worker(_worker_env(tmp_path, steps=80))
+    assert again.returncode == 0, again.stdout + again.stderr
+    assert _final(first.stdout) == _final(again.stdout)
+    floor = int([ln for ln in again.stdout.splitlines()
+                 if ln.startswith("FLOOR ")][0].split()[1])
+    assert floor == 80

@@ -572,6 +572,111 @@ def _serve_dist_ok(sd: dict, floor: dict, tol: float) -> bool:
             and sd["pulls_per_s"] >= gate)
 
 
+def _measure_durability(numel=16 * 1024, reps=9, batch=24,
+                        replay_pushes=400):
+    """Durability lane (ISSUE 19, server/wal.py): what the journal
+    costs on the hot push path, and how fast a cold start replays it.
+
+    Push cost: interleaved per-rep batches of ``push_delta`` against a
+    plain in-memory KVStore and a WAL-attached one (same key shape,
+    same deltas, adjacent in time — the bench_smoke host-regime pairing
+    trick), ratio = plain wall / durable wall per rep, median across
+    reps.  The journal runs with ``fsync=off`` so the ratio isolates
+    the journaling machinery (pickle + CRC seal + buffered write),
+    not this host's disk — the fsync policy cost is an operator
+    choice documented in docs/fault_tolerance.md, not a regression
+    this gate could meaningfully bound on a shared CI host.
+
+    Replay: a fresh journal of ``replay_pushes`` records is cold-read
+    back through ``wal.recover`` into an empty store; MB/s over the
+    journal bytes actually replayed.  Gated (floor file):
+    ``durability_push_ratio_floor`` and
+    ``durability_replay_mbps_floor``."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.server import wal
+    from byteps_tpu.server.kv_store import KVStore
+
+    tmp = tempfile.mkdtemp(prefix="bps_bench_durable_")
+    cfg = Config(telemetry_on=False, trace_on=False,
+                 durable_dir=tmp, wal_fsync="off")
+    try:
+        plain = KVStore()
+        durable = KVStore()
+        dur = wal.attach(durable, os.path.join(tmp, "push"), cfg)
+        zeros = np.zeros(numel, np.float32)
+        plain.init_key("b", zeros)
+        durable.init_key("b", zeros)
+        delta = np.random.RandomState(3).randn(numel).astype(np.float32)
+
+        def burst(store, start):
+            for seq in range(start, start + batch):
+                store.push_delta("b", delta, worker_id=0, seq=seq)
+
+        burst(plain, 1)          # warm both paths past first-touch
+        burst(durable, 1)
+        ratios = []
+        for rep in range(reps):
+            base = (rep + 1) * batch + 1
+            t0 = time.perf_counter()
+            burst(plain, base)
+            t_plain = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            burst(durable, base)
+            t_dur = time.perf_counter() - t0
+            ratios.append(t_plain / t_dur)
+        dur.close()
+
+        # cold-start replay: a fresh journal, then recover into an
+        # empty store and clock the whole snapshot+replay path
+        replay_dir = os.path.join(tmp, "replay")
+        src = KVStore()
+        src_dur = wal.attach(src, replay_dir, cfg)
+        src.init_key("b", zeros)
+        for seq in range(1, replay_pushes + 1):
+            src.push_delta("b", delta, worker_id=0, seq=seq)
+        src_dur.close()
+        t0 = time.perf_counter()
+        _, stats = wal.recover(replay_dir, cfg=cfg)
+        replay_s = time.perf_counter() - t0
+
+        def med(xs):
+            m, _, _ = quantile_stats_raw(xs)
+            return m
+        return {"push_ratio": round(med(ratios), 3),
+                "ratio_per_rep": [round(r, 3) for r in sorted(ratios)],
+                "replay_records": stats["records"],
+                "replay_mb": round(stats["bytes"] / MB, 2),
+                "replay_mbps": round(stats["bytes"] / MB / replay_s, 1),
+                "truncated_tails": stats["truncated_tails"],
+                "corrupt_records": stats["corrupt_records"]}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _durability_ok(du: dict, floor: dict, tol: float) -> bool:
+    """The durability gate (pure; pinned by a unit test): the journal
+    must not tax the push path below the floor ratio, a cold start
+    must replay above the MB/s floor, the replay must actually have
+    read the records back (a 0-record replay would gate nothing), and
+    a CLEAN journal must replay with zero damage detected — a torn
+    tail or corrupt record on a fault-free bench run means the write
+    path itself is producing garbage."""
+    gate_r = floor.get("durability_push_ratio_floor", 0.0) * (1.0 - tol)
+    gate_m = floor.get("durability_replay_mbps_floor", 0.0) * (1.0 - tol)
+    du["gate_push_ratio"] = round(gate_r, 3)
+    du["gate_replay_mbps"] = round(gate_m, 1)
+    return (du["push_ratio"] >= gate_r
+            and du["replay_mbps"] >= gate_m
+            and du["replay_records"] > 0
+            and du["truncated_tails"] == 0
+            and du["corrupt_records"] == 0)
+
+
 def _measure_fleet():
     """Fleet churn (ISSUE 18): pulls/s + p99 measured WHILE the fleet
     reconciler spawns real serve-host processes up to the peak target
@@ -621,6 +726,7 @@ def main() -> int:
     out["transport"] = _measure_transport()
     out["serve_dist"] = _measure_serve_dist()
     out["fleet"] = _measure_fleet()
+    out["durability"] = _measure_durability()
     if "--update-floor" in sys.argv:
         # compressed throughput floor: half the measured worst lane —
         # room for host noise, still catches a machinery collapse
@@ -656,6 +762,15 @@ def main() -> int:
                  # graceful drains, so it is the noisiest lane of all
                  "fleet_pulls_per_s_floor": round(
                      out["fleet"]["pulls_per_s"] / 10, 1),
+                 # durability: half the measured push ratio (the
+                 # interleaved pairing cancels host regime, but pickle
+                 # + CRC cost still jitters with CPU contention) and a
+                 # tenth of the measured replay MB/s (cold reads hit
+                 # the page cache unpredictably on a shared host)
+                 "durability_push_ratio_floor": round(
+                     out["durability"]["push_ratio"] / 2, 3),
+                 "durability_replay_mbps_floor": round(
+                     out["durability"]["replay_mbps"] / 10, 1),
                  "note": "measured floor; the lane fails below "
                          "ratio * (1 - tolerance)"}
         with open(FLOOR_PATH, "w") as f:
@@ -695,9 +810,11 @@ def main() -> int:
     out["serve_dist"]["ok"] = serve_dist_ok
     fleet_ok = _fleet_ok(out["fleet"], floor, tol)
     out["fleet"]["ok"] = fleet_ok
+    durability_ok = _durability_ok(out["durability"], floor, tol)
+    out["durability"]["ok"] = durability_ok
     out["ok"] = (engine_ok and straggler_ok and compressed_ok and trace_ok
                  and ts_ok and transport_ok and serve_dist_ok
-                 and fleet_ok)
+                 and fleet_ok and durability_ok)
     print(json.dumps(out))
     if not engine_ok:
         print(f"bench-smoke FAIL: engine_vs_fused_ratio "
@@ -758,6 +875,18 @@ def main() -> int:
               f"land clean), or pulls_per_s {fl['pulls_per_s']} < gate "
               f"{fl['gate_pulls_per_s']} — the self-operating fleet "
               f"machinery regressed", file=sys.stderr)
+    if not durability_ok:
+        du = out["durability"]
+        print(f"bench-smoke FAIL: durability lane violates the floor — "
+              f"push_ratio {du['push_ratio']} < gate "
+              f"{du['gate_push_ratio']} (the journal is taxing the hot "
+              f"push path), replay_mbps {du['replay_mbps']} < gate "
+              f"{du['gate_replay_mbps']} over {du['replay_records']} "
+              f"record(s) (cold start got slow or replayed nothing), "
+              f"or a CLEAN journal replayed with damage "
+              f"(truncated_tails {du['truncated_tails']}, "
+              f"corrupt_records {du['corrupt_records']} — the write "
+              f"path is producing garbage)", file=sys.stderr)
     if not transport_ok:
         trp = out["transport"]
         print(f"bench-smoke FAIL: transport lane violates the floor — "

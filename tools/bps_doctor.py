@@ -226,6 +226,7 @@ def diagnose_postmortem(dir_: str) -> dict:
     faults: List[dict] = []
     parks: List[dict] = []
     reconcile: List[dict] = []
+    durability: List[dict] = []
     for doc in dumps:
         rank = doc.get("rank")
         for ev in doc.get("events") or ():
@@ -254,6 +255,15 @@ def diagnose_postmortem(dir_: str) -> dict:
                                "detail": {k: v for k, v in ev.items()
                                           if k not in ("t", "mono",
                                                        "kind", "site")}})
+            elif kind.startswith("wal."):
+                # durable-state-plane incidents (ISSUE 19): cold-start
+                # replays, torn tails truncated, corrupt segments or
+                # snapshots discarded, serving arcs restored from disk
+                durability.append({"t": ev.get("t"), "rank": rank,
+                                   "kind": kind[len("wal."):],
+                                   "detail": {k: v for k, v in ev.items()
+                                              if k not in ("t", "mono",
+                                                           "kind")}})
             elif kind in ("membership.partition_minority",
                           "membership.quorum_refused"):
                 parks.append({"t": ev.get("t"), "rank": rank,
@@ -265,6 +275,7 @@ def diagnose_postmortem(dir_: str) -> dict:
     faults.sort(key=lambda f: f.get("t") or 0.0)
     parks.sort(key=lambda p: p.get("t") or 0.0)
     reconcile.sort(key=lambda r: r.get("t") or 0.0)
+    durability.sort(key=lambda d: d.get("t") or 0.0)
     partition = _partition_incident(faults, parks)
     firing = [a for a in alerts if a.get("state") == "firing"]
     first = firing[0] if firing else None
@@ -343,6 +354,7 @@ def diagnose_postmortem(dir_: str) -> dict:
             "partition": partition,
             "parks": parks,
             "reconciler": reconcile,
+            "durability": durability,
             "timeseries": ts,
             "trace": trace,
             "culprit": culprit}
@@ -441,6 +453,24 @@ def render_markdown(report: dict) -> str:
                 lines.append("- t=%s host %s: %s %s"
                              % (r.get("t"), r.get("host"), r.get("kind"),
                                 r.get("detail") or ""))
+        if report.get("durability"):
+            lines.append("\n## Durability / cold start")
+            restores = [d for d in report["durability"]
+                        if d["kind"] in ("recovered", "arc_restored")]
+            losses = [d for d in report["durability"]
+                      if d["kind"] in ("truncated_tail", "corrupt_record",
+                                       "snapshot_corrupt", "arc_corrupt")]
+            if restores:
+                lines.append("- restored from local disk: rank(s) %s"
+                             % sorted({d.get("rank") for d in restores}))
+            if losses:
+                lines.append("- journal damage detected and truncated to "
+                             "the last durable point: %d event(s)"
+                             % len(losses))
+            for d in report["durability"]:
+                lines.append("- t=%s rank %s: %s %s"
+                             % (d.get("t"), d.get("rank"), d.get("kind"),
+                                d.get("detail") or ""))
         if report["faults"]:
             lines.append("\n## Injected/recorded faults")
             for f in report["faults"]:

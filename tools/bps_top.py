@@ -31,8 +31,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _COLUMNS = ("RANK", "ROLE", "GB/s", "QDEPTH", "INFLIGHT", "STALL%",
-            "ATTRIB", "RETX", "PULLS", "SHED%", "ARC", "CONN", "CODEC",
-            "TREND", "SLOW", "STATE", "EPOCH", "STEP", "AGE")
+            "ATTRIB", "RETX", "PULLS", "SHED%", "ARC", "CONN", "WAL",
+            "CODEC", "TREND", "SLOW", "STATE", "EPOCH", "STEP", "AGE")
+
+
+def _wal_cell(gauges: dict) -> str:
+    """Durable-plane replay lag (server/wal.py): the on-disk journal
+    bytes a cold start of this rank would replay, from the
+    ``wal.lag_bytes`` gauge each checkpoint cycle refreshes.  '-' =
+    durability off on this rank; a value climbing across refreshes
+    means cuts have stopped landing (full disk, wedged cut thread) and
+    the cold-start story is silently getting worse."""
+    lag = gauges.get("wal.lag_bytes")
+    if lag is None:
+        return "-"
+    if lag >= 1 << 20:
+        return "%.1fM" % (lag / (1 << 20))
+    if lag >= 1 << 10:
+        return "%.1fK" % (lag / (1 << 10))
+    return str(int(lag))
 
 
 def _conn_cell(gauges: dict) -> str:
@@ -176,6 +193,8 @@ def _rank_row(rank: int, entry: dict, slow=None, probation=(),
         fmt(None if arc is None else 100.0 * arc, "{:.0f}%"),
         # transport (comm/transport.py): ready/total peer connections
         _conn_cell(gauges),
+        # durable state plane (server/wal.py): cold-start replay lag
+        _wal_cell(gauges),
         # compression (ISSUE 11): which codec(s) this rank's pushes ride
         _codec_cell(gauges),
         # history (ISSUE 16): throughput sparkline over the rank's

@@ -14,7 +14,20 @@
 # Usage:  tools/run_chaos.sh [lane] [extra pytest args...]
 #         lane: chaos (default) | integrity | obs | coordinator | serve
 #               | serve_dist | straggler | compressed | trace
-#               | transport | doctor | gossip | fleet | lint | all
+#               | transport | doctor | gossip | fleet | durability
+#               | lint | all
+#         durability: the durable-state-plane slice (ISSUE 19,
+#              server/wal.py, docs/fault_tolerance.md "Durable state &
+#              cold start") — the full-world kill acceptance (SIGKILL
+#              the ENTIRE world mid-step, cold-restart from the local
+#              WAL + snapshot cuts, finals bit-exact vs a fault-free
+#              run), the torn-tail / bitflipped-segment / fsync-dropped
+#              variants (each truncates to the last durable point,
+#              detected and counted, zero silent corruption), the
+#              disk_full journal-before-merge pin (failed append leaves
+#              memory untouched and the dedup floor unburned), and the
+#              serve-host restart-in-place arc-restore pins
+#              (tests/test_durability.py)
 #         fleet: the fleet-reconciler slice (ISSUE 18,
 #              launcher/reconciler.py, docs/serving.md "The
 #              self-operating fleet") — the 8-host storm acceptance
@@ -154,6 +167,9 @@ case "${1:-}" in
     fleet)     MARK="chaos or integrity"
                KEXPR="fleet"
                shift ;;
+    durability) MARK="chaos or integrity"
+                KEXPR="durability or wal"
+                shift ;;
     all)       MARK="chaos or integrity"; shift ;;
     lint)
         shift
