@@ -63,7 +63,11 @@ class RecoveryResult:
     #                             durable-plane recovery stats
     #                             (server/wal.py) when BYTEPS_DURABLE_DIR
     #                             is set: snapshot lsn, records replayed,
-    #                             torn tails truncated — None when the
+    #                             torn tails truncated.  A surviving
+    #                             process keeps its OPEN store (the
+    #                             stats are from when it opened); only a
+    #                             process with no open incarnation
+    #                             rebuilds from disk.  None when the
     #                             durable plane is off or its restore
     #                             failed (the in-memory recovery stands
     #                             either way)
@@ -161,6 +165,14 @@ class RecoveryCoordinator:
         get_logger().error(
             "recovery: rank(s) %s lost — drain/suspend, resume on %d "
             "worker(s), restore from checkpoint", sorted(stale), k)
+        # durable-plane survivor probe — BEFORE suspend/resume: resume's
+        # init() opens the durable process store itself, so probing
+        # afterwards would always look like a survivor
+        from ..common.config import get_config
+        dur_survivor = False
+        if get_config().durable_dir:
+            from ..server import wal as _wal
+            dur_survivor = _wal.process_store() is not None
         if api.initialized():
             api.suspend()          # drains handles, stops engine+heartbeat
         if not self.rearm_heartbeat:
@@ -178,17 +190,26 @@ class RecoveryCoordinator:
                 self.template)
         # durable state plane (server/wal.py): when no survivor holds
         # the KV state in memory, the journal + snapshot cuts on local
-        # disk DO — rebuild the trainer-side store from them.  Failure
-        # is non-fatal: the in-memory recovery above already stands,
-        # and the store simply starts empty (the pre-ISSUE-19 world).
+        # disk DO — rebuild the trainer-side store from them.  When
+        # THIS process survived with its durable store open, the store
+        # in memory is the authority: under wal_fsync=interval/off the
+        # journal tail exists only in memory, so closing and
+        # re-replaying from disk would discard acknowledged pushes —
+        # keep the live incarnation and harden its tail instead.
+        # Failure is non-fatal either way: the in-memory recovery above
+        # already stands, and the store simply starts empty (the
+        # pre-ISSUE-19 world).
         dur_stats = None
-        from ..common.config import get_config
         if get_config().durable_dir:
             from ..server import wal as _wal
             try:
-                _store, dur = _wal.recover_process_store()
+                _store, dur = _wal.ensure_process_store()
+                if dur_survivor:
+                    dur.wal.sync()
+                    counters.inc("recovery.durable_kept")
+                else:
+                    counters.inc("recovery.durable_restore")
                 dur_stats = dict(dur.recover_stats)
-                counters.inc("recovery.durable_restore")
             except Exception:  # noqa: BLE001 — degraded, not dead
                 counters.inc("recovery.durable_restore_failed")
                 get_logger().error(

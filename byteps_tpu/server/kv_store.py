@@ -282,13 +282,25 @@ class KVStore:
                     self._membership_epoch = int(data)
                     self._seen.clear()
         elif kind == "clear":
+            if isinstance(data, (tuple, list)):
+                generation, epoch = data
+            else:  # pre-epoch records journaled the bare generation
+                generation, epoch = data, None
             with self._lock:
                 self._store.clear()
                 self._versions.clear()
                 self._codecs.clear()
                 self._seen.clear()
                 self._cow.clear()
-                self._generation = int(data)
+                self._generation = int(generation)
+                if epoch is not None:
+                    # the live clear() re-syncs the epoch to the world
+                    # observed AT CLEAR TIME; replaying that observation
+                    # keeps a cold-started store from holding the stale
+                    # pre-clear epoch and dropping new-world deltas
+                    self._membership_epoch = int(epoch)
+        elif kind == "__advance__":
+            pass  # WAL LSN-jump marker (wal.advance_to) — no mutation
         else:
             counters.inc("wal.replay_skipped")
             get_logger().error("wal replay: unknown record kind %r "
@@ -778,8 +790,11 @@ class KVStore:
         a cross-clear version comparison would silently serve pre-clear
         values as fresh."""
         with self._lock:
+            epoch = _membership.current_epoch()
             if self._wal is not None:
-                self._wal.append("clear", self._generation + 1)
+                # the epoch rides the record so replay restores the
+                # re-sync below, not the stale pre-clear epoch
+                self._wal.append("clear", (self._generation + 1, epoch))
             self._store.clear()
             self._versions.clear()
             self._codecs.clear()
@@ -787,5 +802,5 @@ class KVStore:
             self._cow.clear()
             self.wire_bytes = 0
             self.wire_bytes_wasted = 0
-            self._membership_epoch = _membership.current_epoch()
+            self._membership_epoch = epoch
             self._generation += 1

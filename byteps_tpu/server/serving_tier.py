@@ -254,7 +254,11 @@ class ServingHostCore:
             with open(path, "rb") as fh:
                 frame = fh.read()
             blob, _meta = _integrity.open_bytes(frame)
-            state = pickle.loads(blob)
+            # restricted unpickler (server/wal.py): the seal is CRC, not
+            # authentication — a writable durable dir must not name
+            # arbitrary callables
+            from . import wal as _wal_mod
+            state = _wal_mod._loads(blob)
             refs: Dict[str, np.ndarray] = {}
             for k, a in state["arrays"].items():
                 arr = np.array(a, copy=True)

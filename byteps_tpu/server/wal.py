@@ -38,7 +38,19 @@ gate are rebuilt exactly, so a worker's duplicate retry arriving
 record that fails verification: a torn tail is truncated in place
 (appends resume right after the valid prefix); a corrupt mid-log
 record truncates there and discards the later segments — recovering to
-the last *durable* point with zero silent corruption.
+the last *durable* point with zero silent corruption.  When corruption
+leaves the journal's tail **below** the restored snapshot's cut,
+recovery advances the LSN past the cut with a sealed ``__advance__``
+marker (:meth:`WriteAheadLog.advance_to`): new appends are never
+assigned LSNs an existing snapshot already covers, so a later restart
+cannot skip them as "already folded in".
+
+Durable blobs (journal records, snapshot cuts) are deserialized through
+a **restricted unpickler** limited to numpy's array machinery and plain
+builtins: the CRC seal detects corruption but does not *authenticate*,
+so the durable dir must be as trusted as the binary — the allowlist
+keeps a writable dir from naming arbitrary callables
+(docs/fault_tolerance.md, "Trust boundary").
 
 Chaos sites woven here (``fault/injector.py``): ``wal_write``
 (``bitflip`` corrupts the on-disk frame, ``drop`` tears the write
@@ -72,6 +84,46 @@ _LEN = struct.Struct("!I")
 # sanity clamp on a length prefix: anything past this is garbage bytes
 # read as a length, not a record something in this codebase wrote
 _MAX_RECORD = 1 << 30
+# marker record kind written by advance_to(): carries no mutation, only
+# a verified forward LSN jump (data = {"prev": last LSN before the jump})
+_ADVANCE = "__advance__"
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Unpickler for durable blobs, limited to what the durable plane
+    actually serializes: numpy's array reconstruction machinery plus a
+    handful of containers pickle names as globals.  The integrity seal
+    is corruption DETECTION (CRC), not authentication — without this
+    allowlist, write access to BYTEPS_DURABLE_DIR would be arbitrary
+    code execution in every process that recovers from it."""
+
+    _SAFE_BUILTINS = {"complex", "set", "frozenset", "bytearray", "slice"}
+    _SAFE_NUMPY = {("numpy", "ndarray"), ("numpy", "dtype"),
+                   ("numpy.core.multiarray", "_reconstruct"),
+                   ("numpy.core.multiarray", "scalar"),
+                   ("numpy.core.numeric", "_frombuffer"),
+                   ("numpy._core.multiarray", "_reconstruct"),
+                   ("numpy._core.multiarray", "scalar"),
+                   ("numpy._core.numeric", "_frombuffer")}
+
+    def find_class(self, module, name):
+        if module == "builtins" and name in self._SAFE_BUILTINS:
+            import builtins
+            return getattr(builtins, name)
+        if (module, name) in self._SAFE_NUMPY:
+            import importlib
+            return getattr(importlib.import_module(module), name)
+        raise pickle.UnpicklingError(
+            f"durable blob names global {module}.{name}, which is not "
+            "on the durable-plane allowlist (the durable dir is "
+            "CRC-checked, not authenticated — see "
+            "docs/fault_tolerance.md 'Trust boundary')")
+
+
+def _loads(payload: bytes) -> Any:
+    """Deserialize a verified durable blob through the allowlist."""
+    import io
+    return _RestrictedUnpickler(io.BytesIO(payload)).load()
 
 
 def _fsync_dir(path: str) -> None:
@@ -157,47 +209,80 @@ class WriteAheadLog:
         to memory (journal-before-merge is the crash-consistency
         contract)."""
         with self._lock:
+            return self._append_locked(kind, data)
+
+    def _append_locked(self, kind: str, data: Any) -> int:
+        if not self._replayed:
+            raise RuntimeError("WriteAheadLog.append before replay() "
+                               "— the log position is unknown")
+        if _fault.ENABLED and _fault.should_drop("disk_full"):
+            counters.inc("wal.disk_full_errors")
+            raise OSError(errno.ENOSPC,
+                          "wal: no space left on device (injected)")
+        lsn = self._lsn + 1
+        payload = pickle.dumps((lsn, kind, data),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _integrity.seal_bytes(payload, key="wal", seq=lsn)
+        buf = _LEN.pack(len(frame)) + frame
+        if _fault.ENABLED:
+            buf = _fault.corrupt_bytes("wal_write", buf)
+        if self._fh is None or self._seg_size >= self._segment_bytes:
+            self._roll(lsn)
+        if _fault.ENABLED and _fault.should_drop("wal_write"):
+            # a torn write: half the record reaches the disk, then
+            # the "crash" — the caller sees the failure (mutation
+            # not applied) and replay truncates the torn tail
+            self._fh.write(buf[:max(1, len(buf) // 2)])
+            self._fh.flush()
+            counters.inc("wal.torn_writes")
+            raise OSError(errno.EIO,
+                          "wal: torn write (injected crash)")
+        self._fh.write(buf)
+        self._seg_size += len(buf)
+        self._lsn = lsn
+        counters.inc("wal.appends")
+        counters.inc("wal.append_bytes", len(buf))
+        if self._fsync == "always":
+            _maybe_fsync(self._fh)
+        elif self._fsync == "interval":
+            now = time.monotonic()
+            if now - self._last_sync >= self._fsync_interval_s:
+                if _maybe_fsync(self._fh):
+                    self._last_sync = now
+        else:  # "off": the OS page cache decides
+            self._fh.flush()
+        gauges.set("wal.lsn", lsn)
+        return lsn
+
+    def advance_to(self, lsn: int) -> int:
+        """Force future appends onto LSNs strictly above ``lsn``.
+
+        Recovery calls this when corruption truncated the journal BELOW
+        a restored snapshot's cut: without the jump, new appends would
+        reuse LSNs the snapshot already covers and the NEXT recovery's
+        ``lsn <= snapshot`` skip would silently discard them.  Rolls a
+        fresh segment and seals an explicit :data:`_ADVANCE` marker
+        record into it, so the next replay can verify the LSN gap was an
+        intentional, snapshot-covered advance — not a missing segment.
+        No-op when the log is already at or past ``lsn``."""
+        with self._lock:
             if not self._replayed:
-                raise RuntimeError("WriteAheadLog.append before replay() "
-                                   "— the log position is unknown")
-            if _fault.ENABLED and _fault.should_drop("disk_full"):
-                counters.inc("wal.disk_full_errors")
-                raise OSError(errno.ENOSPC,
-                              "wal: no space left on device (injected)")
-            lsn = self._lsn + 1
-            payload = pickle.dumps((lsn, kind, data),
-                                   protocol=pickle.HIGHEST_PROTOCOL)
-            frame = _integrity.seal_bytes(payload, key="wal", seq=lsn)
-            buf = _LEN.pack(len(frame)) + frame
-            if _fault.ENABLED:
-                buf = _fault.corrupt_bytes("wal_write", buf)
-            if self._fh is None or self._seg_size >= self._segment_bytes:
-                self._roll(lsn)
-            if _fault.ENABLED and _fault.should_drop("wal_write"):
-                # a torn write: half the record reaches the disk, then
-                # the "crash" — the caller sees the failure (mutation
-                # not applied) and replay truncates the torn tail
-                self._fh.write(buf[:max(1, len(buf) // 2)])
-                self._fh.flush()
-                counters.inc("wal.torn_writes")
-                raise OSError(errno.EIO,
-                              "wal: torn write (injected crash)")
-            self._fh.write(buf)
-            self._seg_size += len(buf)
-            self._lsn = lsn
-            counters.inc("wal.appends")
-            counters.inc("wal.append_bytes", len(buf))
-            if self._fsync == "always":
-                _maybe_fsync(self._fh)
-            elif self._fsync == "interval":
-                now = time.monotonic()
-                if now - self._last_sync >= self._fsync_interval_s:
-                    if _maybe_fsync(self._fh):
-                        self._last_sync = now
-            else:  # "off": the OS page cache decides
-                self._fh.flush()
-            gauges.set("wal.lsn", lsn)
-            return lsn
+                raise RuntimeError("WriteAheadLog.advance_to before "
+                                   "replay() — the log position is "
+                                   "unknown")
+            if lsn <= self._lsn:
+                return self._lsn
+            prev = self._lsn
+            self._lsn = int(lsn)
+            self._roll(self._lsn + 1)
+            self._append_locked(_ADVANCE, {"prev": prev})
+            counters.inc("wal.advances")
+            get_logger().warning(
+                "wal: advanced LSN %d -> %d past a restored snapshot "
+                "cut (journal had truncated below it) — new appends "
+                "cannot collide with snapshot-covered LSNs", prev,
+                self._lsn)
+            return self._lsn
 
     def _roll(self, first_lsn: int) -> None:
         """Caller holds the lock: close the current segment (fsynced —
@@ -256,14 +341,25 @@ class WriteAheadLog:
                                      off + _LEN.size + flen]
                         try:
                             payload, _meta = _integrity.open_bytes(frame)
-                            lsn, kind, data = pickle.loads(payload)
+                            lsn, kind, data = _loads(payload)
                         except Exception as e:  # noqa: BLE001 — any
                             # failure here is corruption, by definition
                             bad = f"record failed verification: {e}"
                         else:
                             if expected is not None and lsn != expected:
-                                bad = (f"LSN discontinuity: got {lsn}, "
-                                       f"expected {expected}")
+                                if (kind == _ADVANCE and lsn > expected
+                                        and isinstance(data, dict)
+                                        and data.get("prev")
+                                        == expected - 1):
+                                    # a sealed advance marker whose
+                                    # "prev" chains to the record before
+                                    # it: an intentional, snapshot-
+                                    # covered LSN jump (advance_to), not
+                                    # a hole in the history
+                                    pass
+                                else:
+                                    bad = (f"LSN discontinuity: got "
+                                           f"{lsn}, expected {expected}")
                     if bad is not None:
                         tail = (i == len(segs) - 1)
                         if tail:
@@ -443,7 +539,7 @@ def load_snapshot(dirpath: str, name: str = "kv"
             with open(path, "rb") as fh:
                 frame = fh.read()
             payload, _meta = _integrity.open_bytes(frame)
-            state = pickle.loads(payload)
+            state = _loads(payload)
         except Exception as e:  # noqa: BLE001 — corruption, by definition
             counters.inc("wal.snapshot_corrupt")
             get_logger().error(
@@ -496,8 +592,19 @@ class DurableKV:
         for lsn, kind, data in records:
             if lsn <= snap_lsn:
                 continue  # covered by the snapshot we restored
+            if kind == _ADVANCE:
+                continue  # LSN jump marker, not a mutation
             self.store.apply_wal_record(kind, data)
             applied += 1
+        if snap_lsn > self.wal.lsn:
+            # corruption truncated the journal BELOW the restored cut
+            # (a corrupt record between the cut point and the tail, or
+            # a fully-corrupt live segment).  Jump the LSN past the
+            # snapshot so new appends are never assigned LSNs an
+            # existing cut covers — otherwise the next recovery's
+            # "lsn <= snap_lsn" skip above would silently discard
+            # acknowledged, fsynced mutations.
+            stats["advanced_to"] = self.wal.advance_to(snap_lsn)
         self._ckpt_lsn = snap_lsn
         stats.update(snapshot_lsn=snap_lsn, applied=applied,
                      had_snapshot=int(state is not None),
@@ -611,8 +718,12 @@ def ensure_process_store(cfg=None) -> Tuple[Any, DurableKV]:
 
 def recover_process_store(cfg=None) -> Tuple[Any, DurableKV]:
     """Cold-start recovery of the trainer-side store: close any open
-    incarnation and rebuild it from disk (the ``fault/recovery.py``
-    restore path when no survivor holds the state in memory)."""
+    incarnation and rebuild it from disk.  DESTRUCTIVE to a live
+    incarnation: components already holding the old store object keep a
+    reference that no longer journals, and any journal tail the chaos
+    ``fsync`` site dropped is gone — only call this when no in-memory
+    state is authoritative (``fault/recovery.py`` keeps a surviving
+    process's open store and rebuilds only when none is open)."""
     global _proc
     with _proc_lock:
         if _proc is not None:

@@ -299,6 +299,128 @@ def test_durable_kv_clear_and_generation_survive_recovery(tmp_path):
                                   np.full(8, 7.0, np.float32))
 
 
+def test_durable_kv_clear_restores_epoch_at_clear_time(tmp_path,
+                                                       monkeypatch):
+    """A replayed ``clear`` must re-sync the membership epoch the way
+    the live clear() did — to the epoch observed AT CLEAR TIME.  A
+    cold-started store keeping the stale pre-clear epoch would drop
+    every new-world delta as stale until a later epoch record lands."""
+    from byteps_tpu.server import kv_store as kv_mod
+    store, dur = _mk_store(tmp_path)
+    store.set_membership_epoch(3)
+    real_epoch = kv_mod._membership.current_epoch
+    monkeypatch.setattr(kv_mod._membership, "current_epoch", lambda: 7)
+    store.clear()                       # clear-time world is epoch 7
+    assert store._membership_epoch == 7
+    store.init_key("w", np.zeros(8, np.float32))
+    dur.close()
+    monkeypatch.setattr(kv_mod._membership, "current_epoch", real_epoch)
+
+    store2, _ = wal.recover(str(tmp_path))
+    assert store2._membership_epoch == 7
+    # a new-world delta stamped with the clear-time epoch LANDS — the
+    # pre-fix replay kept epoch 3 and dropped it as stale
+    store2.push_delta("w", np.ones(8, np.float32), mepoch=7,
+                      worker_id=0, seq=1)
+    np.testing.assert_array_equal(store2.pull("w"),
+                                  np.ones(8, np.float32))
+
+
+@pytest.mark.chaos
+def test_wal_corruption_below_cut_point_post_restart_pushes_survive(
+        tmp_path):
+    """A corrupt record BELOW the snapshot cut truncates the journal to
+    an LSN the restored snapshot already covers.  Recovery must advance
+    the journal past the cut (sealed ``__advance__`` marker) so new
+    appends take fresh LSNs — without it, acknowledged post-restart
+    pushes reuse covered LSNs and the SECOND restart's ``lsn <=
+    snapshot`` skip silently discards them."""
+    store, dur = _mk_store(tmp_path, wal_segment_bytes=4096)
+    for seq in range(1, 25):
+        store.push_delta("w", np.full(8, 0.5, np.float32), worker_id=0,
+                         seq=seq)
+    assert dur.checkpoint(force=True)
+    snap_lsn = dur.wal.lsn
+    want_cut = _digest(store)
+    dur.close()
+    # corrupt the first surviving record — strictly below the cut point
+    # (the covered prefix segments were truncated away by the cut)
+    segs = dur.wal.segments()
+    assert segs and segs[0][0] <= snap_lsn
+    with open(segs[0][1], "r+b") as fh:
+        fh.seek(8)
+        b = fh.read(1)
+        fh.seek(8)
+        fh.write(bytes([b[0] ^ 0x20]))
+
+    # restart 1: snapshot restores the cut; the journal truncated below
+    # it, so recovery must advance past snap_lsn before taking appends
+    store2, stats = wal.recover(str(tmp_path))
+    assert stats["had_snapshot"] == 1
+    assert stats.get("advanced_to", 0) > snap_lsn
+    assert _digest(store2) == want_cut
+    dur2 = store2._durable
+    assert dur2.wal.lsn > snap_lsn
+    # acknowledged post-restart pushes...
+    store2.push_delta("w", np.full(8, 2.0, np.float32), worker_id=0,
+                      seq=25)
+    store2.push_delta("w", np.full(8, 3.0, np.float32), worker_id=1,
+                      seq=1)
+    want = _digest(store2)
+    dur2.close()
+
+    # restart 2: ...must SURVIVE (the pre-fix world skipped them here
+    # as "covered by the snapshot")
+    store3, stats3 = wal.recover(str(tmp_path))
+    assert _digest(store3) == want
+    assert store3._seen[("w", 0)] == 25
+    assert store3._seen[("w", 1)] == 1
+    # and the checkpoint no-op guard is healed too: the journal position
+    # sits above the restored cut, so a fresh cut is not refused
+    assert store3._durable.checkpoint() is True
+    store3._durable.close()
+
+
+def test_wal_restricted_unpickler_rejects_foreign_globals(tmp_path):
+    """The durable dir is CRC-checked, not authenticated: a hand-crafted
+    record whose pickle names a global off the durable-plane allowlist
+    must be treated as corruption (truncated, counted) — never
+    resolved, never executed."""
+    import pickle as _pickle
+    from byteps_tpu.common import integrity as _integrity
+
+    pwned = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {pwned}",))
+
+    with pytest.raises(_pickle.UnpicklingError, match="allowlist"):
+        wal._loads(_pickle.dumps(Evil()))
+    # the allowlist still round-trips everything the plane serializes
+    state = {"arrays": {"w": np.arange(3, dtype=np.float32)},
+             "seen": {("w", 0): 2}, "generation": 1}
+    out = wal._loads(_pickle.dumps(state,
+                                   protocol=_pickle.HIGHEST_PROTOCOL))
+    np.testing.assert_array_equal(out["arrays"]["w"], state["arrays"]["w"])
+    assert out["seen"] == state["seen"]
+
+    # a forged-but-correctly-sealed journal record: replay must classify
+    # it as corruption at the unpickle, not resolve the global
+    payload = _pickle.dumps((1, "delta", Evil()),
+                            protocol=_pickle.HIGHEST_PROTOCOL)
+    frame = _integrity.seal_bytes(payload, key="wal", seq=1)
+    seg = os.path.join(str(tmp_path), f"kv-{1:016d}.wal")
+    with open(seg, "wb") as fh:
+        fh.write(wal._LEN.pack(len(frame)) + frame)
+    log = wal.WriteAheadLog(str(tmp_path))
+    recs, stats = log.replay()
+    assert recs == []
+    assert stats["truncated_tails"] == 1
+    assert not pwned.exists()
+    log.close()
+
+
 @pytest.mark.integrity
 def test_wal_disk_full_append_fails_store_untouched(tmp_path):
     """Journal-before-merge: a failed append must leave the in-memory
@@ -404,25 +526,32 @@ def test_snapshotstore_cut_checkpoints_and_truncates_wal(tmp_path):
 
 
 def test_recovery_coordinator_durable_restore(tmp_path, monkeypatch):
-    """RecoveryCoordinator composed with the durable plane: when
-    BYTEPS_DURABLE_DIR is set, the recovery flow rebuilds the trainer
-    store from disk and reports the replay stats on the result."""
+    """RecoveryCoordinator composed with the durable plane, cold-start
+    side: when BYTEPS_DURABLE_DIR is set and NO incarnation of the
+    trainer store is open (this process did not survive with state in
+    memory), the recovery flow rebuilds the store from disk and reports
+    the replay stats on the result."""
     monkeypatch.setenv("BYTEPS_DURABLE_DIR", str(tmp_path))
     from byteps_tpu.common.config import reset_config
     reset_config()
-    # a previous incarnation persisted state
+    # a previous incarnation persisted state ... and died
     store, dur = wal.ensure_process_store()
     store.init_key("w", np.zeros(8, np.float32))
     store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
     dur.checkpoint(force=True)
     store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=2)
     want = _digest(store)
+    wal._reset_for_tests()          # the process is gone
+    assert wal.process_store() is None
 
     from byteps_tpu.fault.recovery import RecoveryCoordinator
     import byteps_tpu.core.api as api
     monkeypatch.setenv("BYTEPS_HEARTBEAT_ON", "0")
-    api.init()  # env-built config: durable plane armed
+    before = counters.get("recovery.durable_restore")
     try:
+        # no api.init() first: the coordinator's resume() performs the
+        # re-init, and the durable block must classify this as a
+        # restore-from-disk, not a survivor
         coord = RecoveryCoordinator(template={"w": np.zeros(8)})
         res = coord.recover({1})
         assert res.durable is not None
@@ -431,7 +560,43 @@ def test_recovery_coordinator_durable_restore(tmp_path, monkeypatch):
         restored = wal.process_store()
         assert restored is not None
         assert _digest(restored) == want
-        assert counters.get("recovery.durable_restore") == 1
+        assert counters.get("recovery.durable_restore") == before + 1
+    finally:
+        api.shutdown()
+
+
+def test_recovery_coordinator_survivor_keeps_open_store(tmp_path,
+                                                        monkeypatch):
+    """RecoveryCoordinator composed with the durable plane, survivor
+    side: a process that lives through the failure event with its
+    durable store OPEN must keep that incarnation — closing and
+    re-replaying from disk would orphan every component holding the old
+    store object and discard any journal tail the chaos fsync site
+    dropped.  The coordinator syncs the live journal instead."""
+    monkeypatch.setenv("BYTEPS_DURABLE_DIR", str(tmp_path))
+    from byteps_tpu.common.config import reset_config
+    reset_config()
+    from byteps_tpu.fault.recovery import RecoveryCoordinator
+    import byteps_tpu.core.api as api
+    monkeypatch.setenv("BYTEPS_HEARTBEAT_ON", "0")
+    api.init()                      # opens the process store
+    try:
+        store, dur = wal.ensure_process_store()
+        store.init_key("w", np.zeros(8, np.float32))
+        store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=1)
+        want = _digest(store)
+        kept = counters.get("recovery.durable_kept")
+        restored = counters.get("recovery.durable_restore")
+        coord = RecoveryCoordinator(template={"w": np.zeros(8)})
+        res = coord.recover({1})
+        assert counters.get("recovery.durable_kept") == kept + 1
+        assert counters.get("recovery.durable_restore") == restored
+        # the SAME incarnation is still open — never closed + re-replayed
+        assert wal.process_store() is store
+        assert _digest(store) == want
+        assert res.durable is not None
+        # acknowledged pushes keep landing on the surviving incarnation
+        store.push_delta("w", np.ones(8, np.float32), worker_id=0, seq=2)
     finally:
         api.shutdown()
 
