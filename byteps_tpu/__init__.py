@@ -36,6 +36,9 @@ from byteps_tpu.core.api import (  # noqa: F401
     poll,
     synchronize,
     declare,
+    declare_update,
+    push_pull_update,
+    push_pull_update_async,
     get_pushpull_speed,
     membership_epoch,
     metrics_snapshot,

@@ -1,0 +1,76 @@
+"""Shared shard-geometry math for flat sharded optimizer state.
+
+Two paths keep optimizer state as one flat padded f32 vector sharded
+over mesh axes: ``parallel/zero.py`` (in-graph SPMD ZeRO — the whole
+model as one vector, collectives inside the train step) and
+``core/sharded_update.py`` (the engine's fused sharded weight update,
+ISSUE 20 — one vector per declared tensor, collectives on the engine's
+push_pull pipeline).  The padding rule, the axis resolution, and the
+"which optimizer-state leaves are sharded" spec rule must be the SAME
+in both, or a state exported from one layout could not be re-imported
+into the other and the two `sharded_update=True` adapters would drift.
+This module is that single source; zero.py re-exports these under its
+historical private names.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import CommContext, DCN_AXIS, ICI_AXIS
+
+__all__ = [
+    "padded_size",
+    "resolve_axes",
+    "spec_of_opt",
+    "init_sharded_opt_state",
+]
+
+
+def padded_size(n: int, ranks: int) -> int:
+    """Pad to a multiple of ranks*128 so every shard is lane-aligned (the
+    partitioner's 512-elem tile rule, common/partitioner.py, scaled to the
+    shard grid)."""
+    quantum = ranks * 128
+    return (n + quantum - 1) // quantum * quantum
+
+
+def resolve_axes(comm: CommContext, shard_axes: str):
+    """(scatter/gather axes, remaining-sum axes, shard count).
+
+    "all": shard over every DP axis — minimum memory (1/R).
+    "ici": HSDP / hybrid sharding — shard within a slice, replicate
+    across slices: the per-step all_gather/psum_scatter ride ICI only,
+    and DCN carries just a psum of the 1/n_ici gradient shard (the
+    layout multi-slice pods want when DCN bandwidth, not HBM, is the
+    constraint).
+    """
+    if shard_axes == "all":
+        return comm.dp_axes, (), comm.num_ranks
+    if shard_axes == "ici":
+        return (ICI_AXIS,), (DCN_AXIS,), comm.n_ici
+    raise ValueError(
+        f"shard_axes must be 'all' or 'ici', got {shard_axes!r}")
+
+
+def spec_of_opt(tree, padded: int, axes):
+    """PartitionSpec tree for flat-sharded optimizer state: vectors of
+    the master's padded length are sharded over ``axes``, everything
+    else (step counters, scalar hyperparams) is replicated."""
+    return jax.tree.map(
+        lambda x: P(axes) if (getattr(x, "ndim", 0) == 1
+                              and x.shape[0] == padded) else P(),
+        tree)
+
+
+def init_sharded_opt_state(comm: CommContext, tx, master, padded: int,
+                           axes):
+    """``tx.init(master)`` with every padded-length leaf COMMITTED to the
+    shard layout (``P(axes)``) and everything else replicated.  The pin
+    matters: zeros_like outputs carry no data dependence on the input,
+    so XLA propagation would replicate them."""
+    shapes = jax.eval_shape(tx.init, master)
+    out_sh = jax.tree.map(lambda s: NamedSharding(comm.mesh, s),
+                          spec_of_opt(shapes, padded, axes))
+    return jax.jit(tx.init, out_shardings=out_sh)(master)

@@ -202,6 +202,41 @@ class Config:
     #                                  where a consumer needs replicated
     #                                  values) when the output shape admits
     #                                  it; 0 = always replicate at assembly
+    sharded_update: bool = False     # BYTEPS_SHARDED_UPDATE: pull leg
+    #                                  returns the owner-updated PARAMETER
+    #                                  update instead of the merged
+    #                                  gradient — the reduce-scatter shard
+    #                                  stays resident on its owner, a
+    #                                  per-shard optax update (flat-shard
+    #                                  optimizer state, AOT-warmed at
+    #                                  declare time) runs before the
+    #                                  all-gather, and assembly reuses the
+    #                                  deferred-gather block-sharded emit.
+    #                                  Steady-state wire bytes drop from
+    #                                  2N (RS + AG of gradients) to
+    #                                  N + N/R (core/sharded_update.py,
+    #                                  docs/performance.md)
+    sharded_update_fused: bool = False  # BYTEPS_SHARDED_UPDATE_FUSED:
+    #                                  dispatch the whole per-shard
+    #                                  optimizer step as ONE fused XLA
+    #                                  program instead of the default
+    #                                  eager op-by-op step wrapped in
+    #                                  jitted layout legs. Faster (one
+    #                                  dispatch per tensor per step) but
+    #                                  XLA's FMA contraction makes the
+    #                                  trajectory drift from the
+    #                                  unsharded path by ~1 ulp/element
+    #                                  per step; the default mode is
+    #                                  bit-for-bit (docs/performance.md)
+    sharded_param_codec: str = ""    # BYTEPS_SHARDED_PARAM_CODEC:
+    #                                  optional codec for the parameter
+    #                                  all-gather leg under sharded
+    #                                  update, e.g. "onebit" or
+    #                                  "randomk:64" ("" = full precision;
+    #                                  "auto" = planner picks per size
+    #                                  bucket). Gated by the same
+    #                                  compress_error_ceiling quality
+    #                                  gate as the gradient ladder
 
     # --- compression ---
     min_compress_bytes: int = 65536  # BYTEPS_MIN_COMPRESS_BYTES
@@ -736,6 +771,26 @@ class Config:
             self.credit_pinned = self.scheduling_credit != 0
         if self.buffer_min_bytes < 0:
             raise ValueError("buffer_min_bytes must be >= 0")
+        if self.sharded_param_codec not in ("", "auto"):
+            # "name" or "name:k" — structural check here; the codec name
+            # and parameter are validated against the registry at declare
+            # time (core/sharded_update.py), where the quality gate runs.
+            parts = self.sharded_param_codec.split(":")
+            if (len(parts) > 2 or not parts[0]
+                    or any(ch.isspace() for ch in self.sharded_param_codec)):
+                raise ValueError(
+                    "sharded_param_codec must be '', 'auto', 'name' or "
+                    f"'name:param', got {self.sharded_param_codec!r}")
+        if self.sharded_param_codec and not self.sharded_update:
+            raise ValueError(
+                "sharded_param_codec requires sharded_update "
+                "(BYTEPS_SHARDED_UPDATE=1) — the parameter all-gather "
+                "leg only exists in sharded-update mode")
+        if self.sharded_update_fused and not self.sharded_update:
+            raise ValueError(
+                "sharded_update_fused requires sharded_update "
+                "(BYTEPS_SHARDED_UPDATE=1) — there is no update program "
+                "to fuse outside sharded-update mode")
         # Round partition bound up to alignment so chunk boundaries stay tiled.
         r = self.partition_bytes % ALIGN_BYTES
         if r and self.partition_bytes < 2**31 - ALIGN_BYTES:
@@ -937,6 +992,10 @@ class Config:
             autotune=_env_bool("BYTEPS_AUTOTUNE", True),
             buffer_min_bytes=_env_int("BYTEPS_BUFFER_MIN_BYTES", 1 << 20),
             deferred_gather=_env_bool("BYTEPS_DEFERRED_GATHER", True),
+            sharded_update=_env_bool("BYTEPS_SHARDED_UPDATE", False),
+            sharded_update_fused=_env_bool("BYTEPS_SHARDED_UPDATE_FUSED",
+                                           False),
+            sharded_param_codec=_env_str("BYTEPS_SHARDED_PARAM_CODEC", ""),
             # presence of the env var IS the pin, whatever its value —
             # a launch script exporting the reference default must still
             # get exactly that value

@@ -394,6 +394,35 @@ class ChunkPlanner:
                       key=lambda k: len(st["samples"].get(k, ())))
             return next(kw for k, kw, _ in st["cands"] if k == key)
 
+    def plan_param_codec(self, nbytes: int):
+        """Pull-leg codec kwargs for a sharded-update tensor of
+        ``nbytes`` under ``BYTEPS_SHARDED_PARAM_CODEC=auto`` (ISSUE 20),
+        or ``None`` for full precision.
+
+        Unlike :meth:`plan_compression` this is DETERMINISTIC — no
+        wall-time race.  The parameter leg's codec changes the values
+        every replica integrates, so the choice must be a pure function
+        of tensor size and the quality gate, reproducible across runs
+        and across an elastic restart (a timing-raced choice could hand
+        the same tensor different codecs on two boots of the same job).
+        Per size bucket: candidates are the ceiling-filtered ladder
+        (:meth:`_compress_candidates`); tensors under 4 MiB take the
+        LOWEST-golden-error quantized rung (quality-first — small
+        tensors' wire is cheap), larger ones take onebit when it clears
+        the gate (the 32x rung: wire dominates) and otherwise fall back
+        to the lowest-error rung."""
+        if nbytes < max(1, self._min_compress):
+            return None
+        cands = [(k, kw, err) for k, kw, err in self._compress_candidates()
+                 if kw is not None]
+        if not cands:
+            return None
+        if nbytes >= (4 << 20):
+            for k, kw, _ in cands:
+                if k == "onebit":
+                    return kw
+        return min(cands, key=lambda c: c[2])[1]
+
     def observe_compression(self, nbytes: int, codec: str, seconds: float,
                             compiled: bool = False) -> None:
         """Record one completed push of a ladder-tuned tensor under

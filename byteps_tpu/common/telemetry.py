@@ -191,6 +191,11 @@ class StepStats:
     # the tensor whose unit retired LAST in this step — the chain the
     # step's completion actually waited on
     lagging_tensor: Optional[str] = None
+    # ISSUE 20: push+pull wire bytes this step actually shipped (per-leg
+    # accounting from the syncer: compressed chunks at payload size,
+    # sharded-update pulls at the owner-slice/codec-payload size) — the
+    # figure the sharded-vs-unsharded bench ratio is computed from
+    wire_bytes_per_step: int = 0
 
     def as_dict(self) -> Dict[str, float]:
         return dataclasses.asdict(self)
@@ -218,6 +223,7 @@ class StepStatsTracker:
         self._bytes = 0
         self._pushes = 0
         self._stall_ms = 0.0
+        self._wire = 0
         self._retx0 = counters.get("integrity.retransmit")
         self._history: Deque[StepStats] = collections.deque(maxlen=history)
         # step-attribution state (ISSUE 12): baseline of the process-wide
@@ -257,6 +263,12 @@ class StepStatsTracker:
     def add_stall(self, ms: float) -> None:
         with self._lock:
             self._stall_ms += ms
+
+    def add_wire(self, nbytes: int) -> None:
+        """Syncer feed: wire bytes (push + pull legs) of each retired
+        chunk, at what the legs actually shipped."""
+        with self._lock:
+            self._wire += int(nbytes)
 
     def add_component(self, component: str, ms: float) -> None:
         """Engine-local attribution feed (e.g. ``queue`` — scheduler
@@ -307,10 +319,12 @@ class StepStatsTracker:
                 1.0 - min(1.0, self._stall_ms / wall_ms), 4),
             attrib=attrib,
             lagging_tensor=self._last_retired,
+            wire_bytes_per_step=self._wire,
         )
         self._bytes = 0
         self._pushes = 0
         self._stall_ms = 0.0
+        self._wire = 0
         self._retx0 = retx
         self._attrib0 = now_tot
         self._comp = {}
@@ -325,6 +339,7 @@ class StepStatsTracker:
         gauges.set("step.retransmits", stats.retransmits)
         gauges.set("step.wall_ms", stats.wall_ms)
         gauges.set("step.overlap_fraction", stats.overlap_fraction)
+        gauges.set("step.wire_bytes_per_step", stats.wire_bytes_per_step)
         for comp, ms in stats.attrib.items():
             # KeyError here is deliberate: a new attribution component
             # must be added to ATTRIB_GAUGE_NAMES (and the doc table) —

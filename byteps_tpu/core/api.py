@@ -29,6 +29,13 @@ _lock = threading.Lock()
 # Tensors declared before/with init, re-declared in order on resume
 # (reference global.cc:431-436 re-declares in original order on re-init).
 _declared_order: List[str] = []
+# Sharded-update slot snapshots captured by suspend() (ISSUE 20): the
+# optimizer state lives engine-side under sharded update, so an elastic
+# transition must carry it across the shutdown.  Consumed (popped) by
+# the next declare_update() for the same name, which re-pads the flat
+# shards to the NEW mesh geometry — that re-import IS the elastic
+# re-shard.
+_suspended_update_state: Dict[str, dict] = {}
 
 
 def init(config: Optional[Config] = None,
@@ -192,6 +199,10 @@ def suspend(wait: bool = True) -> None:
     global _declared_order
     eng = _require()
     _declared_order = eng.registry.names_in_declaration_order()
+    # sharded-update slots hold the ONLY copy of master/optimizer state:
+    # snapshot them at logical length so resume + declare_update re-pads
+    # onto whatever mesh comes back (fewer ranks after a shrink)
+    _suspended_update_state.update(eng.export_update_slots())
     shutdown(wait=wait)
 
 
@@ -272,6 +283,35 @@ def declare(name: str, shape=None, dtype=None, op: str = "average",
     if name not in _declared_order:
         _declared_order.append(name)
     return _declared_order.index(name)
+
+
+def declare_update(name: str, shape, dtype="float32", *, tx,
+                   init_value=None) -> int:
+    """Declare a tensor whose pull leg is the sharded weight update
+    (ISSUE 20, ``BYTEPS_SHARDED_UPDATE``): the reduce-scatter shard
+    stays on its owner, a per-shard optax ``tx`` update runs against
+    engine-resident flat-shard master/optimizer state, and push_pull
+    returns the UPDATES tensor instead of the merged gradient.  If a
+    prior :func:`suspend` stashed this name's slot, the snapshot is
+    re-imported here — re-padded to the current mesh, which is how an
+    elastic shrink re-shards optimizer state.  Requires a running
+    engine (the slot is device state); returns the declared key."""
+    eng = _require()
+    restore = _suspended_update_state.pop(name, None)
+    return eng.declare_update(name, shape, dtype, tx=tx,
+                              init_value=init_value,
+                              restore=restore).declared_key
+
+
+def push_pull_update(x, name: str, **kwargs) -> Any:
+    """Synchronous sharded-update step for one declared tensor: push
+    this process's gradient, receive the owner-computed optax updates
+    (``optax.apply_updates(params, ...)`` applies them)."""
+    return _require().push_pull_update(x, name, **kwargs)
+
+
+def push_pull_update_async(x, name: str, **kwargs) -> Handle:
+    return _require().push_pull_update_async(x, name, **kwargs)
 
 
 def push_pull(stacked, name: str, op: str = "average",

@@ -52,6 +52,7 @@ from ..common.telemetry import (SpeedMonitor, StepStatsTracker, attribution,
 from ..common.types import ChunkTask, Status, StatusCode, TensorContext
 from ..fault import injector as _fault
 from ..fault import membership as _membership
+from .sharded_update import ShardedUpdateSlot
 
 
 _SHUTDOWN = object()  # sync-queue sentinel
@@ -180,7 +181,7 @@ class _PendingTensor:
 
     def __init__(self, handle: Handle, ctx: TensorContext, out_shape, op: str,
                  denom: int, use_buffer: bool = False, comm=None,
-                 scale=None, shard_out: bool = False):
+                 scale=None, shard_out: bool = False, slot=None):
         self.handle = handle
         self.ctx = ctx
         self.out_shape = out_shape
@@ -193,6 +194,10 @@ class _PendingTensor:
         self.comm = comm
         self.scale = scale       # fused scale, applied by assemble
         self.shard_out = shard_out  # deferred-gather assembly
+        # sharded-update slot (ISSUE 20): assembly routes through the
+        # owner-resident optimizer instead of emitting the merged
+        # gradient — the handle resolves to the optax UPDATES tensor
+        self.slot = slot
         self.local_mode = False  # staging mode (False | True | "sharded")
         # chunk bounds snapshot: the planner can repartition the ctx for a
         # LATER push while this one is in flight-free... bounds are only
@@ -225,6 +230,14 @@ class _PendingTensor:
     def assemble(self):
         if self.use_buffer:
             _, C = self.scatter_layout_snap
+            if self.slot is not None:
+                # the accumulator IS the owner-resident gradient shard:
+                # commit the fused optimizer update in place of the
+                # gradient assembly (runs on the same syncer thread, so
+                # retirement order == dispatch order)
+                return self.slot.apply_buffer(
+                    self.buf, scale=self.scale, denom=self.denom,
+                    shard_out=self.shard_out)
             return assemble_scatter(
                 self.comm, self.buf, self.ctx.num_elems, C, self.out_shape,
                 self.ctx.dtype_name, scale=self.scale, denom=self.denom,
@@ -246,6 +259,11 @@ class _PendingTensor:
         # before any downcast); restore the declared dtype here
         if out.dtype != np.dtype(self.ctx.dtype_name):
             out = out.astype(self.ctx.dtype_name)
+        if self.slot is not None:
+            # parts fallback under sharded update: the merged gradient
+            # was materialized anyway, so only the numerics route
+            # through the slot (wire accounting stays at full size)
+            return self.slot.apply_full(out)
         return out
 
 
@@ -257,6 +275,9 @@ class PushPullEngine:
         self.cfg = cfg
         self.registry = TensorRegistry()
         self.handles = HandleManager()
+        # per-tensor owner-resident optimizer slots (ISSUE 20 sharded
+        # weight update); populated by declare_update
+        self.update_slots: Dict[str, ShardedUpdateSlot] = {}
         self.scheduler = self._make_scheduler(cfg)
         self.speed = SpeedMonitor()
         # ONE tracer per process (common/tracing.py): the engine, the
@@ -343,6 +364,7 @@ class PushPullEngine:
                         out_shape: Optional[tuple] = None,
                         local: bool = False,
                         replicate_out: bool = False,
+                        update_slot=None,
                         ) -> Handle:
         """Enqueue a rank-stacked tensor [R, ...] for reduction.
 
@@ -391,6 +413,12 @@ class PushPullEngine:
                     f"{self.comm.num_ranks}")
             if out_shape is None:
                 out_shape = stacked.shape[1:]
+        if update_slot is not None and compression:
+            raise ValueError(
+                "sharded update does not take gradient compression "
+                "kwargs: the gradient never leaves its owner, so there "
+                "is nothing to compress on the pull leg except the "
+                "parameter all-gather — use BYTEPS_SHARDED_PARAM_CODEC")
         if compression:
             # Declare/enqueue-time validation (ISSUE 11 satellite): a
             # typo'd codec name or decorator value fails HERE in the
@@ -542,7 +570,7 @@ class PushPullEngine:
                          and assemble_shardable(self.comm, out_shape))
             pending = _PendingTensor(handle, ctx, out_shape, op, denom,
                                      use_buffer, comm=self.comm, scale=scale,
-                                     shard_out=shard_out)
+                                     shard_out=shard_out, slot=update_slot)
             if self.tracer.active:
                 # windowed AND/OR sampled capture decided here; tctx is
                 # None for pushes that record nothing
@@ -876,6 +904,103 @@ class PushPullEngine:
             get_logger().debug("AOT warm failed for %s; programs compile "
                                "lazily", name, exc_info=True)
         return ctx
+
+    def declare_update(self, name: str, shape, dtype=np.float32, *,
+                       tx, init_value=None,
+                       restore=None) -> TensorContext:
+        """Declare a tensor whose pull leg is the fused sharded weight
+        update (ISSUE 20): registers geometry like declare_tensor, then
+        builds the owner-resident slot — flat f32 master (seeded from
+        ``init_value``, the caller's initial parameters), flat-shard
+        optimizer state for ``tx`` — and AOT-warms the fused update
+        program alongside the chunk programs, so the first
+        push_pull_update dispatches compiled executables only.
+
+        ``restore``: a ShardedUpdateSlot.export() snapshot (elastic
+        resume); re-padded to THIS mesh's shard geometry, which is how
+        an elastic shrink re-shards optimizer state.
+        """
+        if not self.cfg.sharded_update:
+            raise ValueError(
+                "declare_update requires sharded-update mode: set "
+                "BYTEPS_SHARDED_UPDATE=1 or Config(sharded_update=True)")
+        if jax.process_count() > 1:
+            raise ValueError(
+                "sharded update is single-controller only for now: the "
+                "owner-resident master/optimizer state is device_put "
+                "over the whole mesh, which a multi-process SPMD "
+                "controller cannot address")
+        np_dtype = np.dtype(dtype)
+        if not jnp.issubdtype(np_dtype, jnp.inexact):
+            raise ValueError(
+                f"sharded update needs a float tensor (the optimizer "
+                f"runs on the shard), got dtype {np_dtype}")
+        ctx = self.declare_tensor(name, shape, np_dtype, op="average",
+                                  local=True)
+        with ctx.lock:
+            # pin the gradient-compressor ladder OFF for this tensor:
+            # compressed chunks ride parts mode, which would defeat the
+            # owner-resident shard (and the pull-leg codec is a
+            # different knob — sharded_param_codec)
+            ctx.compression_tuned = False
+        slot = ShardedUpdateSlot(
+            self.comm, self.cfg, name, shape, np_dtype, tx,
+            planner=self.planner, init_value=init_value, restore=restore)
+        self.update_slots[name] = slot
+        try:
+            # mirror _aot_warm's denominator model for the local push
+            # this slot's pushes will dispatch: float + denom=R rides
+            # the fused-scale fast path (scaled=True)
+            buffered = (self._buffer_eligible(ctx)
+                        and ctx.scatter_layout not in (None, "ineligible"))
+            # buffer mode applies the fused 1/R scale inside the update
+            # program; parts fallback receives the already-averaged
+            # merged gradient (apply_full), so no scale arg there
+            n = slot.warm(buffered=buffered, scaled=buffered, denom=1)
+            if n:
+                get_logger().debug(
+                    "AOT-compiled sharded-update program for %s", name)
+        except Exception:  # noqa: BLE001 — warm is an optimization only
+            counters.inc("engine.aot_compile_failed")
+            get_logger().debug(
+                "sharded-update AOT warm failed for %s; the program "
+                "compiles lazily", name, exc_info=True)
+        return ctx
+
+    def push_pull_update_async(self, x, name: str, *,
+                               stacked: bool = False, **kw) -> Handle:
+        """Contribute this process's gradient for ``name`` and receive
+        the OWNER-COMPUTED optax updates tensor (block-sharded under
+        deferred gather): ``optax.apply_updates(params, h.wait())`` is
+        the sharded-update step.  Requires a prior declare_update.
+
+        ``stacked=True``: ``x`` carries a leading rank axis (the
+        DistributedOptimizer data model) and rides the stacked chunk
+        collectives — the same programs the unsharded adapter path
+        dispatches, so the merged gradient the slot integrates is
+        bitwise the one the unsharded caller would have received."""
+        slot = self.update_slots.get(name)
+        if slot is None:
+            raise ValueError(
+                f"{name!r} has no sharded-update slot: call "
+                f"declare_update(name, shape, dtype, tx=...) first")
+        kw.setdefault("op", "average")
+        if stacked:
+            return self.push_pull_async(x, name, update_slot=slot, **kw)
+        return self.push_pull_local_async(x, name, update_slot=slot, **kw)
+
+    def push_pull_update(self, x, name: str, **kw):
+        h = self.push_pull_update_async(x, name, **kw)
+        out = h.wait()
+        self.handles.release(h.id)
+        return out
+
+    def export_update_slots(self) -> Dict[str, dict]:
+        """Host-side snapshots of every sharded-update slot (elastic
+        suspend): logical-length state, re-importable on any world size
+        via declare_update(restore=...)."""
+        return {name: slot.export()
+                for name, slot in self.update_slots.items()}
 
     def _aot_warm(self, ctx: TensorContext, np_dtype, *, op: str,
                   local: bool, replicate_out: bool = False) -> int:
@@ -1444,10 +1569,28 @@ class PushPullEngine:
                                          t_done)
             if self.cfg.telemetry_on:
                 # push + pull wire bytes; compressed chunks report
-                # payload size, which is the point of the feature
+                # payload size, which is the point of the feature.
+                # Under a sharded-update slot the pull leg ships only
+                # the owner's slice (or the parameter-codec payload) —
+                # the halved-wire claim, measured per leg so /metrics
+                # and bps_top can assert it (wire_bytes{leg=}: labeled
+                # series beside the KV store's unlabeled total, which
+                # stays the async-PS figure)
                 wire = (task.compression.worker.payload_nbytes()
                         if task.compression is not None else task.nbytes)
-                self.speed.record(wire * 2)
+                p = task.pending
+                slot = p.slot if p is not None else None
+                pull = (slot.pull_share(task.nbytes, p.use_buffer)
+                        if slot is not None else wire)
+                self.speed.record(wire + pull)
+                counters.inc("wire_bytes", wire, leg="push")
+                counters.inc("wire_bytes", pull, leg="pull")
+                self.step_stats.add_wire(wire + pull)
+                if (slot is not None and slot.codec is not None
+                        and err_t is None and p.use_buffer):
+                    # quantized parameter leg: reported separately from
+                    # the gradient ladder's compression.wire_bytes
+                    counters.inc("compression.param_wire_bytes", pull)
                 if task.compression is not None and err_t is None:
                     # quantized-wire accounting (ISSUE 11): what the
                     # reduce leg actually shipped, and the raw bytes it

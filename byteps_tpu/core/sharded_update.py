@@ -1,0 +1,579 @@
+"""Sharded weight update fused into the push_pull pipeline (ISSUE 20).
+
+"Automatic Cross-Replica Sharding of Weight Update" (PAPERS.md) shows
+the merged gradient never needs to leave its reduce-scatter owner: run
+the optimizer on the shard only and all-gather *parameters* once per
+step.  Under ``Config.sharded_update`` (BYTEPS_SHARDED_UPDATE) the
+engine's pull leg returns the owner-updated parameter *update* instead
+of the merged gradient:
+
+- the reduce-scatter accumulator (``[n_ici, C]``, ``P(ici)`` — the
+  buffer-mode hot path's existing layout) IS the owner-resident
+  gradient shard; nothing is re-sharded,
+- a per-shard optax update runs against a flat f32 master vector and
+  flat-shard optimizer state laid out by ``comm/shard_math.py`` — the
+  SAME geometry rules as ``parallel/zero.py``, so the two paths are one
+  machinery (the ISSUE 20 unification),
+- the emit reuses the deferred-gather block-sharded assembly: the
+  updates stay sharded ``P((dcn, ici))`` and XLA materializes the
+  parameter all-gather only where a consumer needs replicated values.
+
+Wire accounting (docs/performance.md): the unsharded steady state
+ships the gradient twice per tensor — push N (reduce-scatter) + pull N
+(the merged gradient is returned replicated, every replica then runs
+the same optimizer redundantly).  Sharded update ships push N + pull
+N/R: only the owner's slice leaves the owner, because the consumer of
+the updated parameters is sharded too (the master stays resident; a
+serving cut reads per-owner slices).  At R=8 that is 0.5625x.
+
+The optional quantized parameter leg (``Config.sharded_param_codec``)
+applies a PR-10 registry codec to the emitted update vector — the same
+EQuARX-style trade as the gradient ladder, gated by the same
+``compress_error_ceiling`` golden-error gate, with the ChunkPlanner's
+compressor dimension choosing the codec per size bucket under
+``"auto"``.  The master is advanced by the SAME dequantized update
+that is emitted, so master and replicas cannot drift; the codec's
+error-feedback state rides the slot like the gradient ladder's rides
+the chunk.
+
+Like PR 5's chunk programs, every update program is declared/AOT-warmed
+at ``declare_update`` time: the programs take FLAT optimizer-state
+leaves as separate positional arguments (``aot_compile``'s signature
+guard compares per-argument shape/dtype), so the first push dispatches
+compiled executables.
+
+Two dispatch modes, because XLA:CPU contracts ``mul+add`` chains into
+FMAs inside a fusion regardless of ``optimization_barrier`` (the
+OptimizationBarrierExpander strips barriers before fusion) or
+``xla_cpu_enable_fast_math=false`` — a single fused update program can
+NOT reproduce the unsharded caller's eager op-by-op optax rounding
+bit-for-bit.  So:
+
+- default ("exact"): AOT-warmed jit programs handle the layout legs
+  only (buffer -> flat f32 gradient with the fused scale; update
+  vector -> emit dtype/shape/sharding), and the optax transform itself
+  runs EAGERLY on the shard-resident arrays — every primitive
+  dispatches exactly as the unsharded caller's eager ``tx.update``,
+  and elementwise ops preserve the ``P(ici)`` sharding, so state stays
+  owner-resident and the trajectory is bitwise identical,
+- ``Config.sharded_update_fused`` (BYTEPS_SHARDED_UPDATE_FUSED): one
+  fused program per dispatch variant — single dispatch per tensor per
+  step, at the cost of ulp-level FMA-contraction drift from the
+  unsharded trajectory (~1e-9 relative on Adam; documented in
+  docs/performance.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.collectives import (_cached, _cached_scalar, _struct,
+                                aot_compile, assemble_shardable)
+from ..comm.mesh import CommContext, DCN_AXIS, ICI_AXIS
+from ..comm.shard_math import init_sharded_opt_state
+from ..compression import registry as _creg
+from ..common.config import Config
+from ..common.telemetry import counters
+
+__all__ = ["ShardedUpdateSlot", "parse_codec_spec", "resolve_param_codec"]
+
+# "name:param" -> the registry kwarg the parameter maps to; everything
+# rides the same error-feedback decorator the gradient ladder uses
+# (compression/registry.py COMPRESS_LADDER)
+_PARAM_KEY = {"topk": "k", "randomk": "k", "powersgd": "rank",
+              "dithering": "s"}
+
+
+def parse_codec_spec(spec: str) -> Optional[Dict[str, str]]:
+    """``"onebit"`` / ``"randomk:0.25"`` -> registry kwargs, '' -> None.
+
+    ``"auto"`` is NOT handled here — resolve_param_codec routes it to
+    the planner's compressor dimension.
+    """
+    if not spec:
+        return None
+    name, _, param = spec.partition(":")
+    kwargs = {"compressor": name, "ef": "vanilla"}
+    if param:
+        kwargs[_PARAM_KEY.get(name, "k")] = param
+    return kwargs
+
+
+def resolve_param_codec(cfg: Config, planner, nbytes: int
+                        ) -> Optional[Dict[str, str]]:
+    """The pull-leg codec for one declared tensor, or None (full
+    precision).  Explicit specs pass the SAME golden-error quality gate
+    as the gradient ladder — a codec whose cumulative golden error
+    exceeds ``compress_error_ceiling`` fails at declare, in the
+    caller's stack; ``"auto"`` delegates to the planner's per-bucket
+    compressor dimension (already ceiling-filtered)."""
+    spec = cfg.sharded_param_codec
+    if not spec or nbytes < cfg.min_compress_bytes:
+        return None
+    if spec == "auto":
+        return planner.plan_param_codec(nbytes) if planner is not None \
+            else None
+    kwargs = parse_codec_spec(spec)
+    _creg.validate_kwargs(kwargs)
+    err = _creg.golden_error(kwargs)
+    if err > cfg.compress_error_ceiling:
+        raise ValueError(
+            f"sharded_param_codec {spec!r} fails the quality gate: "
+            f"golden error {err:.3f} > compress_error_ceiling "
+            f"{cfg.compress_error_ceiling} (BYTEPS_COMPRESS_ERROR_"
+            f"CEILING) — pick a gentler codec or raise the ceiling")
+    return kwargs
+
+
+class ShardedUpdateSlot:
+    """Owner-resident optimizer state for ONE declared tensor.
+
+    Geometry mirrors the buffer-mode accumulator: ``C = ceil(n /
+    n_ici)`` (scatter_layout's column width — independent of chunk
+    bounds, so planner repartitions never invalidate the slot) and
+    ``n_pad = C * n_ici``.  The flat f32 ``master`` and every
+    padded-length optimizer-state leaf are sharded ``P(ici)`` — exactly
+    the rows the chunk programs' reduce-scatter leaves on each device
+    (DCN-replicated after the cross-slice psum), i.e. zero.py's "ici"
+    (HSDP) layout.  The pad region carries zero gradients forever, so
+    elementwise transforms keep its master/moment entries at exactly
+    0.0 and the unsharded trajectory is reproduced bit-for-bit
+    (tests/test_sharded_update.py).
+    """
+
+    def __init__(self, comm: CommContext, cfg: Config, name: str, shape,
+                 np_dtype, tx: optax.GradientTransformation, *,
+                 planner=None, init_value=None, restore=None):
+        self.comm = comm
+        self.cfg = cfg
+        self.name = name
+        self.out_shape = tuple(shape)
+        self.dtype_name = str(np.dtype(np_dtype))
+        self.n = int(np.prod(self.out_shape)) if self.out_shape else 1
+        self.nbytes = self.n * np.dtype(np_dtype).itemsize
+        self.tx = tx
+        self.C = -(-self.n // comm.n_ici)
+        self.n_pad = self.C * comm.n_ici
+        self.axes = (ICI_AXIS,)
+        self._sh = NamedSharding(comm.mesh, P(ICI_AXIS))
+        self.shard_out = (cfg.deferred_gather
+                          and assemble_shardable(comm, self.out_shape))
+        # exactly-once evidence for the chaos lane: advanced only when a
+        # completed push's update actually committed
+        self.applied = int(restore["applied"]) if restore else 0
+
+        vec = np.zeros(self.n_pad, np.float32)
+        seed = restore["master"] if restore is not None else init_value
+        if seed is not None:
+            flat = np.asarray(seed, np.float32).reshape(-1)
+            vec[: self.n] = flat[: self.n]
+        self.master = jax.device_put(vec, self._sh)
+
+        self.opt_state = init_sharded_opt_state(comm, tx, self.master,
+                                                self.n_pad, self.axes)
+        if restore is not None:
+            self.opt_state = self._restore_opt(restore)
+        self.opt_leaves, self.opt_treedef = jax.tree.flatten(self.opt_state)
+
+        # optional quantized parameter leg
+        kwargs = resolve_param_codec(cfg, planner, self.nbytes)
+        self.codec_kwargs = kwargs
+        if kwargs is not None:
+            self.codec = _creg.create(dict(kwargs), self.n, jnp.float32)
+            self.payload_nbytes = int(self.codec.payload_nbytes())
+            cstate = jax.tree.map(jnp.asarray, self.codec.init_state())
+            if restore is not None and restore.get("cstate") is not None:
+                saved = restore["cstate"]
+                leaves, cdef = jax.tree.flatten(cstate)
+                if all(tuple(l.shape) == tuple(np.shape(s))
+                       for l, s in zip(leaves, saved)):
+                    cstate = jax.tree.unflatten(
+                        cdef, [jnp.asarray(s, l.dtype)
+                               for l, s in zip(leaves, saved)])
+            self.cstate_leaves, self.cstate_treedef = jax.tree.flatten(
+                cstate)
+        else:
+            self.codec = None
+            self.payload_nbytes = 0
+            self.cstate_leaves, self.cstate_treedef = [], None
+
+    # ------------------------------------------------------------ state io
+    def _restore_opt(self, restore):
+        """Re-import exported leaves into this slot's (possibly re-padded)
+        layout: padded-length vectors are sliced/re-padded to the new
+        ``n_pad`` — the elastic-shrink re-shard — everything else
+        (counters) is copied through."""
+        leaves, treedef = jax.tree.flatten(self.opt_state)
+        out: List[Any] = []
+        for leaf, saved in zip(leaves, restore["opt"]):
+            s = np.asarray(saved)
+            if leaf.ndim == 1 and leaf.shape[0] == self.n_pad:
+                buf = np.zeros(self.n_pad, np.dtype(leaf.dtype))
+                buf[: self.n] = s.reshape(-1)[: self.n]
+                out.append(jax.device_put(buf, self._sh))
+            else:
+                out.append(jax.device_put(s.astype(np.dtype(leaf.dtype)),
+                                          leaf.sharding))
+        return jax.tree.unflatten(treedef, out)
+
+    def export(self) -> Dict[str, Any]:
+        """Host-side snapshot for elastic suspend/resume: padded-length
+        leaves are exported at LOGICAL length ``n`` (the pad is layout,
+        not state), so a resume onto a different world size re-pads for
+        its own mesh."""
+        opt = []
+        for leaf in jax.tree.leaves(self.opt_state):
+            a = np.asarray(leaf)
+            if a.ndim == 1 and a.shape[0] == self.n_pad:
+                a = a[: self.n]
+            opt.append(np.array(a, copy=True))
+        return {
+            "master": np.array(np.asarray(self.master)[: self.n],
+                               copy=True),
+            "opt": opt,
+            "cstate": ([np.array(np.asarray(l), copy=True)
+                        for l in self.cstate_leaves]
+                       if self.codec is not None else None),
+            "applied": self.applied,
+            "shape": self.out_shape,
+            "dtype": self.dtype_name,
+        }
+
+    def sync_master(self, value) -> None:
+        """Re-seed the master from externally-authoritative parameters
+        (the async-PS pull leg: the store's fresh weights absorb OTHER
+        workers' deltas the local master never saw).  Host->device copy;
+        only the async adapter's reconcile path pays it."""
+        vec = np.zeros(self.n_pad, np.float32)
+        vec[: self.n] = np.asarray(value, np.float32).reshape(-1)
+        self.master = jax.device_put(vec, self._sh)
+
+    def export_shards(self):
+        """Per-owner slices of the master for a shard-published serving
+        cut: ``[(owner_rank, lo, arr)]`` sorted by offset, each ``arr``
+        the owner's ``[lo, lo+C)`` slice trimmed to the logical length
+        and cast to the declared dtype.  Reads shard-by-shard via
+        ``addressable_shards`` — the full parameter vector is NEVER
+        materialized (ServingTier.cut() probes exactly this, so keep
+        :meth:`params` off this path).  DCN-replicated copies of the
+        same slice dedup by offset."""
+        out = []
+        seen = set()
+        for sh in self.master.addressable_shards:
+            lo = sh.index[0].start or 0
+            if lo in seen or lo >= self.n:
+                continue
+            seen.add(lo)
+            hi = min(lo + sh.data.shape[0], self.n)
+            arr = np.asarray(sh.data)[: hi - lo].astype(self.dtype_name)
+            out.append((lo // self.C, lo, arr))
+        out.sort(key=lambda t: t[1])
+        return out
+
+    def params(self) -> np.ndarray:
+        """The current master parameters, reshaped (host-side; reads the
+        logical prefix only)."""
+        return np.asarray(self.master)[: self.n].reshape(
+            self.out_shape).astype(self.dtype_name)
+
+    # ------------------------------------------------------------ wire
+    def pull_share(self, task_nbytes: int, buffered: bool) -> int:
+        """Pull-leg wire bytes attributable to one completed chunk of
+        ``task_nbytes`` push-leg bytes.  Buffer mode ships only the
+        owner's slice (1/R — the consumer stays sharded), or the codec
+        payload's share under a quantized leg; the parts fallback
+        materializes the merged gradient like the unsharded path, so
+        its pull leg saves nothing."""
+        if not buffered:
+            return task_nbytes
+        if self.codec is not None:
+            return (self.payload_nbytes * task_nbytes) // max(1, self.nbytes)
+        return task_nbytes // self.comm.num_ranks
+
+    # ------------------------------------------------------------ programs
+    def _acc(self):
+        return (jnp.dtype(jnp.float64)
+                if np.dtype(self.dtype_name) == np.float64
+                else jnp.dtype(jnp.float32))
+
+    def _emit_sharding(self, shard_out: bool):
+        if shard_out:
+            extra = [None] * (len(self.out_shape) - 1)
+            return NamedSharding(self.comm.mesh,
+                                 P((DCN_AXIS, ICI_AXIS), *extra))
+        return NamedSharding(self.comm.mesh, P())
+
+    def _program(self, *, buffered: bool, scaled: bool, denom: int,
+                 shard_out: bool):
+        """The fused update program for one dispatch variant, cached on
+        the CommContext like every other collective program.
+
+        Signature is FLAT — ``fn(grad_src, master, *opt_leaves,
+        *cstate_leaves, scale?)`` — because aot_compile's guarded fast
+        path compares per-argument shape/dtype.  The body is pure
+        elementwise math on identically-sharded flat vectors, so plain
+        jit keeps every op shard-local (no shard_map, no collectives:
+        the all-gather belongs to the CONSUMER via the block-sharded
+        emit)."""
+        L = len(self.opt_leaves)
+        Lc = len(self.cstate_leaves)
+        key = ("sharded_update", self.name, self.n, self.C,
+               self.dtype_name, self.codec_kwargs is not None,
+               buffered, scaled, denom, shard_out)
+
+        def build():
+            tx, treedef = self.tx, self.opt_treedef
+            codec, cdef = self.codec, self.cstate_treedef
+            n, n_pad = self.n, self.n_pad
+            out_shape, dtype_name = self.out_shape, self.dtype_name
+
+            def fn(src, master, *rest):
+                opt_leaves = rest[:L]
+                c_leaves = rest[L:L + Lc]
+                if buffered:
+                    g = src.reshape(-1)
+                    if scaled:
+                        g = g * rest[L + Lc]
+                    elif denom != 1:
+                        g = g / denom
+                    g = g.astype(jnp.float32)
+                else:
+                    # parts fallback: the merged, already-averaged
+                    # gradient in the declared dtype
+                    g = src.reshape(-1).astype(jnp.float32)
+                    if n != n_pad:
+                        g = jnp.pad(g, (0, n_pad - n))
+                opt_state = jax.tree.unflatten(treedef, list(opt_leaves))
+                updates, new_opt = tx.update(g, opt_state, master)
+                if codec is None:
+                    new_master = optax.apply_updates(master, updates)
+                    upd = updates[:n] if n != n_pad else updates
+                else:
+                    # quantize the EMITTED update and advance the master
+                    # by the SAME dequantized values: master == what the
+                    # replicas integrate, drift-free; EF residual rides
+                    # c_leaves exactly like the gradient ladder's state
+                    upd_raw = updates[:n] if n != n_pad else updates
+                    cstate = jax.tree.unflatten(cdef, list(c_leaves))
+                    payload, new_cstate = codec.compress(upd_raw, cstate)
+                    upd = codec.decompress(payload).astype(jnp.float32)
+                    pad_upd = (jnp.pad(upd, (0, n_pad - n))
+                               if n != n_pad else upd)
+                    new_master = master + pad_upd
+                    c_out = tuple(jax.tree.leaves(new_cstate))
+                out = upd.astype(dtype_name).reshape(out_shape)
+                outs = (out, new_master) + tuple(jax.tree.leaves(new_opt))
+                if codec is not None:
+                    outs = outs + c_out
+                return outs
+
+            opt_sh = tuple(leaf.sharding for leaf in self.opt_leaves)
+            c_sh = tuple(leaf.sharding for leaf in self.cstate_leaves)
+            out_shardings = ((self._emit_sharding(shard_out), self._sh)
+                             + opt_sh + c_sh)
+            # master/opt/cstate are consumed every step; the cached
+            # scale scalar (last arg) must NOT be donated, and CPU gets
+            # no donation at all (mirrors _assemble_program)
+            if jax.default_backend() != "cpu":
+                donate = tuple(range(2 + L + Lc))
+            else:
+                donate = ()
+            return jax.jit(fn, out_shardings=out_shardings,
+                           donate_argnums=donate)
+
+        return key, _cached(self.comm, key, build)
+
+    def _prep_program(self, *, buffered: bool, scaled: bool, denom: int):
+        """Layout leg 1 (exact mode): accumulator/merged gradient ->
+        flat f32 ``[n_pad]`` sharded ``P(ici)``.  The only arithmetic is
+        the fused scale — a lone multiply, which rounds identically to
+        the lone multiply inside the unsharded assemble program."""
+        key = ("sharded_prep", self.name, self.n, self.C,
+               self.dtype_name, buffered, scaled, denom)
+
+        def build():
+            n, n_pad = self.n, self.n_pad
+
+            def fn(src, *rest):
+                if buffered:
+                    g = src.reshape(-1)
+                    if scaled:
+                        g = g * rest[0]
+                    elif denom != 1:
+                        g = g / denom
+                    return g.astype(jnp.float32)
+                g = src.reshape(-1).astype(jnp.float32)
+                if n != n_pad:
+                    g = jnp.pad(g, (0, n_pad - n))
+                return g
+
+            donate = (0,) if (buffered
+                              and jax.default_backend() != "cpu") else ()
+            return jax.jit(fn, out_shardings=self._sh,
+                           donate_argnums=donate)
+
+        return key, _cached(self.comm, key, build)
+
+    def _emit_program(self, *, shard_out: bool):
+        """Layout leg 2 (exact mode): flat f32 update vector -> declared
+        dtype/shape under the deferred-gather block sharding.  Slice,
+        cast, reshape — no arithmetic."""
+        key = ("sharded_emit", self.name, self.n, self.dtype_name,
+               shard_out)
+
+        def build():
+            n, n_pad = self.n, self.n_pad
+            out_shape, dtype_name = self.out_shape, self.dtype_name
+
+            def fn(upd):
+                if n != n_pad:
+                    upd = upd[:n]
+                return upd.astype(dtype_name).reshape(out_shape)
+
+            donate = () if jax.default_backend() == "cpu" else (0,)
+            return jax.jit(fn, out_shardings=self._emit_sharding(shard_out),
+                           donate_argnums=donate)
+
+        return key, _cached(self.comm, key, build)
+
+    def _arg_structs(self, *, buffered: bool, scaled: bool):
+        if buffered:
+            src = _struct((self.comm.n_ici, self.C), self._acc(), self._sh)
+        else:
+            src = _struct(self.out_shape, np.dtype(self.dtype_name),
+                          NamedSharding(self.comm.mesh, P()))
+        structs = [src,
+                   _struct((self.n_pad,), jnp.float32, self._sh)]
+        structs += [_struct(l.shape, l.dtype, l.sharding)
+                    for l in self.opt_leaves]
+        structs += [_struct(l.shape, l.dtype, l.sharding)
+                    for l in self.cstate_leaves]
+        if scaled:
+            structs.append(_struct((), self._acc(),
+                                   NamedSharding(self.comm.mesh, P())))
+        return structs
+
+    def warm(self, *, buffered: bool, scaled: bool, denom: int) -> int:
+        """Declare-time AOT compile of the variant push_pull will
+        actually dispatch (engine._aot_warm's denominator model).
+        Returns the number of programs warmed."""
+        shard_out = self.shard_out if buffered else False
+        if self.cfg.sharded_update_fused:
+            key, _ = self._program(buffered=buffered, scaled=scaled,
+                                   denom=denom, shard_out=shard_out)
+            ok = aot_compile(self.comm, key,
+                             self._arg_structs(buffered=buffered,
+                                               scaled=scaled))
+            return 1 if ok else 0
+        n = 0
+        key, _ = self._prep_program(buffered=buffered, scaled=scaled,
+                                    denom=denom)
+        structs = [self._arg_structs(buffered=buffered, scaled=scaled)[0]]
+        if scaled:
+            structs.append(_struct((), self._acc(),
+                                   NamedSharding(self.comm.mesh, P())))
+        n += 1 if aot_compile(self.comm, key, structs) else 0
+        key, _ = self._emit_program(shard_out=shard_out)
+        n += 1 if aot_compile(
+            self.comm, key,
+            [_struct((self.n_pad,), jnp.float32, self._sh)]) else 0
+        # the eager optax ops compile per-(shape, dtype, sharding) into
+        # jax's global executable cache: one throwaway update on a zero
+        # gradient warms every per-op program the real step will hit
+        g0 = jax.device_put(np.zeros(self.n_pad, np.float32), self._sh)
+        updates, _ = self.tx.update(g0, self.opt_state, self.master)
+        optax.apply_updates(self.master, updates)
+        if self.codec is not None:
+            upd0 = updates[: self.n] if self.n != self.n_pad else updates
+            cstate = jax.tree.unflatten(self.cstate_treedef,
+                                        self.cstate_leaves)
+            payload, _ = self.codec.compress(upd0, cstate)
+            self.codec.decompress(payload)
+        return n
+
+    # ------------------------------------------------------------ apply
+    def _run(self, src, *, buffered: bool, scale, denom: int,
+             shard_out: bool):
+        if self.cfg.sharded_update_fused:
+            out = self._run_fused(src, buffered=buffered, scale=scale,
+                                  denom=denom, shard_out=shard_out)
+        else:
+            out = self._run_exact(src, buffered=buffered, scale=scale,
+                                  denom=denom, shard_out=shard_out)
+        self.applied += 1
+        counters.inc("engine.sharded_updates")
+        return out
+
+    def _run_exact(self, src, *, buffered: bool, scale, denom: int,
+                   shard_out: bool):
+        """Default mode: jitted layout legs around an EAGER optax step.
+        Eager per-op dispatch reproduces the unsharded caller's rounding
+        bit-for-bit (see module docstring), and elementwise ops keep
+        the ``P(ici)`` sharding, so nothing leaves its owner."""
+        scaled = scale is not None
+        _, prep = self._prep_program(buffered=buffered, scaled=scaled,
+                                     denom=denom)
+        args = [src]
+        if scaled:
+            args.append(_cached_scalar(self.comm, float(scale),
+                                       self._acc()))
+        g = prep(*args)
+        updates, new_opt = self.tx.update(g, self.opt_state, self.master)
+        if self.codec is None:
+            self.master = optax.apply_updates(self.master, updates)
+        else:
+            upd_raw = (updates[: self.n] if self.n != self.n_pad
+                       else updates)
+            cstate = jax.tree.unflatten(self.cstate_treedef,
+                                        self.cstate_leaves)
+            payload, new_cstate = self.codec.compress(upd_raw, cstate)
+            upd = self.codec.decompress(payload).astype(jnp.float32)
+            updates = (jnp.pad(upd, (0, self.n_pad - self.n))
+                       if self.n != self.n_pad else upd)
+            self.master = self.master + updates
+            self.cstate_leaves = list(jax.tree.leaves(new_cstate))
+        self.opt_state = new_opt
+        self.opt_leaves = jax.tree.leaves(new_opt)
+        _, emit = self._emit_program(shard_out=shard_out)
+        return emit(updates)
+
+    def _run_fused(self, src, *, buffered: bool, scale, denom: int,
+                   shard_out: bool):
+        scaled = scale is not None
+        _, fn = self._program(buffered=buffered, scaled=scaled,
+                              denom=denom, shard_out=shard_out)
+        args = [src, self.master, *self.opt_leaves, *self.cstate_leaves]
+        if scaled:
+            args.append(_cached_scalar(self.comm, float(scale),
+                                       self._acc()))
+        outs = fn(*args)
+        L = len(self.opt_leaves)
+        self.master = outs[1]
+        self.opt_leaves = list(outs[2:2 + L])
+        self.opt_state = jax.tree.unflatten(self.opt_treedef,
+                                            self.opt_leaves)
+        if self.codec is not None:
+            self.cstate_leaves = list(outs[2 + L:])
+        return outs[0]
+
+    def apply_buffer(self, buf, *, scale, denom: int, shard_out: bool):
+        """Commit one completed buffer-mode push: the accumulator IS the
+        owner-resident gradient shard.  Runs on the single syncer
+        thread (retirement order == dispatch order), like assembly."""
+        return self._run(buf, buffered=True, scale=scale, denom=denom,
+                         shard_out=shard_out)
+
+    def apply_full(self, merged):
+        """Parts-mode fallback (debug sampling, layouts the column view
+        cannot express): the merged gradient was fully assembled, so
+        the pull leg saved nothing — numerics identical, wire unchanged
+        (pull_share accounts it at full size)."""
+        return self._run(merged, buffered=False, scale=None, denom=1,
+                         shard_out=False)

@@ -137,12 +137,24 @@ class DistributedOptimizer:
     ``backward_passes_per_step`` gradient accumulation: micro-steps
     accumulate locally and only the boundary step communicates
     (reference torch/__init__.py:110-156).
+
+    With ``sharded_update=True`` (default: follow
+    ``Config.sharded_update``) the optax state moves INTO the engine
+    (ISSUE 20): ``init(params)`` declares one sharded-update slot per
+    leaf — flat-shard master/optimizer state resident on the
+    reduce-scatter owners, AOT-warmed at declare time — and ``update``
+    pushes gradients through the same stacked chunk collectives but
+    receives the owner-computed optax UPDATES back (pull leg N/R
+    instead of N).  The returned ``(updates, state)`` contract is
+    unchanged, and the trajectory is bit-for-bit the unsharded one
+    (tests/test_sharded_update.py).
     """
 
     def __init__(self, tx: optax.GradientTransformation,
                  name_prefix: str = "grad",
                  op: str = "average",
-                 backward_passes_per_step: int = 1):
+                 backward_passes_per_step: int = 1,
+                 sharded_update: Optional[bool] = None):
         if backward_passes_per_step < 1:
             raise ValueError("backward_passes_per_step must be >= 1")
         self._tx = tx
@@ -152,8 +164,41 @@ class DistributedOptimizer:
         self._accum = None
         self._micro = 0
         self._lock = threading.Lock()
+        self._sharded = sharded_update
+        self._leaf_meta = None      # [(name, shape, dtype)] once declared
+        self._declared_engine = None
+
+    def _sharded_on(self) -> bool:
+        if self._sharded is not None:
+            return self._sharded
+        from ..common.config import get_config
+        return get_config().sharded_update
+
+    def _declare_sharded(self, params):
+        """Declare one engine slot per leaf.  Re-runs after an elastic
+        transition (the engine instance changed): api.declare_update
+        consumes the suspend() stash, re-padding each flat shard to the
+        new mesh — optimizer state survives the shrink."""
+        names = _leaf_names(params, self._prefix)
+        leaves = jax.tree_util.tree_leaves(params)
+        self._leaf_meta = []
+        for name, leaf in zip(names, leaves):
+            arr = np.asarray(leaf)
+            if self._op != "average":
+                raise ValueError(
+                    "sharded_update supports op='average' only (the "
+                    "fused 1/R scale is baked into the update program)")
+            _api.declare_update(name, arr.shape, arr.dtype, tx=self._tx,
+                                init_value=arr)
+            self._leaf_meta.append((name, arr.shape, arr.dtype))
+        self._declared_engine = _api._engine
 
     def init(self, params):
+        if self._sharded_on():
+            self._declare_sharded(params)
+            # the real state lives in the engine slots; the caller-side
+            # state object is a placeholder threaded through update()
+            return optax.EmptyState()
         return self._tx.init(params)
 
     def update(self, grads, state, params=None):
@@ -177,6 +222,31 @@ class DistributedOptimizer:
                     grads = jax.tree.map(lambda g: g / self._bpps, grads)
                 self._accum = None
                 self._micro = 0
+        if self._sharded_on():
+            if self._leaf_meta is None:
+                raise RuntimeError(
+                    "DistributedOptimizer(sharded_update=True).init("
+                    "params) must run before update(): it declares the "
+                    "engine-resident optimizer slots")
+            if self._declared_engine is not _api._engine:
+                # elastic transition: a new engine has no slots yet;
+                # re-declare from the suspend() stash (params= reseeds
+                # the master only when no stash exists)
+                if params is None:
+                    raise RuntimeError(
+                        "sharded_update re-declare after an elastic "
+                        "transition needs params= (slot geometry)")
+                self._declare_sharded(params)
+            eng = _api._require()
+            treedef = jax.tree_util.tree_structure(grads)
+            leaves = jax.tree_util.tree_leaves(grads)
+            handles = [eng.push_pull_update_async(leaf, name, stacked=True)
+                       for (name, _, _), leaf in zip(self._leaf_meta,
+                                                     leaves)]
+            outs = [h.wait() for h in handles]
+            for h in handles:
+                eng.handles.release(h.id)
+            return jax.tree_util.tree_unflatten(treedef, outs), state
         reduced = push_pull(grads, self._prefix, op=self._op)
         return self._tx.update(reduced, state, params)
 

@@ -50,7 +50,8 @@ class AsyncDistributedOptimizer:
                  store: Optional[KVStore] = None,
                  name_prefix: str = "async",
                  compression: Optional[dict] = None,
-                 worker_id: Optional[int] = None):
+                 worker_id: Optional[int] = None,
+                 sharded_update: Optional[bool] = None):
         """``compression``: the engine's kwargs dict (compressor/ef/...)
         — weight deltas then cross the worker->store boundary as
         wire-encoded compressed payloads (the reference's async +
@@ -61,7 +62,17 @@ class AsyncDistributedOptimizer:
         per-leaf monotonic sequence counter make every push idempotent:
         a retry after a lost ack (chaos ``drop:site=kv_push`` →
         :class:`integrity.AckLost`) is deduplicated by the store and can
-        never double-sum a delta."""
+        never double-sum a delta.
+
+        ``sharded_update`` (default: follow ``Config.sharded_update``):
+        the local optimizer step runs on engine-resident flat-shard
+        master/optimizer state (ISSUE 20 — the same ShardedUpdateSlot
+        machinery the engine mode and zero.py share) instead of a
+        caller-side optax state tree.  The async protocol is unchanged
+        (local update -> push delta -> pull fresh; NO gradient
+        collective, so the trajectory stays bitwise the unsharded async
+        one), but optimizer memory drops to 1/R per device and the
+        update programs are AOT-warmed at ``init(params)``."""
         self._tx = tx
         self._store = store if store is not None else KVStore()
         self._prefix = name_prefix
@@ -71,6 +82,15 @@ class AsyncDistributedOptimizer:
         self._worker_id = worker_id
         self._seqs = {}         # name -> last sequence token issued
         self._ack_retry = None  # built at init() (config is live there)
+        self._sharded = sharded_update
+        self._leaf_meta = None  # [(name, shape, dtype)] once declared
+        self._declared_engine = None
+
+    def _sharded_on(self) -> bool:
+        if self._sharded is not None:
+            return self._sharded
+        from ..common.config import get_config
+        return get_config().sharded_update
 
     @property
     def store(self) -> KVStore:
@@ -83,8 +103,16 @@ class AsyncDistributedOptimizer:
     def init(self, params):
         """Registers every parameter leaf with the store (the init-push
         barrier of the reference, server.cc:261-289) and returns optax
-        state."""
+        state.
+
+        Each leaf is also declared through the engine's ``declare()``
+        geometry path when an engine is running — previously only the
+        torch/DDP adapters declared at wrap time, so the async path's
+        first step paid every program compile; now the AOT warm runs
+        here, and sharded mode builds its engine-resident slots here
+        too."""
         from ..common.config import get_config
+        from ..core import api as _api
         cfg = get_config()
         if self._worker_id is None:
             self._worker_id = _default_sender_id(cfg.host_id)
@@ -92,10 +120,28 @@ class AsyncDistributedOptimizer:
             cfg, retry_on=(_integrity.AckLost,), base_delay_s=0.0,
             max_delay_s=0.0)
         self._names = self._leaf_names(params)
+        sharded = self._sharded_on()
+        if sharded:
+            self._leaf_meta = []
         for name, leaf in zip(self._names,
                               jax.tree_util.tree_leaves(params)):
             arr = np.asarray(leaf)
             self._store.init_key(name, arr)
+            if sharded:
+                if self._compression is not None:
+                    raise ValueError(
+                        "sharded_update + delta compression is not "
+                        "supported on the async path: the delta is the "
+                        "owner-computed update, use "
+                        "BYTEPS_SHARDED_PARAM_CODEC for its wire form")
+                _api.declare_update(name, arr.shape, arr.dtype,
+                                    tx=self._tx, init_value=arr)
+                self._leaf_meta.append((name, arr.shape, arr.dtype))
+            elif _api.initialized():
+                # reuse declare() geometry: registered shape/dtype give
+                # the name a stable key AND an AOT-compiled program set
+                # before the first push (PushPullEngine.declare_tensor)
+                _api.declare(name, arr.shape, arr.dtype)
             if self._compression is not None:
                 from ..compression import registry as reg
                 wc = reg.create(self._compression, arr.size, arr.dtype)
@@ -104,6 +150,9 @@ class AsyncDistributedOptimizer:
                 # truth; diverging worker kwargs fail loudly there)
                 self._store.register_compression(
                     name, self._compression, arr.size, arr.dtype)
+        if sharded:
+            self._declared_engine = _api._engine
+            return optax.EmptyState()
         return self._tx.init(params)
 
     def update_and_sync(self, grads, state, params) -> Tuple:
@@ -118,7 +167,10 @@ class AsyncDistributedOptimizer:
                 "AsyncDistributedOptimizer.init(params) must be called "
                 "before update_and_sync — it registers the parameter keys "
                 "with the store (the reference's init-push barrier)")
-        updates, state = self._tx.update(grads, state, params)
+        if self._sharded_on():
+            updates = self._sharded_updates(grads, params)
+        else:
+            updates, state = self._tx.update(grads, state, params)
         new_params = optax.apply_updates(params, updates)
         leaves_old = jax.tree_util.tree_leaves(params)
         leaves_new = jax.tree_util.tree_leaves(new_params)
@@ -160,5 +212,37 @@ class AsyncDistributedOptimizer:
                 get_logger().warning(
                     "async push %s: ack lost on every attempt; delta "
                     "landed exactly once (seq dedup)", name)
-            fresh.append(jnp.asarray(self._store.pull(name)))
+            pulled = self._store.pull(name)
+            if self._sharded_on() and not np.array_equal(
+                    pulled, np.asarray(new)):
+                # another worker's delta landed: the engine-side master
+                # must match what the store serves, or a params-dependent
+                # transform (weight decay) would integrate stale weights
+                self._engine_slot(name).sync_master(pulled)
+            fresh.append(jnp.asarray(pulled))
         return jax.tree_util.tree_unflatten(treedef, fresh), state
+
+    # ------------------------------------------------------ sharded mode
+    def _engine_slot(self, name):
+        from ..core import api as _api
+        return _api._require().update_slots[name]
+
+    def _sharded_updates(self, grads, params):
+        """The local optimizer step on engine-resident shard state: the
+        gradient goes straight to the slot (apply_full — the async mode
+        has NO gradient collective, so nothing is pushed or averaged
+        here) and the owner-computed updates come back.  After an
+        elastic transition the slots are re-declared from the suspend()
+        stash, re-padded to the new mesh."""
+        from ..core import api as _api
+        if self._declared_engine is not _api._engine:
+            for (name, shape, dtype), leaf in zip(
+                    self._leaf_meta, jax.tree_util.tree_leaves(params)):
+                _api.declare_update(name, shape, dtype, tx=self._tx,
+                                    init_value=np.asarray(leaf))
+            self._declared_engine = _api._engine
+        leaves = jax.tree_util.tree_leaves(grads)
+        treedef = jax.tree_util.tree_structure(grads)
+        outs = [self._engine_slot(name).apply_full(np.asarray(g))
+                for (name, _, _), g in zip(self._leaf_meta, leaves)]
+        return jax.tree_util.tree_unflatten(treedef, outs)

@@ -63,7 +63,9 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..comm.mesh import CommContext, DCN_AXIS, ICI_AXIS
+from ..comm.mesh import CommContext, DCN_AXIS, ICI_AXIS  # noqa: F401
+from ..comm.shard_math import (init_sharded_opt_state, padded_size,
+                               resolve_axes, spec_of_opt)
 
 __all__ = [
     "ZeroState",
@@ -123,30 +125,12 @@ class ZeroState(NamedTuple):
     opt_state: Any
 
 
-def _padded_size(n: int, ranks: int) -> int:
-    """Pad to a multiple of ranks*128 so every shard is lane-aligned (the
-    partitioner's 512-elem tile rule, common/partitioner.py, scaled to the
-    shard grid)."""
-    quantum = ranks * 128
-    return (n + quantum - 1) // quantum * quantum
-
-
-def _resolve_axes(comm: CommContext, shard_axes: str):
-    """(scatter/gather axes, remaining-sum axes, shard count).
-
-    "all": shard over every DP axis — minimum memory (1/R).
-    "ici": HSDP / hybrid sharding — shard within a slice, replicate
-    across slices: the per-step all_gather/psum_scatter ride ICI only,
-    and DCN carries just a psum of the 1/n_ici gradient shard (the
-    layout multi-slice pods want when DCN bandwidth, not HBM, is the
-    constraint).
-    """
-    if shard_axes == "all":
-        return comm.dp_axes, (), comm.num_ranks
-    if shard_axes == "ici":
-        return (ICI_AXIS,), (DCN_AXIS,), comm.n_ici
-    raise ValueError(
-        f"shard_axes must be 'all' or 'ici', got {shard_axes!r}")
+# Shard-geometry math is shared with the engine's fused sharded weight
+# update (comm/shard_math.py; core/sharded_update.py) — the historical
+# private names stay importable so callers and tests see one surface.
+_padded_size = padded_size
+_resolve_axes = resolve_axes
+_spec_of_opt = spec_of_opt
 
 
 def init_zero_state(comm: CommContext, tx: optax.GradientTransformation,
@@ -158,26 +142,9 @@ def init_zero_state(comm: CommContext, tx: optax.GradientTransformation,
     vec, _ = ravel_pytree(params)
     padded = _padded_size(vec.size, nsh)
     master = jnp.pad(vec.astype(jnp.float32), (0, padded - vec.size))
-    sh = NamedSharding(comm.mesh, P(axes))
-    master = jax.device_put(master, sh)
-    # Pin the optimizer-state shardings: zeros_like outputs carry no data
-    # dependence on the input, so XLA propagation would replicate them.
-    shapes = jax.eval_shape(tx.init, master)
-    out_sh = jax.tree.map(
-        lambda s: sh if (s.ndim == 1 and s.shape[0] == padded)
-        else NamedSharding(comm.mesh, P()), shapes)
-    opt_state = jax.jit(tx.init, out_shardings=out_sh)(master)
+    master = jax.device_put(master, NamedSharding(comm.mesh, P(axes)))
+    opt_state = init_sharded_opt_state(comm, tx, master, padded, axes)
     return ZeroState(master=master, opt_state=opt_state)
-
-
-def _spec_of_opt(tree, padded: int, axes):
-    """PartitionSpec tree for a ZeroState: vectors of the master's padded
-    length are sharded over the DP axes, everything else (step counters,
-    scalar hyperparams) is replicated."""
-    return jax.tree.map(
-        lambda x: P(axes) if (getattr(x, "ndim", 0) == 1
-                              and x.shape[0] == padded) else P(),
-        tree)
 
 
 def _unraveler(params_template):

@@ -126,6 +126,11 @@ class KVStore:
         else:
             self.wire_bytes += nbytes
             counters.inc("wire_bytes", nbytes)
+            # per-leg series (ISSUE 20, PR-6 label convention): a
+            # store-bound delta is a push; the labeled series sits
+            # BESIDE the unlabeled total, which stays the established
+            # async-PS figure
+            counters.inc("wire_bytes", nbytes, leg="push")
 
     def debug_state(self) -> dict:
         """Postmortem internals for ``/debug/state``: dedup floors, wire
@@ -276,6 +281,14 @@ class KVStore:
                 if key not in self._store:
                     self._store[key] = np.array(value, copy=True)
                     self._versions[key] = 0
+        elif kind == "publish":
+            key, value = data
+            with self._lock:
+                version = 0 if key not in self._store \
+                    else self._versions[key] + 1
+                self._cow.discard(key)
+                self._store[key] = np.array(value, copy=True)
+                self._versions[key] = version
         elif kind == "epoch":
             with self._lock:
                 if data > self._membership_epoch:
@@ -376,6 +389,32 @@ class KVStore:
                 created = True
         if created:
             self._notify(key, 0)
+
+    def publish_key(self, key: str, value) -> int:
+        """Serving-side overwrite: replace ``key``'s value wholesale and
+        bump its version (creating it at version 0 if absent).
+
+        Unlike the training-side delta paths this does NOT sum — the
+        caller owns the key exclusively (the sharded-update serving cut
+        publishes each owner's parameter slice here, serving_tier.py).
+        An overwrite is the only bitwise-exact refresh: ``old + (new -
+        old)`` re-rounds in float, so a delta-summed publish could
+        serve values that differ from the training master in the last
+        ulp.  COW references from outstanding snapshots stay frozen —
+        the store slot is re-pointed, never mutated in place."""
+        arr = np.array(value, copy=True)
+        with self._lock:
+            if self._wal is not None:
+                self._wal.append("publish", (key, arr))
+            if key not in self._store:
+                version = 0
+            else:
+                version = self._versions[key] + 1
+            self._cow.discard(key)
+            self._store[key] = arr
+            self._versions[key] = version
+        self._notify(key, version)
+        return version
 
     def _push_delta_locked(self, key: str, delta: np.ndarray) -> int:
         if key not in self._store:

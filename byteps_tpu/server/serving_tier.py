@@ -70,7 +70,27 @@ from .serving import (ServeReply, ServeUnavailable, Snapshot, SnapshotRing,
                       SnapshotServer, SnapshotStore)
 
 __all__ = ["AdmissionControl", "ServingHostCore", "TierDirectory",
-           "ServingTier", "TierRouter", "inproc_host", "SERVE_RANK_BASE"]
+           "ServingTier", "TierRouter", "inproc_host", "SERVE_RANK_BASE",
+           "assemble_shard_keys"]
+
+
+def assemble_shard_keys(pull, name: str) -> np.ndarray:
+    """Rebuild one shard-published parameter (ISSUE 20) from its
+    per-owner keys: ``pull`` is any ``key -> ndarray`` callable — a
+    :class:`~.kv_store.KVStore`'s ``pull``, a tier client wrapper, or
+    ``snapshot.refs.__getitem__``.  Reads the ``{name}@shards``
+    manifest (shard count, logical length, column width, shape) and
+    concatenates the ``{name}@shard{i}`` slices in offset order; the
+    result is bitwise the training master cast to the declared dtype —
+    identical to what an unsharded cut of the full parameter would
+    serve."""
+    meta = np.asarray(pull(f"{name}@shards"))
+    nshards, n = int(meta[0]), int(meta[1])
+    shape = tuple(int(d) for d in meta[3:])
+    parts = [np.asarray(pull(f"{name}@shard{i}")).reshape(-1)
+             for i in range(nshards)]
+    flat = np.concatenate(parts) if parts else np.zeros(0, np.float32)
+    return flat[:n].reshape(shape)
 
 # serving hosts publish bus metrics at host_id + this base (one id space
 # for bps_top rows, zero collision with trainer ranks)
@@ -837,10 +857,16 @@ class ServingTier:
                  cut_interval_s: Optional[float] = None,
                  ship_deadline_s: float = 2.0,
                  fail_streak: int = 2,
-                 conn_kw: Optional[dict] = None):
+                 conn_kw: Optional[dict] = None,
+                 update_slots=None):
         from ..common.config import get_config
         cfg = get_config()
         self.store = store
+        # shard-published cuts (ISSUE 20): a mapping name -> slot, or a
+        # zero-arg callable returning one; None auto-discovers the live
+        # engine's sharded-update slots at each cut
+        self._update_slots = update_slots
+        self._pub_applied: Dict[str, int] = {}
         self.replicas = (cfg.serve_tier_replicas if replicas is None
                          else int(replicas))
         self.directory = directory if directory is not None else \
@@ -984,6 +1010,50 @@ class ServingTier:
 
     # -- publication ---------------------------------------------------------
 
+    def _update_slot_map(self) -> Dict[str, object]:
+        src = self._update_slots
+        if src is None:
+            from ..core import api as _api
+            eng = _api._engine
+            return dict(getattr(eng, "update_slots", None) or {})
+        return dict(src() if callable(src) else src)
+
+    def _publish_update_slots(self) -> None:
+        """Shard-published serving cut (ISSUE 20): under sharded update
+        the parameters live as owner-resident flat shards inside the
+        engine — there is no replicated copy to snapshot.  Each owner's
+        slice is published into the store as its own key
+        (``name@shard{i}``, plus a ``name@shards`` manifest for
+        read-side reassembly, :func:`assemble_shard_keys`), so the ring
+        routes every slice to its arc directly and NO step of this path
+        allocates a full-parameter buffer (``ShardedUpdateSlot.
+        export_shards`` reads per-device shards; ``publish_key``
+        overwrites exactly — a delta-summed refresh would re-round).
+        Slots whose ``applied`` counter has not advanced since the last
+        cut publish nothing, so steady-state cuts are write-free."""
+        try:
+            slots = self._update_slot_map()
+        except Exception:  # noqa: BLE001 — a torn-down engine mid-cut
+            # must not fail the cut of everything else in the store
+            get_logger().warning("serving tier: sharded-update slot "
+                                 "discovery failed", exc_info=True)
+            return
+        for name, slot in sorted(slots.items()):
+            applied = int(getattr(slot, "applied", 0))
+            if self._pub_applied.get(name) == applied:
+                continue
+            shards = slot.export_shards()
+            nbytes = 0
+            for i, (_, _, arr) in enumerate(shards):
+                self.store.publish_key(f"{name}@shard{i}", arr)
+                nbytes += arr.nbytes
+            meta = np.array([len(shards), slot.n, slot.C]
+                            + list(slot.out_shape), np.int64)
+            self.store.publish_key(f"{name}@shards", meta)
+            self._pub_applied[name] = applied
+            counters.inc("serve.shard_publishes")
+            counters.inc("serve.shard_publish_bytes", nbytes)
+
     def _replica_hosts(self, key) -> List[int]:
         memo = self._owner_memo.get(key)
         if memo is None:
@@ -998,6 +1068,7 @@ class ServingTier:
         tier has no hosts yet."""
         with self._cut_serial:
             self.refresh_directory()
+            self._publish_update_slots()
             snap = self.snapstore.cut()
             hosts = sorted(self.ring.hosts())
             if not hosts:

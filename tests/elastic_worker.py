@@ -75,10 +75,88 @@ import numpy as np
 LR = 0.1
 DIM = 8
 
+# Sharded-update leg (BYTEPS_ELASTIC_SHARDED=1, ISSUE 20 chaos proof):
+# a second model trained through declare_update/push_pull_update on the
+# local engine.  SU_DIM is deliberately NOT divisible by the 2-device
+# local mesh, so every restore exercises the re-pad (C=6, n_pad=12).
+SU_NAME = "wsh"
+SU_DIM = 11
+
 
 def _grad(rank: int) -> np.ndarray:
     # rank-distinct so shrink/grow changes the mean: {1,4,9}/3 vs {1,9}/2
     return np.full(DIM, float((rank + 1) ** 2), np.float32)
+
+
+def _su_tx():
+    import optax
+
+    # momentum → a real padded-length trace leaf that must survive the
+    # elastic re-shard bit-for-bit
+    return optax.sgd(learning_rate=LR, momentum=0.9)
+
+
+def _su_slot(api, m, mm):
+    """A live sharded-update slot on the CURRENT engine.  After a world
+    change tore the engine down, declare_update consumes the suspend()
+    stash — master + momentum re-padded onto the rebuilt mesh — and the
+    worker prints ``RESHARDED <applied> <owner,owner>`` as the restore
+    evidence (a fresh slot would have applied == 0)."""
+    retries = 0
+    while True:
+        try:
+            eng = api._require()
+            slot = eng.update_slots.get(SU_NAME)
+            if slot is None:
+                api.declare_update(SU_NAME, (SU_DIM,), tx=_su_tx(),
+                                   init_value=np.zeros(SU_DIM, np.float32))
+                slot = eng.update_slots[SU_NAME]
+                if slot.applied:
+                    owners = ",".join(str(o) for o, _, _ in
+                                      slot.export_shards())
+                    print("RESHARDED", slot.applied, owners, flush=True)
+            return slot
+        except RuntimeError:
+            retries += 1
+            if retries > 200:
+                raise
+            m.wait_ready(mm.current_epoch(), timeout=30)
+            time.sleep(0.05)
+
+
+def _su_step(api, m, mm, g0, target):
+    """One exactly-once sharded-update dispatch: push this step's mean
+    gradient (scaled onto a fixed basis vector), commit exactly one
+    owner-resident optax update.
+
+    ``target`` is how many updates must have committed once this call
+    returns.  A mid-dispatch engine teardown (the kill's shrink) loses
+    the handle but not the state: suspend() exported the slot WITH its
+    ``applied`` count, so after the re-declare the counter arbitrates
+    the torn step — already ``target`` means it committed before the
+    drain (skip; a redispatch would double-apply), ``target - 1`` means
+    the unit was dropped as stale (redispatch).  Never lost, never
+    double-applied."""
+    g = np.float32(g0) * np.arange(1, SU_DIM + 1, dtype=np.float32)
+    retries = 0
+    while True:
+        slot = _su_slot(api, m, mm)
+        if slot.applied >= target:
+            assert slot.applied == target, (slot.applied, target)
+            return
+        try:
+            api._require().push_pull_update(g, SU_NAME)
+        except (RuntimeError, ValueError):
+            # engine torn down / rebuilt mid-dispatch (ValueError: the
+            # rebuilt engine has no slot yet — next _su_slot re-declares)
+            retries += 1
+            if retries > 200:
+                raise
+            m.wait_ready(mm.current_epoch(), timeout=30)
+            time.sleep(0.05)
+            continue
+        assert slot.applied == target, (slot.applied, target)
+        return
 
 
 def _stale_probes(api, mm) -> int:
@@ -180,6 +258,7 @@ def main() -> int:
     init_w = float(os.environ.get("BYTEPS_ELASTIC_INIT_W", "0"))
     sleep_s = float(os.environ.get("BYTEPS_ELASTIC_STEP_SLEEP", "0.05"))
     rejoining = os.environ.get("BYTEPS_ELASTIC_REJOIN", "") == "1"
+    sharded = os.environ.get("BYTEPS_ELASTIC_SHARDED", "") == "1"
     die_on_detect = os.environ.get("BYTEPS_ELASTIC_DIE_ON_DETECT", "") == "1"
     wedge_step = int(os.environ.get("BYTEPS_ELASTIC_WEDGE_STEP", "0"))
     wedge_s = float(os.environ.get("BYTEPS_ELASTIC_WEDGE_S", "4"))
@@ -241,6 +320,7 @@ def main() -> int:
     wedged = False
     partition_armed = False
     conn_errs = 0
+    su_target = 0   # sharded-update commits expected so far (exactly-once)
     while step <= n_steps:
         if retries > 200:   # a real wedge must fail loudly, not spin
             print("RETRY-BUDGET-EXHAUSTED at", step, flush=True)
@@ -320,9 +400,12 @@ def main() -> int:
         retries = 0
         conn_errs = 0
         grads = [np.asarray(p) for p in payloads.values()]
-        w = w - np.float32(LR) * (np.sum(grads, axis=0,
-                                         dtype=np.float32)
-                                  / np.float32(len(grads)))
+        g = (np.sum(grads, axis=0, dtype=np.float32)
+             / np.float32(len(grads)))
+        w = w - np.float32(LR) * g
+        if sharded:
+            su_target += 1
+            _su_step(api, m, mm, float(g[0]), su_target)
         step += 1
         time.sleep(sleep_s)
 
@@ -335,6 +418,14 @@ def main() -> int:
         print("DEADLINE-TRIPS", _counters.get("engine.sync_deadline_trips"),
               "RECONCILES", _counters.get("membership.reconcile_started"),
               flush=True)
+    if sharded:
+        # read the master back through export() (logical length): the
+        # test replays the mean-gradient sequence with eager optax and
+        # asserts this line bit-for-bit, plus applied == steps (no lost
+        # or double-applied update across the mid-step teardown)
+        slot = _su_slot(api, m, mm)
+        vals = ",".join(repr(float(v)) for v in slot.export()["master"])
+        print("FINAL-SHARDED", slot.applied, vals, flush=True)
     view = m.view()
     print("FINAL", view.epoch, ",".join(map(str, view.world)),
           repr(float(w[0])), flush=True)

@@ -957,3 +957,37 @@ def test_bench_smoke_compressed_floor_and_gate_arithmetic():
     # contract is onebit's — randomk's lane reports it for the trend
     assert lanes()["randomk"]["wire_ratio"] > floor[
         "compressed_wire_ratio_max"]
+
+
+def test_bench_smoke_sharded_update_floor_and_gate_arithmetic():
+    """ISSUE 20: the sharded_update lane gates on the wire-ratio
+    contract (push N + pull N/R — deterministic, no tolerance), the
+    bitwise replay exactness (absolute), and the interleaved step-time
+    ratio over the floor with the lane tolerance.  Pin the floor file's
+    entries and the pure gate function."""
+    from tools import bench_smoke as bs
+    with open(bs.FLOOR_PATH) as f:
+        floor = json.load(f)
+    assert floor["sharded_wire_ratio_max"] <= 0.62
+    assert floor["sharded_step_ratio_floor"] > 0
+
+    def su():
+        return {"exact": True, "wire_ratio": 0.577,
+                "step_time_ratio": 1e9}
+
+    good = su()
+    assert bs._sharded_update_ok(good, floor, 0.3)
+    assert good["gate_step_ratio"] == round(
+        floor["sharded_step_ratio_floor"] * 0.7, 3)
+    # trajectory drift fails outright — the replay proof is absolute
+    drift = su()
+    drift["exact"] = False
+    assert not bs._sharded_update_ok(drift, floor, 0.3)
+    # the wire ratio is the feature's contract — no tolerance applied
+    fat = su()
+    fat["wire_ratio"] = floor["sharded_wire_ratio_max"] + 0.01
+    assert not bs._sharded_update_ok(fat, floor, 0.3)
+    # an update-machinery collapse fails the step-time floor
+    slow = su()
+    slow["step_time_ratio"] = 0.0
+    assert not bs._sharded_update_ok(slow, floor, 0.3)

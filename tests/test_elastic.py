@@ -213,6 +213,88 @@ def test_rejoin_in_place_at_step_boundary():
     assert "STALE-PUSH-DROPPED" in outs[0], outs[0][-3000:]
 
 
+def _simulate_sharded(worlds):
+    """elastic_worker's sharded-update leg, bit-for-bit: eager optax
+    sgd(momentum=0.9) on the mean-gradient basis vector, float32
+    throughout — the same eager op sequence the slot's exact mode runs
+    on its padded shards (the pad is zeros under elementwise
+    transforms, so the logical region is identical)."""
+    import jax.numpy as jnp
+    import optax
+
+    from .elastic_worker import LR, SU_DIM
+
+    tx = optax.sgd(learning_rate=LR, momentum=0.9)
+    basis = np.arange(1, SU_DIM + 1, dtype=np.float32)
+    w = jnp.zeros(SU_DIM, jnp.float32)
+    st = tx.init(w)
+    for ranks in worlds:
+        g0 = (np.sum([np.float32((r + 1) ** 2) for r in ranks],
+                     dtype=np.float32) / np.float32(len(ranks)))
+        u, st = tx.update(jnp.asarray(np.float32(g0) * basis), st, w)
+        w = optax.apply_updates(w, u)
+    return np.asarray(w)
+
+
+@pytest.mark.chaos
+def test_shrink_resharding_sharded_update():
+    """ISSUE 20 chaos acceptance (tools/run_chaos.sh `sharded` lane):
+    kill rank 1 mid-step while every worker ALSO trains a second model
+    through the engine's sharded weight-update path
+    (BYTEPS_SHARDED_UPDATE=1, optimizer state owner-resident on the
+    local mesh).  The survivors' shrink tears each engine down —
+    possibly mid-dispatch — and the suspend() stash carries master +
+    momentum at logical length; declare_update re-pads them onto the
+    rebuilt mesh (the ``RESHARDED <applied> <owners>`` line, applied>0,
+    proves restore-not-reinit and the owner reassignment).
+
+    Exactly-once: the slot's ``applied`` counter arbitrates a torn
+    dispatch (committed before the drain → skip; dropped as stale →
+    redispatch), so each survivor commits exactly one update per step
+    and the final master is bit-for-bit the eager-optax replay of the
+    mean-gradient sequence ({0,1,2} before the shrink, {0,2} after).
+    The geometry-CHANGING re-shard (8→4 devices) is pinned in-process
+    in tests/test_sharded_update.py; this lane pins the kill-driven
+    export/restore path under real process chaos."""
+    n, kill_step = 9, 4
+    # the sharded leg doubles the per-step push count (grad + wsh), and
+    # the injector counts pushes: land the kill on step 4's GRAD push,
+    # before its step-4 sync — survivors sync steps 1-3 at full world
+    kill_push = 2 * kill_step - 1
+    bus, hb = str(_free_port()), str(_free_port())
+    procs = {
+        r: _spawn(r, "0,1,2", bus, hb, n, extra={
+            "BYTEPS_ELASTIC_SHARDED": "1",
+            "BYTEPS_SHARDED_UPDATE": "1",
+            **({"BYTEPS_FAULT_SPEC": f"kill:rank=1:step={kill_push}",
+                "BYTEPS_FAULT_SEED": "7"} if r == 1 else {})})
+        for r in (0, 1, 2)}
+    outs = _communicate(procs)
+
+    assert procs[1].returncode == 1, outs[1][-3000:]
+    assert "FINAL" not in outs[1]
+
+    expected = _simulate_sharded(
+        [(0, 1, 2)] * (kill_step - 1) + [(0, 2)] * (n - kill_step + 1))
+    for r in (0, 2):
+        assert procs[r].returncode == 0, outs[r][-3000:]
+        assert "WORLD 1 0,2" in outs[r], outs[r][-3000:]
+        # the rebuilt engine restored (not re-initialized) the slot:
+        # applied > 0 at re-declare time, and the owner map covers the
+        # whole re-padded vector on the 2-device local mesh
+        resh = [l for l in outs[r].splitlines()
+                if l.startswith("RESHARDED ")]
+        assert resh, outs[r][-3000:]
+        assert all(int(l.split()[1]) >= 1 for l in resh), resh
+        assert resh[-1].split()[2] == "0,1", resh
+        fin = next(l for l in outs[r].splitlines()
+                   if l.startswith("FINAL-SHARDED "))
+        _, applied, vals = fin.split(" ", 2)
+        assert int(applied) == n, fin   # exactly one commit per step
+        got = np.array([float(v) for v in vals.split(",")], np.float32)
+        assert np.array_equal(got, expected), (r, got, expected)
+
+
 @pytest.mark.chaos
 def test_double_failure_during_shrink():
     """Rank 1 is killed mid-train; rank 2 dies the moment its detector

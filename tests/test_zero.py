@@ -248,3 +248,77 @@ def test_hsdp_clip_by_global_norm_sgd(comm):
         p, zstate, loss = zstep(p, zstate, batch)
         losses.append(float(loss))
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+
+# -- shard-geometry edge cases (ISSUE 20 satellite) ---------------------------
+# `_padded_size` / `_spec_of_opt` are the unification surface shared with
+# core/sharded_update.py (comm/shard_math.py): pin the boundary behavior
+# the replay proofs never exercise.
+
+
+def test_padded_size_edge_cases():
+    from byteps_tpu.parallel.zero import _padded_size
+    # the pad quantum is ranks*128 (lane alignment), so a numel not
+    # divisible by ranks still lands on a full tile grid
+    assert _padded_size(0, 8) == 0
+    assert _padded_size(1, 8) == 1024
+    assert _padded_size(33, 8) == 1024
+    assert _padded_size(1024, 8) == 1024
+    assert _padded_size(1025, 8) == 2048
+    assert _padded_size(7, 1) == 128
+    # every result divides evenly among the ranks
+    for n in (1, 33, 1000, 4097):
+        for r in (1, 2, 4, 8):
+            p = _padded_size(n, r)
+            assert p >= n and p % r == 0 and p % 128 == 0
+
+
+def test_spec_of_opt_edge_cases(comm):
+    from jax.sharding import PartitionSpec as P
+    from byteps_tpu.parallel.zero import _spec_of_opt
+    padded = 1024
+    axes = ("dcn", "ici")
+    tree = {
+        "sharded": jnp.zeros(padded, jnp.float32),
+        "sharded_i8": jnp.zeros(padded, jnp.int8),     # mixed dtype: the
+        # spec rule is SHAPE-based, dtype does not exempt a leaf
+        "short": jnp.zeros(padded - 1, jnp.float32),   # wrong length
+        "matrix": jnp.zeros((padded, 1), jnp.float32),  # wrong rank
+        "scalar": jnp.zeros((), jnp.float32),          # 0-d (step count)
+        "count": jnp.array(0, jnp.int32),
+        "empty": jnp.zeros(0, jnp.float32),            # empty leaf
+        "none": None,                                  # optax EmptyState
+    }
+    spec = _spec_of_opt(tree, padded, axes)
+    assert spec["sharded"] == P(axes)
+    assert spec["sharded_i8"] == P(axes)
+    for k in ("short", "matrix", "scalar", "count", "empty"):
+        assert spec[k] == P(), k
+    assert "none" not in jax.tree.leaves(spec) or spec["none"] == P()
+    # empty optimizer state (optax.sgd has no state vectors) maps cleanly
+    assert _spec_of_opt({}, padded, axes) == {}
+
+
+def test_init_sharded_opt_state_pads_and_places(comm):
+    from byteps_tpu.comm.shard_math import (init_sharded_opt_state,
+                                            padded_size)
+    tx = optax.adam(1e-2)
+    n = 1000                                 # NOT divisible by 8
+    nsh = comm.num_ranks
+    padded = padded_size(n, nsh)
+    master = jax.device_put(
+        jnp.zeros(padded, jnp.float32),
+        jax.sharding.NamedSharding(comm.mesh,
+                                   jax.sharding.PartitionSpec(
+                                       ("dcn", "ici"))))
+    state = init_sharded_opt_state(comm, tx, master, padded,
+                                   ("dcn", "ici"))
+    for leaf in jax.tree.leaves(state):
+        if leaf.ndim == 1 and leaf.shape[0] == padded:
+            # padded-length vectors are committed to the shard layout
+            assert len(leaf.sharding.device_set) == nsh
+            shard = next(iter(leaf.addressable_shards))
+            assert shard.data.shape[0] == padded // nsh
+        else:
+            # counters stay replicated
+            assert leaf.sharding.is_fully_replicated

@@ -15,7 +15,21 @@
 #         lane: chaos (default) | integrity | obs | coordinator | serve
 #               | serve_dist | straggler | compressed | trace
 #               | transport | doctor | gossip | fleet | durability
-#               | lint | all
+#               | sharded | lint | all
+#         sharded: the sharded-weight-update elastic slice (ISSUE 20,
+#              core/sharded_update.py, docs/performance.md "Sharded
+#              weight update") — kill one rank mid-step while every
+#              worker trains through declare_update/push_pull_update:
+#              the survivors' shrink tears each engine down
+#              (possibly mid-dispatch), the suspend stash re-pads
+#              master + momentum onto the rebuilt mesh (RESHARDED
+#              evidence, owner reassignment), the slot's `applied`
+#              counter arbitrates the torn step (committed → skip,
+#              dropped-as-stale → redispatch; never lost, never
+#              double-applied), and the final master is bit-for-bit
+#              the eager-optax replay of the mean-gradient sequence
+#              (tests/test_elastic.py
+#              test_shrink_resharding_sharded_update)
 #         durability: the durable-state-plane slice (ISSUE 19,
 #              server/wal.py, docs/fault_tolerance.md "Durable state &
 #              cold start") — the full-world kill acceptance (SIGKILL
@@ -170,6 +184,9 @@ case "${1:-}" in
     durability) MARK="chaos or integrity"
                 KEXPR="durability or wal"
                 shift ;;
+    sharded)   MARK="chaos"
+               KEXPR="sharded"
+               shift ;;
     all)       MARK="chaos or integrity"; shift ;;
     lint)
         shift
